@@ -1,0 +1,62 @@
+"""Headline benchmark: 3-D diffusion cell-update rate (MLUPS) on one chip.
+
+Mirrors the reference's north-star measurement — the 4th-order 13-point
+Laplacian + SSP-RK3 hot loop of ``MultiGPU/Diffusion3d_Baseline``
+(401×201×207 including reference halo, 101 iters, 5.87 "GFLOPS" on
+2 GPUs ≈ 731 MLUPS total, ``Run.m:4-13``; derivation in BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+BASELINE_MLUPS = 731.0  # MultiGPU Diffusion3d, 2 GPUs total (BASELINE.md)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from multigpu_advectiondiffusion_tpu import DiffusionConfig, DiffusionSolver, Grid
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    # Reference interior grid 400x200x206 (z,y,x) = (206,200,400) rounded to
+    # friendly TPU tile sizes; double precision in the reference, f32 here
+    # (the framework's TPU dtype policy, core/dtypes.py).
+    grid = Grid.make(400, 200, 208, lengths=(10.0, 5.0, 5.0))
+    cfg = DiffusionConfig(grid=grid, diffusivity=1.0, dtype="float32")
+    solver = DiffusionSolver(cfg)
+    state = solver.initial_state()
+
+    iters = 101
+    # warm-up + compile
+    out = solver.run(state, iters)
+    out.u.block_until_ready()
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = solver.run(state, iters)
+        out.u.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+
+    rate = mlups(grid.num_cells, iters, STAGES[cfg.integrator], best)
+    print(
+        json.dumps(
+            {
+                "metric": "diffusion3d_mlups",
+                "value": round(rate, 2),
+                "unit": "MLUPS",
+                "vs_baseline": round(rate / BASELINE_MLUPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

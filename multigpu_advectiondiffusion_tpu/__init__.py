@@ -1,0 +1,45 @@
+"""TPU-native advection–diffusion framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+CUDA+MPI codebase ``cfd-learner/MultiGPU_AdvectionDiffusion``:
+
+* Heat/diffusion equation ``u_t = K lap(u)`` with a 4th-order central
+  Laplacian (13-point in 3-D) and SSP-RK3 time stepping
+  (reference: ``MultiGPU/Diffusion3d_Baseline``,
+  ``Matlab_Prototipes/DiffusionNd``).
+* Inviscid/viscous Burgers equation ``u_t + div(u^2/2) = nu lap(u)`` with
+  5th/7th-order WENO flux reconstruction and Lax–Friedrichs splitting
+  (reference: ``MultiGPU/Burgers3d_Baseline``, ``SingleGPU/Burgers3d_WENO5*``,
+  ``Matlab_Prototipes/InviscidBurgersNd``).
+
+Where the reference scales with 1 MPI rank per GPU, host-staged halo
+exchanges and five CUDA streams, this framework scales with a
+``jax.sharding.Mesh`` + ``shard_map`` step whose halo exchange is
+``jax.lax.ppermute`` over ICI, and relies on XLA's async collectives for
+compute/communication overlap.
+"""
+
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+from multigpu_advectiondiffusion_tpu.core.bc import Boundary
+from multigpu_advectiondiffusion_tpu.models.diffusion import (
+    DiffusionConfig,
+    DiffusionSolver,
+)
+from multigpu_advectiondiffusion_tpu.models.burgers import (
+    BurgersConfig,
+    BurgersSolver,
+)
+from multigpu_advectiondiffusion_tpu.models.state import SolverState
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Grid",
+    "Boundary",
+    "DiffusionConfig",
+    "DiffusionSolver",
+    "BurgersConfig",
+    "BurgersSolver",
+    "SolverState",
+    "__version__",
+]

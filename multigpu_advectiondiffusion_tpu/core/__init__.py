@@ -1,0 +1,5 @@
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+from multigpu_advectiondiffusion_tpu.core.bc import Boundary, pad_axis
+from multigpu_advectiondiffusion_tpu.core import dtypes
+
+__all__ = ["Grid", "Boundary", "pad_axis", "dtypes"]

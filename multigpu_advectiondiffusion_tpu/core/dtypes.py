@@ -1,0 +1,41 @@
+"""Floating-point policy.
+
+The reference compiles for a single ``REAL`` selected at build time
+(``MultiGPU/Diffusion3d_Baseline/DiffusionMPICUDA.h:66-73``, default double).
+On TPU float64 is software-emulated, so the policy here is: float32 by
+default (fast path on MXU/VPU), float64 opt-in for accuracy studies (needs
+``jax.config.jax_enable_x64``), bfloat16 available for experiments.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_ALIASES = {
+    "f32": jnp.float32,
+    "float32": jnp.float32,
+    "single": jnp.float32,
+    "f64": jnp.float64,
+    "float64": jnp.float64,
+    "double": jnp.float64,
+    "bf16": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+}
+
+
+def canonicalize(dtype) -> jnp.dtype:
+    """Resolve a user-facing dtype spec to a concrete jnp dtype."""
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _ALIASES:
+            raise ValueError(f"unknown dtype {dtype!r}; use one of {sorted(_ALIASES)}")
+        dt = _ALIASES[key]
+    else:
+        dt = jnp.dtype(dtype).type
+    if dt == jnp.float64 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "float64 requested but jax_enable_x64 is off; "
+            "set JAX_ENABLE_X64=1 or jax.config.update('jax_enable_x64', True)"
+        )
+    return jnp.dtype(dt)

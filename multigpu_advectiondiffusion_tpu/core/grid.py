@@ -1,0 +1,165 @@
+"""Structured node-centered grids.
+
+The reference builds its grids inline in every driver with the convention
+``dx = L/(Nx-1)`` over symmetric domains (e.g.
+``MultiGPU/Diffusion3d_Baseline/main.c:61-63``,
+``Matlab_Prototipes/DiffusionNd/heat3d.m:17-23``,
+``Matlab_Prototipes/InviscidBurgersNd/LFWENO5FDM3d.m:52-55``). Here the grid
+is a first-class object shared by every solver.
+
+Array-axis convention: fields are stored C-order with **x innermost**, i.e.
+a 3-D field has shape ``(nz, ny, nx)``. On TPU this places the x sweep along
+vector lanes and matches the reference's flat index ``o = i + nx*j + nx*ny*k``
+(``MultiGPU/Diffusion3d_Baseline/Tools.c:110``), so ``u.ravel()`` reproduces
+the reference's binary file layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+# Axis names in array order for each dimensionality.
+_AXIS_NAMES = {1: ("x",), 2: ("y", "x"), 3: ("z", "y", "x")}
+
+
+@dataclasses.dataclass(frozen=True)
+class Grid:
+    """A uniform node-centered grid.
+
+    Attributes:
+      shape: number of nodes per array axis, e.g. ``(nz, ny, nx)``.
+      bounds: ``(lo, hi)`` physical bounds per array axis.
+    """
+
+    shape: Tuple[int, ...]
+    bounds: Tuple[Tuple[float, float], ...]
+
+    def __post_init__(self):
+        if len(self.shape) != len(self.bounds):
+            raise ValueError(
+                f"shape {self.shape} and bounds {self.bounds} rank mismatch"
+            )
+        if not 1 <= len(self.shape) <= 3:
+            raise ValueError("only 1-D/2-D/3-D grids are supported")
+        for n in self.shape:
+            if n < 2:
+                raise ValueError(f"need at least 2 nodes per axis, got {self.shape}")
+
+    # ------------------------------------------------------------------ #
+    # Constructors
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def make(
+        nx: int,
+        ny: int | None = None,
+        nz: int | None = None,
+        lengths: Sequence[float] | float | None = None,
+        bounds: Sequence[Tuple[float, float]] | None = None,
+    ) -> "Grid":
+        """Build a grid from physical-order sizes ``nx, ny, nz``.
+
+        ``lengths`` are physical-order extents ``(L, W, H)``; the domain is
+        centered at the origin (matches ``heat3d.m:23`` meshgrid from ``-L/2``
+        to ``L/2``). Alternatively pass explicit physical-order ``bounds``.
+        """
+        sizes = [n for n in (nx, ny, nz) if n is not None]
+        ndim = len(sizes)
+        if bounds is None:
+            if lengths is None:
+                lengths = [2.0] * ndim
+            if isinstance(lengths, (int, float)):
+                lengths = [float(lengths)] * ndim
+            if len(lengths) != ndim:
+                raise ValueError("lengths rank mismatch")
+            bounds = [(-L / 2.0, L / 2.0) for L in lengths]
+        if len(bounds) != ndim:
+            raise ValueError("bounds rank mismatch")
+        # physical order (x, y, z) -> array order (z, y, x)
+        shape = tuple(reversed(sizes))
+        bnds = tuple(tuple(map(float, b)) for b in reversed(bounds))
+        return Grid(shape=shape, bounds=bnds)
+
+    @staticmethod
+    def make_periodic(
+        nx: int,
+        ny: int | None = None,
+        nz: int | None = None,
+        lengths: Sequence[float] | float | None = None,
+        origin: float = 0.0,
+    ) -> "Grid":
+        """Grid for periodic axes: nodes at ``origin + i*L/n`` for
+        ``i = 0..n-1`` so that ``n * dx`` equals the physical period ``L``
+        (the two endpoint nodes of :meth:`make` would alias under wrap
+        padding)."""
+        sizes = [n for n in (nx, ny, nz) if n is not None]
+        ndim = len(sizes)
+        if lengths is None:
+            lengths = [1.0] * ndim
+        if isinstance(lengths, (int, float)):
+            lengths = [float(lengths)] * ndim
+        bounds = [
+            (origin, origin + L * (n - 1) / n) for n, L in zip(sizes, lengths)
+        ]
+        return Grid.make(*sizes, bounds=bounds)
+
+    # ------------------------------------------------------------------ #
+    # Geometry
+    # ------------------------------------------------------------------ #
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return _AXIS_NAMES[self.ndim]
+
+    @property
+    def spacing(self) -> Tuple[float, ...]:
+        """Node spacing per array axis, ``dx = (hi-lo)/(n-1)``."""
+        return tuple(
+            (hi - lo) / (n - 1) for n, (lo, hi) in zip(self.shape, self.bounds)
+        )
+
+    @property
+    def num_cells(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def cell_volume(self) -> float:
+        return math.prod(self.spacing)
+
+    def axis_index(self, name: str) -> int:
+        return self.axis_names.index(name)
+
+    def coords(self, axis: int, dtype=jnp.float32) -> jnp.ndarray:
+        lo, hi = self.bounds[axis]
+        return jnp.linspace(lo, hi, self.shape[axis], dtype=dtype)
+
+    def meshgrid(self, dtype=jnp.float32):
+        """Coordinate arrays in array order, each of shape ``self.shape``."""
+        axes = [self.coords(a, dtype) for a in range(self.ndim)]
+        return jnp.meshgrid(*axes, indexing="ij")
+
+    def radius_sq(self, dtype=jnp.float32) -> jnp.ndarray:
+        """``x^2 + y^2 + z^2`` about the domain center."""
+        r2 = jnp.zeros(self.shape, dtype=dtype)
+        for axis in range(self.ndim):
+            lo, hi = self.bounds[axis]
+            c = self.coords(axis, dtype) - 0.5 * (lo + hi)
+            shp = [1] * self.ndim
+            shp[axis] = self.shape[axis]
+            r2 = r2 + jnp.reshape(c * c, shp)
+        return r2
+
+    # Physical-order accessors -- convenience for reference-style drivers.
+    @property
+    def shape_xyz(self) -> Tuple[int, ...]:
+        return tuple(reversed(self.shape))
+
+    @property
+    def spacing_xyz(self) -> Tuple[float, ...]:
+        return tuple(reversed(self.spacing))

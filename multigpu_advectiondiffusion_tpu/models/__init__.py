@@ -1,0 +1,14 @@
+from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu.models.diffusion import (
+    DiffusionConfig,
+    DiffusionSolver,
+)
+from multigpu_advectiondiffusion_tpu.models.burgers import BurgersConfig, BurgersSolver
+
+__all__ = [
+    "SolverState",
+    "DiffusionConfig",
+    "DiffusionSolver",
+    "BurgersConfig",
+    "BurgersSolver",
+]

@@ -1,0 +1,100 @@
+"""Burgers / scalar conservation-law solver:
+``u_t + sum_axis d f(u)/dx_axis = nu lap(u)``.
+
+TPU-native re-design of the reference's WENO family:
+
+* Inviscid 1/2/3-D with WENO5-JS (``Matlab_Prototipes/InviscidBurgersNd/
+  LFWENO5FDM{1,2,3}d.m``, ``MultiGPU/Burgers{2,3}d_Baseline``),
+  WENO5-Z (``SingleGPU/Burgers3d_WENO5_SharedMem``) and WENO7
+  (``LFWENO7FDM*``).
+* Viscous option ``nu > 0`` with the 4th-order Laplacian — the single-GPU
+  Burgers variants are viscous with ``nu = 1e-5``
+  (``SingleGPU/Burgers3d_WENO5/main.cpp:56-59,147``).
+* Selectable flux: burgers / linear / buckley (``LFWENO5FDM3d.m:30-40``).
+
+Adaptive dt ``CFL dx / max|f'(u)|`` (``LFWENO5FDM3d.m:71``) is the default,
+with the global reduction running as ``lax.pmax`` over the device mesh.
+``adaptive_dt=False`` reproduces the CUDA drivers' hard-coded unit wave
+speed (``MultiGPU/Burgers3d_Baseline/main.c:193`` — a documented defect
+kept available only for benchmark parity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+from multigpu_advectiondiffusion_tpu.models.base import (
+    LocalPhysics,
+    SolverBase,
+    StepContext,
+)
+from multigpu_advectiondiffusion_tpu.ops import flux as flux_lib
+from multigpu_advectiondiffusion_tpu.ops.laplacian import laplacian
+from multigpu_advectiondiffusion_tpu.ops.weno import flux_divergence
+from multigpu_advectiondiffusion_tpu.timestepping.cfl import advective_dt
+
+
+@dataclasses.dataclass(frozen=True)
+class BurgersConfig:
+    grid: Grid
+    flux: str = "burgers"
+    flux_params: Tuple = ()
+    weno_order: int = 5
+    weno_variant: str = "js"
+    cfl: float = 0.4  # LFWENO5FDM3d.m:25
+    nu: float = 0.0  # viscosity; 1e-5 in SingleGPU Burgers (main.cpp:56)
+    laplacian_order: int = 4
+    adaptive_dt: bool = True
+    integrator: str = "ssp_rk3"
+    dtype: str = "float32"
+    ic: object = "gaussian"
+    ic_params: Tuple = ()
+    bc: object = "edge"
+    t0: float = 0.0
+
+
+class BurgersSolver(SolverBase):
+    cfg: BurgersConfig
+
+    def __init__(self, cfg: BurgersConfig, mesh=None, decomp=None):
+        super().__init__(cfg, mesh=mesh, decomp=decomp)
+        self.flux = flux_lib.get(cfg.flux, **dict(cfg.flux_params))
+
+    def build_local(self, ctx: StepContext) -> LocalPhysics:
+        cfg = self.cfg
+        spacing = cfg.grid.spacing
+        fx = self.flux
+
+        def rhs(u):
+            acc = None
+            for axis in range(u.ndim):
+                div = flux_divergence(
+                    u,
+                    axis,
+                    spacing[axis],
+                    fx,
+                    order=cfg.weno_order,
+                    variant=cfg.weno_variant,
+                    padder=ctx.padder,
+                )
+                acc = div if acc is None else acc + div
+            out = -acc
+            if cfg.nu:
+                out = out + laplacian(
+                    u,
+                    spacing,
+                    diffusivity=cfg.nu,
+                    order=cfg.laplacian_order,
+                    padder=ctx.padder,
+                )
+            return out
+
+        if cfg.adaptive_dt:
+            dt_fn = lambda u: advective_dt(  # noqa: E731
+                u, fx.df, spacing, cfg.cfl, reduce_max=ctx.reduce_max
+            )
+            return LocalPhysics(rhs=rhs, dt_fn=dt_fn)
+        # CUDA-parity fixed dt: CFL * dx / 1.0 (Burgers3d_Baseline/main.c:193)
+        return LocalPhysics(rhs=rhs, static_dt=cfg.cfl * min(spacing))

@@ -1,0 +1,225 @@
+"""Heat / diffusion equation solver: ``u_t = K lap(u) + S(u)``.
+
+TPU-native re-design of the reference's diffusion family:
+
+* 1/2/3-D, 2nd- or 4th-order Laplacian, SSP-RK3
+  (``Matlab_Prototipes/DiffusionNd/heat{1,2,3}d.m``,
+  ``SingleGPU/Diffusion{2,3}d*``, ``MultiGPU/Diffusion{2,3}d_Baseline``).
+* Axisymmetric r-y variant (``heat2d_axisymmetric.m``) via
+  ``geometry="axisymmetric"``.
+
+Reference-parity behavior (on by default): the Laplacian is zeroed on the
+2-cell boundary band (``Laplace3d.m:21``) and Dirichlet faces are
+re-clamped after every step (``heat3d.m:65-67``) — both applied with
+*global* indices, so a sharded run reproduces the single-device solution
+bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+from multigpu_advectiondiffusion_tpu.models.base import (
+    LocalPhysics,
+    SolverBase,
+    StepContext,
+)
+from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu.ops.axisym import (
+    axis_mask,
+    axisymmetric_laplacian,
+    inverse_radius,
+)
+from multigpu_advectiondiffusion_tpu.ops.laplacian import laplacian
+from multigpu_advectiondiffusion_tpu.ops.stencils import (
+    boundary_band_mask,
+    face_mask,
+)
+from multigpu_advectiondiffusion_tpu.timestepping.cfl import diffusive_dt
+from multigpu_advectiondiffusion_tpu.utils import metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffusionConfig:
+    grid: Grid
+    diffusivity: float = 1.0  # K, "heat conduction" arg (main.c:38)
+    order: int = 4
+    integrator: str = "ssp_rk3"
+    dtype: str = "float32"
+    safety: float = 0.8  # dt stability factor (main.c:64: 0.8; MATLAB: 0.9)
+    ic: object = "heat_kernel"
+    ic_params: Tuple = ()
+    bc: object = "dirichlet"
+    t0: float = 0.1  # initial time of the analytic Gaussian (heat3d.m:15)
+    reference_parity: bool = True
+    boundary_band: int = 2  # width of the skipped band (Laplace3d.m:21)
+    source: Optional[Callable] = None  # S(u) hook (heat3d.m:26-30)
+    geometry: str = "cartesian"  # or "axisymmetric" (2-D r-y)
+
+    def __post_init__(self):
+        if self.geometry not in ("cartesian", "axisymmetric"):
+            raise ValueError(f"unknown geometry {self.geometry!r}")
+        if self.geometry == "axisymmetric" and self.grid.ndim != 2:
+            raise ValueError("axisymmetric geometry requires a 2-D (y, r) grid")
+
+
+class DiffusionSolver(SolverBase):
+    cfg: DiffusionConfig
+
+    def __init__(self, cfg: DiffusionConfig, mesh=None, decomp=None):
+        super().__init__(cfg, mesh=mesh, decomp=decomp)
+        self.dt = diffusive_dt(cfg.diffusivity, cfg.grid.spacing, cfg.safety)
+
+    def ic_spec(self):
+        """Thread the config's diffusivity/t0 into the analytic ICs so the
+        initial state always matches :meth:`exact_solution` at ``t = t0``
+        (the MATLAB drivers couple these by construction, heat3d.m:33-36)."""
+        name = self.cfg.ic
+        if name == "heat_kernel" and self.cfg.geometry == "axisymmetric":
+            name = "heat_kernel_radial"
+        if name in ("heat_kernel", "heat_kernel_radial"):
+            return name, {"t0": self.cfg.t0, "diffusivity": self.cfg.diffusivity}
+        return name, {}
+
+    def build_local(self, ctx: StepContext) -> LocalPhysics:
+        cfg = self.cfg
+        grid = cfg.grid
+        bcs = self.bcs
+
+        if cfg.geometry == "axisymmetric":
+            r = grid.coords(1, self.dtype)
+            inv_r_local = inverse_radius(r)
+            on_axis_local = axis_mask(r)
+            # slice the local window when the r axis is sharded
+            if ctx.local_shape[1] != ctx.global_shape[1]:
+                inv_r_local = jax.lax.dynamic_slice_in_dim(
+                    inv_r_local, ctx.offsets[1], ctx.local_shape[1]
+                )
+                if on_axis_local is not None:
+                    on_axis_local = jax.lax.dynamic_slice_in_dim(
+                        on_axis_local, ctx.offsets[1], ctx.local_shape[1]
+                    )
+
+            def operator(u):
+                return axisymmetric_laplacian(
+                    u,
+                    grid.spacing,
+                    inv_r_local,
+                    diffusivity=cfg.diffusivity,
+                    padder=ctx.padder,
+                    on_axis=on_axis_local,
+                )
+
+        else:
+
+            def operator(u):
+                return laplacian(
+                    u,
+                    grid.spacing,
+                    diffusivity=cfg.diffusivity,
+                    order=cfg.order,
+                    padder=ctx.padder,
+                )
+
+        walled_axes = [a for a, b in enumerate(bcs) if b.kind != "periodic"]
+        band = boundary_band_mask(
+            ctx.local_shape, cfg.boundary_band, ctx.global_shape, ctx.offsets,
+            axes=walled_axes,
+        ) if cfg.reference_parity and walled_axes else None
+
+        def rhs(u):
+            lu = operator(u)
+            if cfg.source is not None:
+                lu = lu + cfg.source(u)
+            if band is not None:
+                lu = jnp.where(band, lu, jnp.zeros_like(lu))
+            return lu
+
+        post = None
+        if cfg.reference_parity and walled_axes:
+            dir_axes = [a for a in walled_axes if bcs[a].kind == "dirichlet"]
+            edge_axes = [a for a in walled_axes if bcs[a].kind == "edge"]
+            clamps = [
+                (
+                    face_mask(ctx.local_shape, [a], ctx.global_shape, ctx.offsets),
+                    bcs[a].value,
+                )
+                for a in dir_axes
+            ]
+
+            def post(u):
+                # Dirichlet walls re-imposed each step (heat3d.m:65-67).
+                for faces, value in clamps:
+                    u = jnp.where(faces, jnp.asarray(value, u.dtype), u)
+                # Zero-gradient walls: the frozen band copies the first
+                # evolving row (heat2d_axisymmetric.m:64-66 u(1,:)=u(3,:)).
+                for a in edge_axes:
+                    n_loc, n_glob = ctx.local_shape[a], ctx.global_shape[a]
+                    gidx = jnp.arange(n_loc) + ctx.offsets[a]
+                    tgt = jnp.clip(gidx, cfg.boundary_band,
+                                   n_glob - 1 - cfg.boundary_band)
+                    # local index of the source row, clipped into this shard
+                    lidx = jnp.clip(tgt - ctx.offsets[a], 0, n_loc - 1)
+                    u = jnp.take(u, lidx, axis=a)
+                return u
+
+        return LocalPhysics(rhs=rhs, static_dt=self.dt, post=post)
+
+    # ------------------------------------------------------------------ #
+    # Analytic solution support (heat3d.m:36; heat2d_axisymmetric.m:39)
+    # ------------------------------------------------------------------ #
+    def exact_solution(self, t: float) -> jnp.ndarray:
+        cfg = self.cfg
+        d = cfg.diffusivity
+        r2 = cfg.grid.radius_sq(self.dtype)
+        if cfg.geometry == "axisymmetric":
+            r = cfg.grid.coords(1, self.dtype)
+            amp = (cfg.t0 / t) ** 1.0
+            return (amp * jnp.exp(-(r[None, :] ** 2) / (4.0 * d * t))) * jnp.ones(
+                cfg.grid.shape, self.dtype
+            )
+        power = cfg.grid.ndim / 2.0
+        return ((cfg.t0 / t) ** power * jnp.exp(-r2 / (4.0 * d * t))).astype(
+            self.dtype
+        )
+
+    def error_norms(self, state: SolverState, t: float | None = None):
+        t_val = float(state.t) if t is None else t
+        return metrics.error_norms(
+            state.u, self.exact_solution(t_val), self.cfg.grid.spacing
+        )
+
+    # ------------------------------------------------------------------ #
+    # MATLAB-exact accuracy-test loop (diffusion3dTest.m:43-70)
+    # ------------------------------------------------------------------ #
+    def advance_reference(self, state: SolverState, t_end: float) -> SolverState:
+        """Reproduce the reference test loop *exactly*, including its
+        final-step quirk: the RK update uses the previous dt, and only
+        afterwards is dt trimmed and time advanced
+        (``diffusion3dTest.m:43-70``). Needed to hit the frozen norms in
+        ``TestingAccuracy.log``."""
+        from jax import lax
+
+        def block(u, t):
+            def cond(c):
+                return c[1] < t_end
+
+            def body(c):
+                u, t, dt = c
+                phys = self.build_local(self._context(u))
+                u = self.integrator(phys.rhs, u, dt.astype(u.dtype), phys.post)
+                dt = jnp.where(t + dt > t_end, t_end - t, dt)
+                return (u, t + dt, dt)
+
+            dt0 = jnp.asarray(self.dt, dtype=t.dtype)
+            u, t, _ = lax.while_loop(cond, body, (u, t, dt0))
+            return u, t
+
+        f = self._compiled(("advref", float(t_end)), lambda: self._wrap(block))
+        u, t = f(state.u, state.t)
+        return SolverState(u=u, t=t, it=state.it)

@@ -1,0 +1,26 @@
+"""Solver state carried through jitted time loops."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class SolverState(NamedTuple):
+    """The evolving solution plus simulated time and iteration count.
+
+    A pytree, so it flows through ``jit`` / ``lax`` loops / ``shard_map``
+    unchanged. ``t`` and ``it`` are 0-d arrays (replicated across shards).
+    """
+
+    u: jnp.ndarray
+    t: jnp.ndarray
+    it: jnp.ndarray
+
+    @staticmethod
+    def create(u: jnp.ndarray, t: float = 0.0) -> "SolverState":
+        rdt = jnp.float64 if u.dtype == jnp.float64 else jnp.float32
+        return SolverState(
+            u=u, t=jnp.asarray(t, dtype=rdt), it=jnp.asarray(0, dtype=jnp.int32)
+        )

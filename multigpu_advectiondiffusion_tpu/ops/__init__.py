@@ -1,0 +1,3 @@
+from multigpu_advectiondiffusion_tpu.ops import flux, laplacian, weno, stencils, axisym
+
+__all__ = ["flux", "laplacian", "weno", "stencils", "axisym"]

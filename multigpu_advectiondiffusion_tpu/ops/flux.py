@@ -1,0 +1,63 @@
+"""Scalar flux functions for the hyperbolic solvers.
+
+Mirrors the selectable flux menu of the MATLAB drivers
+(``Matlab_Prototipes/InviscidBurgersNd/LFWENO5FDM3d.m:30-40``):
+linear advection, Burgers ``u^2/2`` (the CUDA kernels' ``Flux``:
+``MultiGPU/Burgers3d_Baseline/Kernels.cu:32-35``), and Buckley–Leverett.
+Each entry provides ``f(u)`` and its wave speed ``f'(u)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Flux:
+    name: str
+    f: Callable[[jnp.ndarray], jnp.ndarray]
+    df: Callable[[jnp.ndarray], jnp.ndarray]
+    cfl_max: float  # author-recommended CFL ceiling (LFWENO5FDM3d.m:31-39)
+
+
+def linear(c: float = -1.0) -> Flux:
+    return Flux(
+        name="linear",
+        f=lambda w: c * w,
+        df=lambda w: jnp.full_like(w, c),
+        cfl_max=0.65,
+    )
+
+
+def burgers() -> Flux:
+    return Flux(
+        name="burgers",
+        f=lambda w: 0.5 * w * w,
+        df=lambda w: w,
+        cfl_max=0.40,
+    )
+
+
+def buckley_leverett() -> Flux:
+    def f(w):
+        return 4.0 * w * w / (4.0 * w * w + (1.0 - w) ** 2)
+
+    def df(w):
+        return 8.0 * w * (1.0 - w) / (5.0 * w * w - 2.0 * w + 1.0) ** 2
+
+    return Flux(name="buckley", f=f, df=df, cfl_max=0.20)
+
+
+def get(name: str, **kwargs) -> Flux:
+    registry = {
+        "linear": linear,
+        "burgers": burgers,
+        "buckley": buckley_leverett,
+        "buckley_leverett": buckley_leverett,
+    }
+    if name not in registry:
+        raise ValueError(f"unknown flux {name!r}; use {sorted(registry)}")
+    return registry[name](**kwargs)

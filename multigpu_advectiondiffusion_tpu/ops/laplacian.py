@@ -1,0 +1,85 @@
+"""Central-difference Laplacians (2nd and 4th order).
+
+TPU-native re-design of the reference's Laplacian kernels:
+
+* 4th-order 13-point 3-D stencil — ``LaplaceO4_async``
+  (``MultiGPU/Diffusion3d_Baseline/Kernels.cu:207-261``) and the MATLAB
+  ground truth ``Matlab_Prototipes/DiffusionNd/Laplace3d.m:22-25``:
+  ``D/(12 dx^2) * (-u[i+2] + 16 u[i+1] - 30 u[i] + 16 u[i-1] - u[i-2])``
+  summed per axis.
+* 2nd-order variants (``LaplaceO2_async``, ``Kernels.cu:152-201``).
+
+Where the CUDA kernels hand-pipeline registers over the z axis, here each
+axis term is a sum of shifted slices of a padded array; XLA fuses the whole
+stencil into one bandwidth-bound loop over HBM tiles (the Pallas variant in
+``ops/pallas`` tiles it explicitly).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu.core.bc import Boundary, pad_axis
+from multigpu_advectiondiffusion_tpu.ops.stencils import Padder, shifted
+
+# order -> (coefficients, halo radius, denominator)
+D2_STENCILS = {
+    2: ((1.0, -2.0, 1.0), 1, 1.0),
+    4: ((-1.0, 16.0, -30.0, 16.0, -1.0), 2, 12.0),
+}
+
+
+def d2_from_padded(
+    up: jnp.ndarray, axis: int, dx: float, order: int = 4
+) -> jnp.ndarray:
+    """Second derivative along ``axis`` of an array padded by the stencil radius."""
+    coefs, r, denom = D2_STENCILS[order]
+    n = up.shape[axis] - 2 * r
+    scale = 1.0 / (denom * dx * dx)
+    acc = None
+    for j, c in enumerate(coefs):
+        term = shifted(up, axis, j, n) * (c * scale)
+        acc = term if acc is None else acc + term
+    return acc
+
+
+def second_derivative(
+    u: jnp.ndarray,
+    axis: int,
+    dx: float,
+    bc: Boundary,
+    order: int = 4,
+) -> jnp.ndarray:
+    _, r, _ = D2_STENCILS[order]
+    return d2_from_padded(pad_axis(u, axis, r, bc), axis, dx, order)
+
+
+def laplacian(
+    u: jnp.ndarray,
+    spacing: Sequence[float],
+    diffusivity: float | Sequence[float] = 1.0,
+    order: int = 4,
+    padder: Padder | None = None,
+    bcs: Sequence[Boundary] | None = None,
+) -> jnp.ndarray:
+    """``sum_axis K_axis * d2u/dx_axis^2`` over all array axes.
+
+    Exactly one of ``padder`` (sharded/explicit halo source) or ``bcs``
+    (single-device BC padding) must be provided.
+    """
+    if (padder is None) == (bcs is None):
+        raise ValueError("provide exactly one of padder/bcs")
+    if padder is None:
+        padder = lambda x, axis, halo: pad_axis(x, axis, halo, bcs[axis])  # noqa: E731
+    if isinstance(diffusivity, (int, float)):
+        diffusivity = [float(diffusivity)] * u.ndim
+    _, r, _ = D2_STENCILS[order]
+    acc = None
+    for axis in range(u.ndim):
+        term = diffusivity[axis] * d2_from_padded(
+            padder(u, axis, r), axis, spacing[axis], order
+        )
+        acc = term if acc is None else acc + term
+    return acc

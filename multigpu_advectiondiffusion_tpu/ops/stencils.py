@@ -1,0 +1,80 @@
+"""Shared slicing helpers for stencil operators.
+
+All operators work on *padded* arrays: the caller supplies a ``padder``
+callable ``padder(u, axis, halo) -> padded`` which is either plain BC
+padding (single device, :func:`core.bc.pad_axis`) or a ``ppermute`` halo
+exchange (sharded, :mod:`parallel.halo`). This is the TPU-native analog of
+the reference's ghost-cell machinery
+(``MultiGPU/Diffusion3d_Baseline/Kernels.cu:32-99`` pack/unpack kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# padder(u, axis, halo) -> u padded with `halo` ghost cells on both ends.
+Padder = Callable[[jnp.ndarray, int, int], jnp.ndarray]
+
+
+def slice_axis(a: jnp.ndarray, axis: int, start: int, stop: int) -> jnp.ndarray:
+    """Static slice ``a[..., start:stop, ...]`` along one axis."""
+    return jax.lax.slice_in_dim(a, start, stop, axis=axis)
+
+
+def shifted(a_padded: jnp.ndarray, axis: int, offset: int, length: int) -> jnp.ndarray:
+    """View of length ``length`` at ``offset`` into the padded axis."""
+    return jax.lax.slice_in_dim(a_padded, offset, offset + length, axis=axis)
+
+
+def boundary_band_mask(
+    shape: Sequence[int],
+    band: int,
+    global_shape: Sequence[int] | None = None,
+    offsets: Sequence[jnp.ndarray | int] | None = None,
+    axes: Sequence[int] | None = None,
+) -> jnp.ndarray:
+    """Boolean mask, True on cells >= ``band`` away from every global face.
+
+    Mirrors the reference Laplacian's interior guard
+    (``Matlab_Prototipes/DiffusionNd/Laplace3d.m:21``: cells within ``band=2``
+    of a wall get ``Lu = 0``). ``offsets``/``global_shape`` let a shard build
+    the mask in its local window (offset = shard_index * local_n). ``axes``
+    restricts the guard to walled axes (periodic axes have no walls).
+    """
+    ndim = len(shape)
+    if global_shape is None:
+        global_shape = shape
+    if offsets is None:
+        offsets = [0] * ndim
+    if axes is None:
+        axes = range(ndim)
+    mask = jnp.ones(tuple(shape), dtype=bool)
+    for axis in axes:
+        idx = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis) + offsets[axis]
+        mask = mask & (idx >= band) & (idx < global_shape[axis] - band)
+    return mask
+
+
+def face_mask(
+    shape: Sequence[int],
+    axes: Sequence[int],
+    global_shape: Sequence[int] | None = None,
+    offsets: Sequence[jnp.ndarray | int] | None = None,
+) -> jnp.ndarray:
+    """True on cells lying on a global face of any of the given axes.
+
+    Mirrors the MATLAB Dirichlet clamp (``heat3d.m:65-67``).
+    """
+    ndim = len(shape)
+    if global_shape is None:
+        global_shape = shape
+    if offsets is None:
+        offsets = [0] * ndim
+    mask = jnp.zeros(tuple(shape), dtype=bool)
+    for axis in axes:
+        idx = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis) + offsets[axis]
+        mask = mask | (idx == 0) | (idx == global_shape[axis] - 1)
+    return mask

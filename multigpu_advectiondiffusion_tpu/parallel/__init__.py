@@ -1,0 +1,14 @@
+from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+    Decomposition,
+    make_mesh,
+    shard_map,
+)
+from multigpu_advectiondiffusion_tpu.parallel.halo import exchange_axis, make_padder
+
+__all__ = [
+    "Decomposition",
+    "make_mesh",
+    "shard_map",
+    "exchange_axis",
+    "make_padder",
+]

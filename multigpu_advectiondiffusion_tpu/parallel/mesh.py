@@ -1,0 +1,99 @@
+"""Device meshes and domain decompositions.
+
+The reference decomposes 1-D slabs along the last axis only, one MPI rank
+per GPU (``MultiGPU/Diffusion3d_Baseline/main.c:69``,
+``Util.cu:66-74`` ``AssignDevices``). Here a decomposition is a mapping
+from grid axes to named ``jax.sharding.Mesh`` axes — 1-D slabs, 2-D pencils
+or full 3-D blocks — and device placement is XLA's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+try:  # jax >= 0.4.35 promoted shard_map out of experimental
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def make_mesh(
+    axis_sizes: Dict[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a mesh, e.g. ``make_mesh({'dz': 4, 'dy': 2})``.
+
+    Axis order follows dict order; total size must divide the device count
+    (or equal it when ``devices`` is None).
+    """
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[n] for n in names)
+    if devices is None:
+        devices = jax.devices()
+    need = math.prod(sizes)
+    if need > len(devices):
+        raise ValueError(f"mesh needs {need} devices, only {len(devices)} available")
+    return jax.make_mesh(sizes, names, devices=tuple(devices[:need]))
+
+
+@dataclasses.dataclass(frozen=True)
+class Decomposition:
+    """Maps array axes of the grid to mesh axes.
+
+    ``axes[array_axis] = mesh_axis_name`` (axes not present are unsharded).
+    The reference's slab split is ``Decomposition.slab(ndim)``: last-array-
+    axis... i.e. z in 3-D, matching ``_Nz = Nz/np`` (``main.c:69``) — note
+    the reference splits the *z* axis, which in this framework's
+    ``(z, y, x)`` array order is axis 0.
+    """
+
+    axes: Tuple[Tuple[int, str], ...]
+
+    @staticmethod
+    def of(mapping: Dict[int, str]) -> "Decomposition":
+        return Decomposition(tuple(sorted(mapping.items())))
+
+    @staticmethod
+    def slab(mesh_axis: str = "dz") -> "Decomposition":
+        """Reference-style 1-D slab decomposition along z (array axis 0)."""
+        return Decomposition.of({0: mesh_axis})
+
+    @property
+    def mapping(self) -> Dict[int, str]:
+        return dict(self.axes)
+
+    def mesh_axis(self, array_axis: int) -> Optional[str]:
+        return self.mapping.get(array_axis)
+
+    def mesh_axis_names(self) -> Tuple[str, ...]:
+        return tuple(name for _, name in self.axes)
+
+    def partition_spec(self, ndim: int) -> PartitionSpec:
+        return PartitionSpec(*[self.mapping.get(ax) for ax in range(ndim)])
+
+    def sharding(self, mesh: Mesh, ndim: int) -> NamedSharding:
+        return NamedSharding(mesh, self.partition_spec(ndim))
+
+    def validate(self, mesh: Mesh, global_shape: Sequence[int]) -> None:
+        """Startup topology assertions (the reference's ``MPIDeviceCheck``
+        analog, ``Util.cu:43-61``) — every sharded axis must divide evenly
+        and leave at least one stencil-halo worth of cells per shard."""
+        for ax, name in self.axes:
+            if name not in mesh.shape:
+                raise ValueError(f"mesh has no axis {name!r}")
+            parts = mesh.shape[name]
+            if global_shape[ax] % parts:
+                raise ValueError(
+                    f"axis {ax} size {global_shape[ax]} not divisible by "
+                    f"mesh axis {name!r} ({parts} shards)"
+                )
+
+    def local_shape(self, mesh: Mesh, global_shape: Sequence[int]) -> Tuple[int, ...]:
+        out = list(global_shape)
+        for ax, name in self.axes:
+            out[ax] //= mesh.shape[name]
+        return tuple(out)

@@ -1,0 +1,9 @@
+from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+    INTEGRATORS,
+    euler,
+    ssp_rk2,
+    ssp_rk3,
+)
+from multigpu_advectiondiffusion_tpu.timestepping import cfl
+
+__all__ = ["INTEGRATORS", "euler", "ssp_rk2", "ssp_rk3", "cfl"]

@@ -1,0 +1,43 @@
+"""Time-step selection.
+
+* Diffusive stability bound — ``dt = 1/(2 K sum_i 1/dx_i^2) * safety``
+  (``MultiGPU/Diffusion3d_Baseline/main.c:64``, ``heat3d.m:39``).
+* Advective CFL — ``dt = CFL * dx / max|f'(u)|``
+  (``LFWENO5FDM3d.m:71``). The CUDA ports hard-code ``max|u| = 1``
+  (``MultiGPU/Burgers3d_Baseline/main.c:193``) — a known defect; here the
+  global wave-speed reduction is restored and, in the sharded step, runs as
+  a ``lax.pmax`` over the device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+
+def diffusive_dt(diffusivity: float, spacing: Sequence[float], safety: float = 0.8):
+    inv = sum(1.0 / (dx * dx) for dx in spacing)
+    return safety / (2.0 * diffusivity * inv)
+
+
+def max_wave_speed(
+    u: jnp.ndarray,
+    dflux: Callable[[jnp.ndarray], jnp.ndarray],
+    reduce_max: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+) -> jnp.ndarray:
+    """Global ``max |f'(u)|``; ``reduce_max`` adds the cross-device pmax."""
+    local = jnp.max(jnp.abs(dflux(u)))
+    return reduce_max(local) if reduce_max is not None else local
+
+
+def advective_dt(
+    u: jnp.ndarray,
+    dflux,
+    spacing: Sequence[float],
+    cfl: float,
+    reduce_max=None,
+    floor: float = 1e-12,
+):
+    a = max_wave_speed(u, dflux, reduce_max)
+    return cfl * min(spacing) / jnp.maximum(a, floor)

@@ -1,0 +1,3 @@
+from multigpu_advectiondiffusion_tpu.utils import ic, io, metrics
+
+__all__ = ["ic", "io", "metrics"]

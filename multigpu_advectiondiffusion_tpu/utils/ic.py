@@ -1,0 +1,207 @@
+"""Initial-condition library.
+
+Union of the reference's IC sources:
+
+* ``Init_domain`` cases {1: square jump, 2: zeros, 3: Gaussian}
+  (``MultiGPU/Diffusion3d_Baseline/Tools.c:124-175``) with
+  ``GAUSSIAN_DISTRIBUTION(x,y,z) = exp(-(x²+y²+z²)/0.1)``
+  (``DiffusionMPICUDA.h:58``);
+* the 2-D spherical discontinuity (``MultiGPU/Diffusion2d_Baseline/Tools.c``);
+* the 10-case 1-D menu of ``Matlab_Prototipes/InviscidBurgersNd/CommonIC.m``;
+* the analytic heat-kernel Gaussian used by the accuracy tests
+  (``heat3d.m:33``: ``exp(-r²/(4 D t0))``).
+
+All ICs are functions of a :class:`Grid` returning an array of the grid's
+shape; 1-D profiles broadcast along x when applied to 2-D/3-D grids.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+
+
+def _x_profile(grid: Grid, dtype, fn) -> jnp.ndarray:
+    """Apply a 1-D profile of x and broadcast over the remaining axes."""
+    x = grid.coords(grid.ndim - 1, dtype)
+    u = fn(x)
+    return jnp.broadcast_to(u, grid.shape)
+
+
+def _x_span(grid: Grid):
+    lo, hi = grid.bounds[grid.ndim - 1]
+    return lo, hi, hi - lo, 0.5 * (lo + hi)
+
+
+def rectangular_pulse(a: float, b: float, x: jnp.ndarray) -> jnp.ndarray:
+    """MATLAB ``rectangularPulse``: 1 inside (a,b), 1/2 at the edges."""
+    inside = ((x > a) & (x < b)).astype(x.dtype)
+    edge = ((x == a) | (x == b)).astype(x.dtype)
+    return inside + 0.5 * edge
+
+
+# ---------------------------------------------------------------------- #
+# N-dimensional ICs
+# ---------------------------------------------------------------------- #
+def gaussian(grid: Grid, dtype=jnp.float32, amplitude=1.0, width=0.1):
+    """``amp * exp(-r²/width)`` — DiffusionMPICUDA.h:58 and LFWENO5FDM3d.m:58."""
+    return (amplitude * jnp.exp(-grid.radius_sq(dtype) / width)).astype(dtype)
+
+
+def heat_kernel(grid: Grid, dtype=jnp.float32, t0=0.1, diffusivity=1.0):
+    """Gaussian that solves the heat equation exactly (heat3d.m:33)."""
+    return jnp.exp(-grid.radius_sq(dtype) / (4.0 * diffusivity * t0)).astype(dtype)
+
+
+def heat_kernel_radial(grid: Grid, dtype=jnp.float32, t0=1.0, diffusivity=1.0):
+    """Radial Gaussian ``exp(-r^2/(4 D t0))`` over the innermost (r) axis —
+    the axisymmetric IC/exact-solution pair (heat2d_axisymmetric.m:11-14,
+    uncentered r coordinate)."""
+    r = grid.coords(grid.ndim - 1, dtype)
+    u = jnp.exp(-(r * r) / (4.0 * diffusivity * t0))
+    return jnp.broadcast_to(u, grid.shape).astype(dtype)
+
+
+def square_jump(grid: Grid, dtype=jnp.float32, inside=1.0, outside=0.0):
+    """Index-based central box jump (Init_domain case 1, Tools.c:129-144)."""
+    u = jnp.full(grid.shape, outside, dtype=dtype)
+    mask = None
+    for ax, n in enumerate(grid.shape):
+        idx = jnp.arange(n)
+        m = (idx >= n // 4) & (idx < 3 * n // 4)
+        shp = [1] * grid.ndim
+        shp[ax] = n
+        m = jnp.reshape(m, shp)
+        mask = m if mask is None else (mask & m)
+    return jnp.where(mask, jnp.asarray(inside, dtype), u)
+
+
+def zeros(grid: Grid, dtype=jnp.float32):
+    return jnp.zeros(grid.shape, dtype=dtype)
+
+
+def spherical_jump(grid: Grid, dtype=jnp.float32, radius=0.2, inside=1.0, outside=0.0):
+    """Discontinuity at ``r < radius`` (MultiGPU/Diffusion2d_Baseline/Tools.c IC 3)."""
+    r2 = grid.radius_sq(dtype)
+    return jnp.where(r2 < radius * radius, inside, outside).astype(dtype)
+
+
+# ---------------------------------------------------------------------- #
+# CommonIC.m 1-D menu (broadcast along x for higher dims)
+# ---------------------------------------------------------------------- #
+def gaussian_advection(grid: Grid, dtype=jnp.float32):
+    _, _, _, xmid = _x_span(grid)
+    return _x_profile(grid, dtype, lambda x: jnp.exp(-20.0 * (x - xmid) ** 2))
+
+
+def gaussian_diffusion(grid: Grid, dtype=jnp.float32, mu=0.01):
+    _, _, _, xmid = _x_span(grid)
+    return _x_profile(grid, dtype, lambda x: jnp.exp(-((x - xmid) ** 2) / (4 * mu)))
+
+
+def sine(grid: Grid, dtype=jnp.float32):
+    return _x_profile(grid, dtype, lambda x: jnp.sin(jnp.pi * x))
+
+
+def lifted_sine(grid: Grid, dtype=jnp.float32):
+    return _x_profile(grid, dtype, lambda x: 0.5 - jnp.sin(jnp.pi * x))
+
+
+def tanh_viscous(grid: Grid, dtype=jnp.float32, mu=0.02):
+    return _x_profile(grid, dtype, lambda x: 0.5 * (1.0 - jnp.tanh(x / (4 * mu))))
+
+
+def riemann(grid: Grid, dtype=jnp.float32, left=2.0, right=1.0):
+    _, _, _, xmid = _x_span(grid)
+    return _x_profile(
+        grid, dtype, lambda x: jnp.where(x <= xmid, left, right).astype(dtype)
+    )
+
+
+def tanh_profile(grid: Grid, dtype=jnp.float32):
+    a, b, _, _ = _x_span(grid)
+
+    def fn(x):
+        xi = 8.0 / (b - a) * (x - a) - 4.0
+        return 0.5 * (jnp.tanh(-4.0 * xi) + 1.0)
+
+    return _x_profile(grid, dtype, fn)
+
+
+def square_jump_1d(grid: Grid, dtype=jnp.float32):
+    _, _, Lx, xmid = _x_span(grid)
+    return _x_profile(
+        grid,
+        dtype,
+        lambda x: rectangular_pulse(xmid - 0.1 * Lx, xmid + 0.1 * Lx, x) + 1.0,
+    )
+
+
+def displaced_square_jump(grid: Grid, dtype=jnp.float32):
+    _, _, Lx, _ = _x_span(grid)
+    xmid = -0.25  # CommonIC.m:63 overrides the midpoint
+    return _x_profile(
+        grid,
+        dtype,
+        lambda x: rectangular_pulse(xmid - 0.125 * Lx, xmid + 0.125 * Lx, x) + 1.0,
+    )
+
+
+def trapezoidal(grid: Grid, dtype=jnp.float32):
+    """Oleg's trapezoidal (CommonIC.m:67)."""
+    _, _, Lx, xmid = _x_span(grid)
+    return _x_profile(
+        grid,
+        dtype,
+        lambda x: jnp.exp(-x)
+        * rectangular_pulse(xmid - 0.1 * Lx, xmid + 0.1 * Lx, x)
+        * jnp.exp(0.1),
+    )
+
+
+REGISTRY: Dict[str, Callable] = {
+    "gaussian": gaussian,
+    "heat_kernel": heat_kernel,
+    "heat_kernel_radial": heat_kernel_radial,
+    "square_jump": square_jump,
+    "zeros": zeros,
+    "spherical_jump": spherical_jump,
+    "gaussian_advection": gaussian_advection,
+    "gaussian_diffusion": gaussian_diffusion,
+    "sine": sine,
+    "lifted_sine": lifted_sine,
+    "tanh_viscous": tanh_viscous,
+    "riemann": riemann,
+    "tanh": tanh_profile,
+    "square_jump_1d": square_jump_1d,
+    "displaced_square_jump": displaced_square_jump,
+    "trapezoidal": trapezoidal,
+}
+
+# CommonIC.m case-number aliases (1..10)
+COMMON_IC_CASES = {
+    1: "gaussian_advection",
+    2: "gaussian_diffusion",
+    3: "sine",
+    4: "lifted_sine",
+    5: "tanh_viscous",
+    6: "riemann",
+    7: "tanh",
+    8: "square_jump_1d",
+    9: "displaced_square_jump",
+    10: "trapezoidal",
+}
+
+
+def initial_condition(name, grid: Grid, dtype=jnp.float32, **params) -> jnp.ndarray:
+    """Look up an IC by name (or CommonIC case number) and evaluate it."""
+    if isinstance(name, int):
+        name = COMMON_IC_CASES[name]
+    if callable(name):
+        return jnp.asarray(name(grid, dtype, **params), dtype=dtype)
+    if name not in REGISTRY:
+        raise ValueError(f"unknown IC {name!r}; available: {sorted(REGISTRY)}")
+    return REGISTRY[name](grid, dtype=dtype, **params)

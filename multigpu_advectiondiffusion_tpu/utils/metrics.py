@@ -1,0 +1,59 @@
+"""Error norms and performance metrics.
+
+* Error norms mirror the MATLAB post-processing
+  (``heat3d.m:106-109``): ``L1 = prod(dx) * sum|e|``,
+  ``L2 = sqrt(prod(dx) * sum e^2)``, ``Linf = max|e|``.
+* ``CalcGflops`` is the reference's derived cell-update-rate metric
+  (``MultiGPU/Diffusion3d_Baseline/Tools.c:247-250``:
+  ``3 * iters * nx*ny*nz * FLOPS * 1e-9 / t`` with ``FLOPS = 8``).
+  MLUPS (= million lattice updates / s) is the hardware-neutral version
+  used for TPU-vs-GPU comparison (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+
+REFERENCE_FLOPS_PER_CELL = 8.0  # DiffusionMPICUDA.h:52
+
+
+@dataclasses.dataclass(frozen=True)
+class ErrorNorms:
+    l1: float
+    l2: float
+    linf: float
+
+    def __iter__(self):
+        return iter((self.l1, self.l2, self.linf))
+
+
+def error_norms(u, u_exact, spacing: Sequence[float]) -> ErrorNorms:
+    vol = math.prod(spacing)
+    err = jnp.abs(jnp.asarray(u, jnp.float64 if u.dtype == jnp.float64 else jnp.float32)
+                  - u_exact)
+    l1 = vol * jnp.sum(err)
+    l2 = jnp.sqrt(vol * jnp.sum(err * err))
+    linf = jnp.max(err)
+    return ErrorNorms(float(l1), float(l2), float(linf))
+
+
+def mlups(num_cells: int, iters: int, stages: int, seconds: float) -> float:
+    """Million lattice (cell) updates per second, counting RK stages."""
+    return num_cells * iters * stages / seconds / 1e6
+
+
+def gflops_reference_convention(
+    num_cells: int, iters: int, seconds: float, stages: int = 3
+) -> float:
+    """The reference's ``CalcGflops`` (Tools.c:247-250)."""
+    return stages * iters * num_cells * REFERENCE_FLOPS_PER_CELL * 1e-9 / seconds
+
+
+def observed_order(coarse_norm: float, fine_norm: float, ratio: float = 2.0) -> float:
+    """Order of accuracy between two refinement levels
+    (``TestingAccuracy.m:43-47``)."""
+    return math.log(coarse_norm / fine_norm) / math.log(ratio)

@@ -1,0 +1,36 @@
+"""Test configuration: CPU backend with 8 virtual devices.
+
+The reference cannot test multi-GPU without a physical cluster
+(``MPIDeviceCheck`` exits with < 2 GPUs, ``Util.cu:43-61``). Here the
+distributed runtime is validated on a simulated 8-device CPU mesh
+(SURVEY §4 implication (c)). Env vars must be set before jax imports.
+"""
+
+import os
+
+# Force-override: the ambient environment may pin jax to a real TPU (e.g.
+# an axon tunnel whose sitecustomize calls
+# jax.config.update('jax_platforms', 'axon,cpu') at interpreter startup,
+# trumping the JAX_PLATFORMS env var). The test suite always runs on
+# virtual CPU devices so sharding is exercised without hardware — so both
+# the env var AND the config entry must be forced before backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

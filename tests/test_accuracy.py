@@ -1,0 +1,150 @@
+"""Analytic-solution accuracy tests — the port of the reference's real
+test suite (SURVEY §4: ``Matlab_Prototipes/DiffusionNd/TestingAccuracy.m``,
+``diffusion{1,2,3}dTest.m``), plus IC/exact-solution consistency checks.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multigpu_advectiondiffusion_tpu import (
+    BurgersConfig,
+    BurgersSolver,
+    DiffusionConfig,
+    DiffusionSolver,
+    Grid,
+)
+from multigpu_advectiondiffusion_tpu.utils.metrics import observed_order
+
+
+# --------------------------------------------------------------------- #
+# IC <-> exact-solution consistency (must hold for ANY config params)
+# --------------------------------------------------------------------- #
+def test_ic_matches_exact_at_t0_nondefault_params():
+    grid = Grid.make(33, 33, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, diffusivity=0.27, t0=1.0, dtype="float64")
+    solver = DiffusionSolver(cfg)
+    state = solver.initial_state()
+    norms = solver.error_norms(state, t=cfg.t0)
+    assert norms.linf < 1e-12
+
+
+def _axisym_config(n, diffusivity=0.27):
+    """The reference's setup (heat2d_axisymmetric.m:20-43): r spans the full
+    diameter through the axis, Dirichlet-0 at the far-field r faces,
+    zero-gradient on y; IC/exact pair exp(-r^2/(4 D t)) scaled by t0/t."""
+    grid = Grid.make(n, n, bounds=[(-5.0, 5.0), (-5.0, 5.0)])
+    return DiffusionConfig(
+        grid=grid,
+        geometry="axisymmetric",
+        diffusivity=diffusivity,
+        t0=1.0,
+        bc=("edge", "dirichlet"),  # (y, r) array order
+        dtype="float64",
+    )
+
+
+def test_axisymmetric_ic_matches_exact_at_t0():
+    cfg = _axisym_config(33)
+    solver = DiffusionSolver(cfg)
+    norms = solver.error_norms(solver.initial_state(), t=cfg.t0)
+    assert norms.linf < 1e-12
+
+
+# --------------------------------------------------------------------- #
+# Grid-refinement convergence (TestingAccuracy.m:30-47)
+# --------------------------------------------------------------------- #
+def _diffusion_error(n, ndim, t_end=0.2):
+    sizes = (n,) * ndim
+    grid = Grid.make(*sizes, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float64")
+    solver = DiffusionSolver(cfg)
+    out = solver.advance_to(solver.initial_state(), t_end)
+    return solver.error_norms(out, t=t_end).l1
+
+
+@pytest.mark.parametrize("ndim", [1, 2, 3])
+def test_diffusion_convergence_order(ndim):
+    """Observed order of accuracy under 2x refinement. The scheme is
+    formally 4th-order in space / 3rd-order in time; with the
+    reference-parity boundary-band clamp the MATLAB study observes
+    ~3.8-3.9 (TestingAccuracy.log). Require >= 2.5 as the gate."""
+    ns = {1: (33, 65, 129), 2: (17, 33, 65), 3: (9, 17, 33)}[ndim]
+    errs = [_diffusion_error(n, ndim) for n in ns]
+    orders = [observed_order(errs[i], errs[i + 1]) for i in range(len(errs) - 1)]
+    assert errs[0] > errs[-1], f"no error reduction: {errs}"
+    assert max(orders) > 2.5, f"orders {orders} from errors {errs}"
+
+
+def test_axisymmetric_convergence():
+    errs = []
+    for n in (33, 65):
+        solver = DiffusionSolver(_axisym_config(n))
+        out = solver.advance_to(solver.initial_state(), 1.5)
+        errs.append(solver.error_norms(out, t=1.5).l1)
+    assert errs[1] < errs[0] / 4, f"axisymmetric not converging: {errs}"
+
+
+# --------------------------------------------------------------------- #
+# WENO linear-advection exactness checks
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("order", [5, 7])
+def test_weno_advects_periodic_gaussian(order):
+    """Linear flux, periodic BC: after one period the profile returns.
+    WENO5/7 on a smooth profile should give small L_inf error."""
+    n = 128
+    grid = Grid.make_periodic(n, lengths=1.0)
+    # period: domain length 1, speed -1 -> t=1 is one full revolution
+    cfg = BurgersConfig(
+        grid=grid,
+        flux="linear",
+        weno_order=order,
+        bc="periodic",
+        cfl=0.4,
+        ic="gaussian_advection",
+        dtype="float64",
+    )
+    solver = BurgersSolver(cfg)
+    state = solver.initial_state()
+    u0 = np.asarray(state.u)
+    out = solver.advance_to(state, 1.0)
+    err = float(jnp.max(jnp.abs(out.u - state.u)))
+    assert err < 2e-3, f"WENO{order} advection error {err}"
+    # and the solution actually moved during the run (t advanced)
+    assert abs(float(out.t) - 1.0) < 1e-9
+
+
+def test_weno5_z_sharper_than_js_on_discontinuity():
+    """WENO5-Z is designed to lose less resolution at discontinuities
+    (SingleGPU _SharedMem variant's motivation). Sanity-check the two
+    variants differ and both remain bounded on a square jump."""
+    n = 129
+    grid = Grid.make_periodic(n, lengths=1.0)
+    outs = {}
+    for variant in ("js", "z"):
+        cfg = BurgersConfig(
+            grid=grid, flux="linear", weno_variant=variant, bc="periodic",
+            ic="square_jump_1d", dtype="float64",
+        )
+        solver = BurgersSolver(cfg)
+        outs[variant] = np.asarray(solver.advance_to(solver.initial_state(), 0.2).u)
+    assert not np.array_equal(outs["js"], outs["z"])
+    for v, u in outs.items():
+        assert np.isfinite(u).all()
+        assert u.max() < 2.3 and u.min() > 0.7, f"{v} lost boundedness"
+
+
+def test_burgers_shock_total_variation_bounded():
+    """SSP-RK3 + WENO on Burgers with a smooth IC steepening to a shock:
+    total variation must not blow up (TVB sanity, LFWENO5FDM1d.m setup)."""
+    grid = Grid.make_periodic(201, lengths=2.0, origin=-1.0)
+    cfg = BurgersConfig(grid=grid, flux="burgers", ic="sine", bc="periodic",
+                        dtype="float64")
+    solver = BurgersSolver(cfg)
+    state = solver.initial_state()
+    tv0 = float(jnp.sum(jnp.abs(jnp.diff(state.u))))
+    out = solver.advance_to(state, 0.5)  # shock forms at t = 1/pi
+    tv1 = float(jnp.sum(jnp.abs(jnp.diff(out.u))))
+    assert tv1 < tv0 * 1.05, f"total variation grew: {tv0} -> {tv1}"

@@ -1,0 +1,102 @@
+"""Distributed-runtime tests on the simulated 8-device CPU mesh.
+
+The key property the reference could never test (SURVEY §4: no fake
+backend, ``MPIDeviceCheck`` exits without >= 2 physical GPUs): a sharded
+run must be **bit-identical** to the unsharded run — the halo exchange
+(``lax.ppermute``), global-edge BC fix-up, and ``pmax`` CFL reduction may
+not change a single ulp.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multigpu_advectiondiffusion_tpu import (
+    BurgersConfig,
+    BurgersSolver,
+    DiffusionConfig,
+    DiffusionSolver,
+    Grid,
+)
+from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition, make_mesh
+
+
+def _max_abs_diff(a, b):
+    return float(jnp.max(jnp.abs(a - b)))
+
+
+@pytest.mark.parametrize(
+    "mesh_axes,decomp_map",
+    [
+        ({"dz": 4}, {0: "dz"}),  # reference-style slab over z
+        ({"dz": 2, "dy": 2}, {0: "dz", 1: "dy"}),  # 2-D pencils
+        ({"dz": 2, "dy": 2, "dx": 2}, {0: "dz", 1: "dy", 2: "dx"}),  # 3-D blocks
+    ],
+)
+def test_diffusion3d_sharded_bit_identical(devices, mesh_axes, decomp_map):
+    grid = Grid.make(24, 24, 24, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float64")
+    mesh = make_mesh(mesh_axes)
+    ref = DiffusionSolver(cfg).run(DiffusionSolver(cfg).initial_state(), 10)
+    solver = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.of(decomp_map))
+    out = solver.run(solver.initial_state(), 10)
+    assert _max_abs_diff(ref.u, out.u) == 0.0
+
+
+@pytest.mark.parametrize("variant", ["js", "z"])
+def test_burgers3d_sharded_bit_identical(devices, variant):
+    """Adaptive dt: the global max|u| reduction must also agree (pmax)."""
+    grid = Grid.make(16, 16, 16, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, weno_variant=variant, nu=1e-5, dtype="float64")
+    mesh = make_mesh({"dz": 2, "dy": 2})
+    ref = BurgersSolver(cfg).run(BurgersSolver(cfg).initial_state(), 5)
+    solver = BurgersSolver(
+        cfg, mesh=mesh, decomp=Decomposition.of({0: "dz", 1: "dy"})
+    )
+    out = solver.run(solver.initial_state(), 5)
+    assert _max_abs_diff(ref.u, out.u) == 0.0
+    assert float(ref.t) == float(out.t)
+
+
+def test_burgers2d_sharded_innermost_axis(devices):
+    """Sharding the x (innermost/lane) axis exercises the awkward sweep."""
+    grid = Grid.make(32, 32, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, dtype="float64")
+    mesh = make_mesh({"dx": 4})
+    ref = BurgersSolver(cfg).run(BurgersSolver(cfg).initial_state(), 5)
+    solver = BurgersSolver(cfg, mesh=mesh, decomp=Decomposition.of({1: "dx"}))
+    out = solver.run(solver.initial_state(), 5)
+    assert _max_abs_diff(ref.u, out.u) == 0.0
+
+
+def test_periodic_sharded(devices):
+    grid = Grid.make(32, 32, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, bc="periodic", dtype="float64")
+    mesh = make_mesh({"dy": 4})
+    ref = BurgersSolver(cfg).run(BurgersSolver(cfg).initial_state(), 5)
+    solver = BurgersSolver(cfg, mesh=mesh, decomp=Decomposition.of({0: "dy"}))
+    out = solver.run(solver.initial_state(), 5)
+    assert _max_abs_diff(ref.u, out.u) == 0.0
+
+
+def test_sharded_output_sharding_preserved(devices):
+    grid = Grid.make(24, 24, 24, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32")
+    mesh = make_mesh({"dz": 8})
+    solver = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.slab("dz"))
+    out = solver.run(solver.initial_state(), 3)
+    assert out.u.sharding.is_equivalent_to(solver.sharding(), grid.ndim)
+
+
+def test_axisymmetric_sharded_r_axis(devices):
+    """Sharding r exercises the 1/r local-window slice (diffusion.py)."""
+    grid = Grid.make(32, 32, bounds=[(-4.0, 4.0), (-4.0, 4.0)])
+    cfg = DiffusionConfig(
+        grid=grid, geometry="axisymmetric", diffusivity=0.5, t0=1.0,
+        bc=("edge", "dirichlet"), dtype="float64",
+    )
+    mesh = make_mesh({"dr": 4})
+    ref = DiffusionSolver(cfg).run(DiffusionSolver(cfg).initial_state(), 5)
+    solver = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.of({1: "dr"}))
+    out = solver.run(solver.initial_state(), 5)
+    assert _max_abs_diff(ref.u, out.u) == 0.0
