@@ -1,0 +1,4 @@
+from multigpu_advectiondiffusion_tpu.bench.matrix import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""Benchmark matrix — drivers mirroring the reference's published configs.
+
+Each entry reproduces one row of BASELINE.md (the author's archived
+``Run.m`` numbers) with the same grid/iteration workload, and records the
+TPU result next to the reference GFLOPS/MLUPS. Replaces the reference's
+pitched/texture/shared *memory* variants (no TPU meaning) with the
+framework's kernel-strategy axis: pure-XLA vs Pallas (``impl`` field).
+
+Run:  python -m multigpu_advectiondiffusion_tpu.bench [--name X] [--quick]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional, Tuple
+
+BASELINES_MLUPS = {
+    # name -> (reference MLUPS, reference source)
+    "diffusion2d": (972.8, "SingleGPU/Diffusion2d_PitchedMem/Run.m:3-12"),
+    "diffusion3d": (927.3, "SingleGPU/Diffusion3d_Blocking/Run.m:3-12"),
+    "diffusion3d_multigpu": (731.0, "MultiGPU/Diffusion3d_Baseline/Run.m:4-13"),
+    "burgers3d_512": (879.8, "SingleGPU/Burgers3d_WENO5/Run.m:15-25"),
+    "burgers2d_multigpu": (15.5, "MultiGPU/Burgers2d_Baseline/Run.m:4-14"),
+    "burgers3d_multigpu": (37.9, "MultiGPU/Burgers3d_Baseline/Run.m:4-14"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchCase:
+    name: str
+    kind: str  # diffusion | burgers
+    grid_xyz: Tuple[int, ...]
+    iters: int
+    quick_scale: int = 4  # divide grid/iters by this in --quick mode
+    weno_order: int = 5
+    fixed_dt: bool = True  # reference parity: CUDA drivers fix dt
+
+
+CASES = [
+    # reference grids rounded to TPU-friendly multiples where needed
+    BenchCase("diffusion2d", "diffusion", (1024, 1024), 1000),
+    BenchCase("diffusion3d", "diffusion", (208, 200, 200), 605),
+    BenchCase("diffusion3d_multigpu", "diffusion", (400, 200, 208), 101),
+    BenchCase("burgers3d_512", "burgers", (512, 512, 512), 86),
+    BenchCase("burgers2d_multigpu", "burgers", (400, 408), 200),
+    BenchCase("burgers3d_multigpu", "burgers", (400, 400, 408), 267),
+]
+
+
+def build_solver(case: BenchCase, dtype: str, grid_xyz, mesh_spec: Optional[str]):
+    from multigpu_advectiondiffusion_tpu.cli.drivers import (
+        decomposition_for,
+        parse_mesh_spec,
+    )
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+    from multigpu_advectiondiffusion_tpu.models.burgers import (
+        BurgersConfig,
+        BurgersSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionConfig,
+        DiffusionSolver,
+    )
+
+    grid = Grid.make(*grid_xyz, lengths=[10.0] * len(grid_xyz))
+    mesh, sizes = parse_mesh_spec(mesh_spec)
+    decomp = decomposition_for(grid, sizes)
+    if case.kind == "diffusion":
+        cfg = DiffusionConfig(grid=grid, diffusivity=1.0, dtype=dtype)
+        return DiffusionSolver(cfg, mesh=mesh, decomp=decomp)
+    cfg = BurgersConfig(
+        grid=grid,
+        weno_order=case.weno_order,
+        cfl=0.4,
+        adaptive_dt=not case.fixed_dt,
+        dtype=dtype,
+        ic="gaussian",
+    )
+    return BurgersSolver(cfg, mesh=mesh, decomp=decomp)
+
+
+def run_case(
+    case: BenchCase,
+    dtype: str = "float32",
+    quick: bool = False,
+    mesh_spec: Optional[str] = None,
+    repeats: int = 3,
+) -> dict:
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    grid_xyz = case.grid_xyz
+    iters = case.iters
+    if quick:
+        grid_xyz = tuple(max(16, g // case.quick_scale) for g in grid_xyz)
+        iters = max(3, iters // case.quick_scale)
+
+    solver = build_solver(case, dtype, grid_xyz, mesh_spec)
+    state = solver.initial_state()
+
+    t0 = time.perf_counter()
+    out = solver.run(state, 1)
+    out.u.block_until_ready()
+    compile_s = time.perf_counter() - t0
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = solver.run(state, iters)
+        out.u.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+
+    cells = 1
+    for g in grid_xyz:
+        cells *= g
+    rate = mlups(cells, iters, STAGES[solver.cfg.integrator], best)
+    base, src = BASELINES_MLUPS.get(case.name, (None, None))
+    result = {
+        "name": case.name,
+        "grid": "x".join(map(str, grid_xyz)),
+        "iters": iters,
+        "dtype": dtype,
+        "seconds": round(best, 4),
+        "compile_seconds": round(compile_s, 3),
+        "mlups": round(rate, 1),
+        "quick": quick,
+        "mesh": mesh_spec,
+    }
+    if base and not quick:
+        result["reference_mlups"] = base
+        result["vs_reference"] = round(rate / base, 3)
+        result["reference_source"] = src
+    return result
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="multigpu_advectiondiffusion_tpu.bench")
+    ap.add_argument("--name", default=None,
+                    help="run one case (default: all)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--quick", action="store_true",
+                    help="shrunken grids for smoke-benching")
+    ap.add_argument("--mesh", default=None, help="e.g. dz=4")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--out", default=None, help="write JSON lines here")
+    args = ap.parse_args(argv)
+
+    cases = [c for c in CASES if args.name is None or c.name == args.name]
+    if not cases:
+        raise SystemExit(
+            f"no case {args.name!r}; have {[c.name for c in CASES]}"
+        )
+    lines = []
+    for case in cases:
+        res = run_case(case, dtype=args.dtype, quick=args.quick,
+                       mesh_spec=args.mesh, repeats=args.repeats)
+        line = json.dumps(res)
+        print(line, flush=True)
+        lines.append(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""Command-line interface.
+
+Replaces the reference's per-project positional-arg binaries + shell/MATLAB
+harness (``./Diffusion3d.run K L W H Nx Ny Nz iters bX bY bZ``,
+``run.sh``/``Run.m`` — SURVEY §3.1/§3.5) with one argparse CLI:
+
+    python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+        --K 1.0 --lengths 2 2 2 --n 400 200 200 --iters 1000 --save out/
+    python -m multigpu_advectiondiffusion_tpu.cli burgers3d \
+        --t-end 0.06 --cfl 0.3 --n 400 400 400 --save out/ --plot
+    python -m multigpu_advectiondiffusion_tpu.cli convergence --ndim 3
+    python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+        --n 256 256 256 --iters 100 --mesh dz=4,dy=2
+
+Block sizes (bX/bY/bZ) have no TPU meaning and are not taken; XLA/Pallas
+choose tiling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from multigpu_advectiondiffusion_tpu.cli.drivers import (
+    decomposition_for,
+    parse_mesh_spec,
+    run_solver,
+)
+
+
+def _add_common(p: argparse.ArgumentParser, ndim: int):
+    p.add_argument("--n", type=int, nargs=ndim, required=True,
+                   metavar=tuple("N" + c for c in "xyz"[:ndim]),
+                   help="grid nodes per physical axis (x [y [z]])")
+    p.add_argument("--lengths", type=float, nargs=ndim, default=None,
+                   help="physical extents (L [W [H]]); domain centered at 0")
+    p.add_argument("--iters", type=int, default=None,
+                   help="fixed iteration count (reference main.c mode)")
+    p.add_argument("--t-end", type=float, default=None,
+                   help="march to this simulated time instead of --iters")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64", "bfloat16"])
+    p.add_argument("--ic", default=None, help="initial-condition name")
+    p.add_argument("--bc", default=None, nargs="*",
+                   help="boundary kind(s): one value or one per axis "
+                        "(dirichlet|edge|periodic)")
+    p.add_argument("--integrator", default="ssp_rk3",
+                   choices=["euler", "ssp_rk2", "ssp_rk3"])
+    p.add_argument("--mesh", default=None,
+                   help="device-mesh spec, e.g. 'dz=4' or 'dz=4,dy=2'")
+    p.add_argument("--save", default=None, metavar="DIR",
+                   help="write initial.bin/result.bin/summary.json here")
+    p.add_argument("--plot", action="store_true",
+                   help="also render a PNG into --save DIR")
+    p.add_argument("--check-error", action="store_true",
+                   help="report L1/L2/Linf vs the analytic solution")
+    p.add_argument("--repeats", type=int, default=1,
+                   help="timed repetitions; best time is reported")
+
+
+def _grid(args, ndim):
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+
+    lengths = args.lengths if args.lengths is not None else [2.0] * ndim
+    if args.bc and all(b == "periodic" for b in args.bc):
+        return Grid.make_periodic(*args.n, lengths=lengths)
+    return Grid.make(*args.n, lengths=lengths)
+
+
+def _bc(args, default):
+    if not args.bc:
+        return default
+    return args.bc[0] if len(args.bc) == 1 else tuple(reversed(args.bc))
+
+
+def _mesh_decomp(args, grid):
+    mesh, sizes = parse_mesh_spec(args.mesh)
+    return mesh, decomposition_for(grid, sizes)
+
+
+def _run_diffusion(args, ndim, geometry="cartesian"):
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionConfig,
+        DiffusionSolver,
+    )
+
+    grid = _grid(args, ndim)
+    cfg = DiffusionConfig(
+        grid=grid,
+        diffusivity=args.K,
+        order=args.order,
+        integrator=args.integrator,
+        dtype=args.dtype,
+        ic=args.ic or "heat_kernel",
+        bc=_bc(args, "dirichlet" if geometry == "cartesian"
+               else ("edge", "dirichlet")),
+        t0=args.t0,
+        geometry=geometry,
+    )
+    mesh, decomp = _mesh_decomp(args, grid)
+    solver = DiffusionSolver(cfg, mesh=mesh, decomp=decomp)
+    name = f"diffusion{ndim}d" if geometry == "cartesian" else "diffusion_axisym"
+    iters = args.iters if args.t_end is None else None
+    if iters is None and args.t_end is None:
+        iters = 100
+    return run_solver(solver, name, iters=iters, t_end=args.t_end,
+                      save_dir=args.save, plot=args.plot,
+                      check_error=args.check_error, repeats=args.repeats)
+
+
+def _run_burgers(args, ndim):
+    from multigpu_advectiondiffusion_tpu.models.burgers import (
+        BurgersConfig,
+        BurgersSolver,
+    )
+
+    grid = _grid(args, ndim)
+    cfg = BurgersConfig(
+        grid=grid,
+        flux=args.flux,
+        weno_order=args.weno_order,
+        weno_variant=args.weno_variant,
+        cfl=args.cfl,
+        nu=args.nu,
+        adaptive_dt=not args.fixed_dt,
+        integrator=args.integrator,
+        dtype=args.dtype,
+        ic=args.ic or "gaussian",
+        bc=_bc(args, "edge"),
+    )
+    mesh, decomp = _mesh_decomp(args, grid)
+    solver = BurgersSolver(cfg, mesh=mesh, decomp=decomp)
+    iters = args.iters if args.t_end is None else None
+    if iters is None and args.t_end is None:
+        iters = 100
+    return run_solver(solver, f"burgers{ndim}d", iters=iters, t_end=args.t_end,
+                      save_dir=args.save, plot=args.plot,
+                      check_error=False, repeats=args.repeats)
+
+
+def _run_convergence(args):
+    """The TestingAccuracy.m equivalent: grid-refinement OOA study."""
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionConfig,
+        DiffusionSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.metrics import observed_order
+
+    ndim = args.ndim
+    ns = args.cells or {1: [17, 33, 65, 129], 2: [17, 33, 65],
+                        3: [9, 17, 33]}[ndim]
+    print(f"-- diffusion{ndim}d grid-refinement study "
+          f"(TestingAccuracy.m analog), dtype={args.dtype}")
+    print(f"{'n':>6} {'L1':>12} {'Linf':>12} {'OOA(L1)':>8}")
+    prev_l1 = None
+    for n in ns:
+        grid = Grid.make(*(n,) * ndim, lengths=10.0)
+        solver = DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype=args.dtype, order=args.order)
+        )
+        out = solver.advance_to(solver.initial_state(), args.t_end)
+        norms = solver.error_norms(out, t=args.t_end)
+        ooa = (f"{observed_order(prev_l1, norms.l1):8.2f}"
+               if prev_l1 else " " * 8)
+        print(f"{n:>6} {norms.l1:>12.4e} {norms.linf:>12.4e} {ooa}")
+        prev_l1 = norms.l1
+    return None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="multigpu_advectiondiffusion_tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    for ndim in (1, 2, 3):
+        p = sub.add_parser(f"diffusion{ndim}d",
+                           help=f"{ndim}-D heat equation (heat{ndim}d.m, "
+                                f"Diffusion{ndim}d drivers)")
+        _add_common(p, ndim)
+        p.add_argument("--K", type=float, default=1.0,
+                       help="diffusivity (main.c arg 1)")
+        p.add_argument("--order", type=int, default=4, choices=[2, 4])
+        p.add_argument("--t0", type=float, default=0.1)
+        p.set_defaults(fn=lambda a, d=ndim: _run_diffusion(a, d))
+
+    p = sub.add_parser("diffusion-axisym",
+                       help="axisymmetric r-y diffusion "
+                            "(heat2d_axisymmetric.m)")
+    _add_common(p, 2)
+    p.add_argument("--K", type=float, default=0.27)
+    p.add_argument("--order", type=int, default=4, choices=[2, 4])
+    p.add_argument("--t0", type=float, default=1.0)
+    p.set_defaults(fn=lambda a: _run_diffusion(a, 2, geometry="axisymmetric"))
+
+    for ndim in (1, 2, 3):
+        p = sub.add_parser(f"burgers{ndim}d",
+                           help=f"{ndim}-D scalar conservation law, WENO "
+                                f"(LFWENO5FDM{ndim}d.m, Burgers drivers)")
+        _add_common(p, ndim)
+        p.add_argument("--flux", default="burgers",
+                       choices=["burgers", "linear", "buckley"])
+        p.add_argument("--weno-order", type=int, default=5, choices=[5, 7])
+        p.add_argument("--weno-variant", default="js", choices=["js", "z"])
+        p.add_argument("--cfl", type=float, default=0.4)
+        p.add_argument("--nu", type=float, default=0.0,
+                       help="viscosity (1e-5 in SingleGPU Burgers)")
+        p.add_argument("--fixed-dt", action="store_true",
+                       help="reference-parity dt = CFL*dx (hard-coded "
+                            "max|u|=1, Burgers3d_Baseline/main.c:193)")
+        p.set_defaults(fn=lambda a, d=ndim: _run_burgers(a, d))
+
+    p = sub.add_parser("convergence",
+                       help="grid-refinement accuracy study "
+                            "(TestingAccuracy.m)")
+    p.add_argument("--ndim", type=int, default=3, choices=[1, 2, 3])
+    p.add_argument("--cells", type=int, nargs="*", default=None)
+    p.add_argument("--t-end", type=float, default=0.2)
+    p.add_argument("--dtype", default="float64")
+    p.add_argument("--order", type=int, default=4, choices=[2, 4])
+    p.set_defaults(fn=_run_convergence)
+
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() is not False else 1)

@@ -1,0 +1,132 @@
+"""Shared CLI run driver — the re-design of the reference's per-project
+``main.c``/``main.cpp`` drivers and ``Run.m`` harnesses (SURVEY §3.1, §3.5):
+build solver → save ``initial.bin`` → timed hot loop → save ``result.bin``
+→ PrintSummary block (+ JSON + optional PNG render, replacing MATLAB).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+
+from multigpu_advectiondiffusion_tpu.models.base import SolverBase
+from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition, make_mesh
+from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
+from multigpu_advectiondiffusion_tpu.utils import io as io_utils
+from multigpu_advectiondiffusion_tpu.utils.summary import RunSummary
+
+
+def parse_mesh_spec(spec: Optional[str]):
+    """``'dz=4,dy=2'`` -> (mesh, Decomposition) or (None, None).
+
+    Mesh axis names map to grid axes by suffix: dz/dy/dx/dr -> z/y/x/r.
+    """
+    if not spec:
+        return None, None
+    sizes = {}
+    for part in spec.split(","):
+        name, _, num = part.partition("=")
+        sizes[name.strip()] = int(num)
+    mesh = make_mesh(sizes)
+    return mesh, sizes
+
+
+def decomposition_for(grid, mesh_sizes) -> Optional[Decomposition]:
+    if not mesh_sizes:
+        return None
+    suffix_to_axis = {}
+    names = grid.axis_names  # e.g. ('z','y','x'); axisym grids use ('y','x')
+    for ax, n in enumerate(names):
+        suffix_to_axis[n] = ax
+    # r is the innermost axis of axisymmetric grids
+    suffix_to_axis.setdefault("r", grid.ndim - 1)
+    mapping = {}
+    for mesh_name in mesh_sizes:
+        suffix = mesh_name.lstrip("d")
+        if suffix not in suffix_to_axis:
+            raise ValueError(
+                f"mesh axis {mesh_name!r} has no grid axis (grid axes: {names})"
+            )
+        mapping[suffix_to_axis[suffix]] = mesh_name
+    return Decomposition.of(mapping)
+
+
+def run_solver(
+    solver: SolverBase,
+    name: str,
+    iters: Optional[int] = None,
+    t_end: Optional[float] = None,
+    save_dir: Optional[str] = None,
+    plot: bool = False,
+    check_error: bool = False,
+    repeats: int = 1,
+) -> RunSummary:
+    """Execute the timed solve exactly the way the reference drivers do:
+    untimed warm-up/compile, barrier-sandwiched hot loop
+    (``MultiGPU/Diffusion3d_Baseline/main.c:184-307``), then I/O."""
+    if (iters is None) == (t_end is None):
+        raise ValueError("provide exactly one of iters/t_end")
+    state = solver.initial_state()
+
+    if save_dir:
+        os.makedirs(save_dir, exist_ok=True)
+        io_utils.save_binary(state.u, os.path.join(save_dir, "initial.bin"))
+
+    # compile (untimed, like the reference's untimed warm phase)
+    t0 = time.perf_counter()
+    if iters is not None:
+        out = solver.run(state, 1)
+    else:
+        out = solver.step(state)
+    out.u.block_until_ready()
+    compile_s = time.perf_counter() - t0
+
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        if iters is not None:
+            out = solver.run(state, iters)
+        else:
+            out = solver.advance_to(state, t_end)
+        out.u.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+
+    n_iters = iters if iters is not None else max(1, int(out.it) or 1)
+    dt = getattr(solver, "dt", None)
+    if dt is None:
+        dt = (float(out.t) - float(state.t)) / max(n_iters, 1)
+
+    summary = RunSummary(
+        name=name,
+        grid_xyz=solver.grid.shape_xyz,
+        iters=n_iters,
+        stages=STAGES[solver.cfg.integrator],
+        seconds=best,
+        dt=float(dt),
+        t_final=float(out.t),
+        devices=1 if solver.mesh is None else solver.mesh.devices.size,
+        dtype=str(solver.cfg.dtype),
+    )
+
+    if check_error and hasattr(solver, "error_norms"):
+        norms = solver.error_norms(out)
+        summary.error_l1, summary.error_l2, summary.error_linf = tuple(norms)
+
+    if save_dir:
+        io_utils.save_binary(out.u, os.path.join(save_dir, "result.bin"))
+        summary.write_json(os.path.join(save_dir, "summary.json"))
+        if plot:
+            from multigpu_advectiondiffusion_tpu.utils.plot import plot_field
+
+            plot_field(
+                out.u,
+                grid=solver.grid,
+                title=f"{name} t={float(out.t):.4f}",
+                path=os.path.join(save_dir, f"{name}.png"),
+            )
+
+    summary.print_block()
+    return summary

@@ -160,7 +160,9 @@ class SolverBase:
     # ------------------------------------------------------------------ #
     # Execution: wrap a (u, t) -> (u, t) block program for this world
     # ------------------------------------------------------------------ #
-    def _wrap(self, fn):
+    def _wrap(self, fn, n_out_scalars: int = 1):
+        """Jit a block program ``(u, t) -> (u, *scalars)``; sharded, the
+        field follows the decomposition spec and scalars are replicated."""
         if self.mesh is None:
             return jax.jit(fn)
         spec = self.decomp.partition_spec(self.grid.ndim)
@@ -169,7 +171,7 @@ class SolverBase:
                 fn,
                 mesh=self.mesh,
                 in_specs=(spec, P()),
-                out_specs=(spec, P()),
+                out_specs=(spec,) + (P(),) * n_out_scalars,
             )
         )
 
@@ -209,10 +211,12 @@ class SolverBase:
                 return c[1] < t_end - eps
 
             def body(c):
-                return self._local_step(c[0], c[1], t_end=t_end)
+                u, t, it = c
+                u, t = self._local_step(u, t, t_end=t_end)
+                return (u, t, it + 1)
 
-            return lax.while_loop(cond, body, (u, t))
+            return lax.while_loop(cond, body, (u, t, jnp.zeros((), jnp.int32)))
 
-        f = self._compiled(("adv", float(t_end)), lambda: self._wrap(block))
-        u, t = f(state.u, state.t)
-        return SolverState(u=u, t=t, it=state.it)  # it not tracked in while mode
+        f = self._compiled(("adv", float(t_end)), lambda: self._wrap(block, 2))
+        u, t, steps = f(state.u, state.t)
+        return SolverState(u=u, t=t, it=state.it + steps)

@@ -53,6 +53,7 @@ class BurgersConfig:
     ic_params: Tuple = ()
     bc: object = "edge"
     t0: float = 0.0
+    impl: str = "xla"  # kernel strategy: "xla" | "pallas"
 
 
 class BurgersSolver(SolverBase):
@@ -78,6 +79,7 @@ class BurgersSolver(SolverBase):
                     order=cfg.weno_order,
                     variant=cfg.weno_variant,
                     padder=ctx.padder,
+                    impl=cfg.impl,
                 )
                 acc = div if acc is None else acc + div
             out = -acc
@@ -88,6 +90,7 @@ class BurgersSolver(SolverBase):
                     diffusivity=cfg.nu,
                     order=cfg.laplacian_order,
                     padder=ctx.padder,
+                    impl=cfg.impl,
                 )
             return out
 

@@ -60,6 +60,7 @@ class DiffusionConfig:
     boundary_band: int = 2  # width of the skipped band (Laplace3d.m:21)
     source: Optional[Callable] = None  # S(u) hook (heat3d.m:26-30)
     geometry: str = "cartesian"  # or "axisymmetric" (2-D r-y)
+    impl: str = "xla"  # kernel strategy: "xla" | "pallas"
 
     def __post_init__(self):
         if self.geometry not in ("cartesian", "axisymmetric"):
@@ -124,6 +125,7 @@ class DiffusionSolver(SolverBase):
                     diffusivity=cfg.diffusivity,
                     order=cfg.order,
                     padder=ctx.padder,
+                    impl=cfg.impl,
                 )
 
         walled_axes = [a for a, b in enumerate(bcs) if b.kind != "periodic"]

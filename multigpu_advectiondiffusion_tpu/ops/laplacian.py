@@ -63,11 +63,14 @@ def laplacian(
     order: int = 4,
     padder: Padder | None = None,
     bcs: Sequence[Boundary] | None = None,
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """``sum_axis K_axis * d2u/dx_axis^2`` over all array axes.
 
     Exactly one of ``padder`` (sharded/explicit halo source) or ``bcs``
-    (single-device BC padding) must be provided.
+    (single-device BC padding) must be provided. ``impl`` selects the
+    kernel strategy: ``"xla"`` (fused shifted slices) or ``"pallas"``
+    (VMEM slab-pipelined TPU kernel; falls back to XLA where unsupported).
     """
     if (padder is None) == (bcs is None):
         raise ValueError("provide exactly one of padder/bcs")
@@ -76,6 +79,25 @@ def laplacian(
     if isinstance(diffusivity, (int, float)):
         diffusivity = [float(diffusivity)] * u.ndim
     _, r, _ = D2_STENCILS[order]
+
+    if impl == "pallas":
+        from multigpu_advectiondiffusion_tpu.ops.pallas import (
+            laplacian as pallas_lap,
+        )
+
+        if pallas_lap.supported(u.shape, order):
+            up = u
+            for axis in range(u.ndim):
+                up = padder(up, axis, r)
+            fn = (
+                pallas_lap.laplacian_o4_3d
+                if u.ndim == 3
+                else pallas_lap.laplacian_o4_2d
+            )
+            return fn(up, spacing, diffusivity)
+    elif impl != "xla":
+        raise ValueError(f"unknown laplacian impl {impl!r}; use 'xla'/'pallas'")
+
     acc = None
     for axis in range(u.ndim):
         term = diffusivity[axis] * d2_from_padded(

@@ -1,0 +1,127 @@
+"""Pallas TPU kernel for the WENO5 flux divergence.
+
+TPU re-design of the reference's tiled face-flux kernels
+(``SingleGPU/Burgers3d_WENO5_SharedMem/kernels.cu:212-400``): each tile
+loads its stencil halo once, reconstructs every interface flux exactly
+once, and differences adjacent faces. Here the "shared-memory tile" is a
+VMEM z-slab DMA'd from HBM, and the per-thread serial sweeps of the
+baseline kernels (``MultiGPU/Burgers3d_Baseline/Kernels.cu:225-452``)
+become full-slab vector slices.
+
+The kernel consumes an array *pre-padded by 3 along the sweep axis* (BC
+ghosts or ppermute halo attached by the caller), so one kernel serves
+single-device and sharded execution. The WENO math itself is shared with
+the XLA path (``ops.weno._weno5_minus/_weno5_plus``) — one source of
+truth for the stencil algebra.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multigpu_advectiondiffusion_tpu.ops.flux import Flux
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import pick_block
+
+R = 3  # WENO5 stencil radius
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _face_flux(window, axis, n_faces, flux, variant):
+    """All ``n_faces`` interface fluxes along ``axis`` of a padded slab."""
+    from multigpu_advectiondiffusion_tpu.ops.weno import (
+        _weno5_minus,
+        _weno5_plus,
+    )
+
+    a = jnp.abs(flux.df(window))
+    fu = flux.f(window)
+    vp = 0.5 * (fu + a * window)
+    vm = 0.5 * (fu - a * window)
+
+    def shifts(arr, lo):
+        out = []
+        for j in range(5):
+            idx = [slice(None)] * arr.ndim
+            idx[axis] = slice(lo + j, lo + j + n_faces)
+            out.append(arr[tuple(idx)])
+        return out
+
+    return _weno5_minus(*shifts(vp, 0), variant) + _weno5_plus(
+        *shifts(vm, 1), variant
+    )
+
+
+def flux_divergence_pallas(
+    up: jnp.ndarray,
+    axis: int,
+    dx: float,
+    flux: Flux,
+    variant: str = "js",
+    block: int | None = None,
+) -> jnp.ndarray:
+    """``d f(u)/dx`` along ``axis`` of an array padded by 3 on that axis.
+
+    3-D arrays are processed in z-slabs (y-slabs for 2-D); the sweep axis
+    may be any axis, including the blocked one (the slab then carries the
+    halo in-block).
+    """
+    ndim = up.ndim
+    shape = list(up.shape)
+    shape[axis] -= 2 * R
+    n = shape[axis]  # output length along the sweep axis
+    lead_axis = 0  # block over the leading axis
+    nb_padded = up.shape[0]
+    nb = shape[0]
+    b = block or pick_block(nb, 8 if ndim == 3 else 128)
+    halo_lead = 2 * R if axis == lead_axis else 0
+
+    def kernel(up_hbm, out_ref, slab, sem):
+        k = pl.program_id(0)
+        pltpu.make_async_copy(
+            up_hbm.at[pl.ds(k * b, b + halo_lead)], slab, sem
+        ).start()
+        pltpu.make_async_copy(
+            up_hbm.at[pl.ds(k * b, b + halo_lead)], slab, sem
+        ).wait()
+        window = slab[:]
+        h = _face_flux(window, axis, (b if axis == lead_axis else n) + 1,
+                       flux, variant)
+        lo = [slice(None)] * ndim
+        hi = [slice(None)] * ndim
+        lo[axis] = slice(0, b if axis == lead_axis else n)
+        hi[axis] = slice(1, (b if axis == lead_axis else n) + 1)
+        out_ref[:] = (h[tuple(hi)] - h[tuple(lo)]) * (1.0 / dx)
+
+    slab_shape = list(up.shape)
+    slab_shape[0] = b + halo_lead
+    out_block = list(shape)
+    out_block[0] = b
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nb // b,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            tuple(out_block),
+            lambda k: (k,) + (0,) * (ndim - 1),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(tuple(shape), up.dtype),
+        scratch_shapes=[
+            pltpu.VMEM(tuple(slab_shape), up.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=_interpret(),
+    )(up)
+
+
+def supported(ndim: int, order: int, variant: str) -> bool:
+    return order == 5 and variant in ("js", "z") and ndim in (2, 3)

@@ -185,18 +185,33 @@ def flux_divergence(
     variant: str = "js",
     padder: Padder | None = None,
     bc: Boundary | None = None,
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """Conservative residual ``d f(u) / dx`` along one axis.
 
     Equivalent role to ``Compute_dF/dG/dH``
     (``MultiGPU/Burgers3d_Baseline/Kernels.cu:225-452``) and
     ``WENO5resAdv_{X,Y,Z}.m``. Exactly one of ``padder``/``bc`` selects the
-    ghost-cell source.
+    ghost-cell source. ``impl``: ``"xla"`` or ``"pallas"`` (VMEM
+    slab-pipelined kernel; falls back to XLA where unsupported).
     """
     if (padder is None) == (bc is None):
         raise ValueError("provide exactly one of padder/bc")
     r = HALO[order]
     up = padder(u, axis, r) if padder is not None else pad_axis(u, axis, r, bc)
+
+    if impl == "pallas":
+        from multigpu_advectiondiffusion_tpu.ops.pallas import (
+            weno as pallas_weno,
+        )
+
+        if pallas_weno.supported(u.ndim, order, variant):
+            return pallas_weno.flux_divergence_pallas(
+                up, axis, dx, flux, variant
+            )
+    elif impl != "xla":
+        raise ValueError(f"unknown WENO impl {impl!r}; use 'xla'/'pallas'")
+
     h = interface_flux_from_padded(up, axis, flux, order, variant)
     n = u.shape[axis]
     return (shifted(h, axis, 1, n) - shifted(h, axis, 0, n)) / dx

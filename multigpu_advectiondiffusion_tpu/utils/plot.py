@@ -1,0 +1,130 @@
+"""Slice plotting — the matplotlib replacement for the reference's MATLAB
+visualization layer (``myplot.m`` slice renders and the k-Wave-derived
+``getColorMap.m`` per project, e.g.
+``MultiGPU/Burgers3d_Baseline/getColorMap.m:1-25``).
+
+Headless-safe (Agg backend); every function returns the figure and can
+write a PNG, mirroring ``Run.m``'s ``print('-dpng', ...)`` step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _mpl():
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def kwave_colormap(n: int = 256):
+    """Diverging dark-red -> white -> dark-blue map in the style of the
+    k-Wave ``getColorMap`` the reference embeds (re-derived from its
+    anchor colors, not copied point data)."""
+    from matplotlib.colors import LinearSegmentedColormap
+
+    anchors = [
+        (0.0, (0.30, 0.00, 0.00)),
+        (0.25, (0.85, 0.10, 0.00)),
+        (0.45, (1.00, 0.80, 0.30)),
+        (0.50, (1.00, 1.00, 1.00)),
+        (0.55, (0.30, 0.80, 1.00)),
+        (0.75, (0.00, 0.10, 0.85)),
+        (1.0, (0.00, 0.00, 0.30)),
+    ]
+    return LinearSegmentedColormap.from_list("kwave_like", anchors, N=n)
+
+
+def plot_field(
+    u,
+    grid=None,
+    slices: Optional[Sequence[float]] = None,
+    title: str = "",
+    path: Optional[str] = None,
+    cmap=None,
+):
+    """Render a 1-D line, 2-D image, or 3-D orthogonal slice panel.
+
+    The 3-D panel shows the mid-planes (z, y, x) like ``myplot.m``'s
+    ``slice(...,xcenter,ycenter,zcenter)`` view.
+    """
+    plt = _mpl()
+    u = np.asarray(u)
+    cmap = cmap or kwave_colormap()
+    extent = None
+
+    if u.ndim == 1:
+        fig, ax = plt.subplots(figsize=(6, 4))
+        x = np.linspace(*grid.bounds[0], u.shape[0]) if grid else np.arange(len(u))
+        ax.plot(x, u, "-o", ms=2)
+        ax.set_xlabel("x")
+        ax.set_ylabel("u")
+    elif u.ndim == 2:
+        fig, ax = plt.subplots(figsize=(6, 5))
+        if grid is not None:
+            (ylo, yhi), (xlo, xhi) = grid.bounds
+            extent = (xlo, xhi, ylo, yhi)
+        im = ax.imshow(u, origin="lower", extent=extent, cmap=cmap)
+        fig.colorbar(im, ax=ax, shrink=0.85)
+        ax.set_xlabel("x")
+        ax.set_ylabel("y")
+    else:
+        fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+        nz, ny, nx = u.shape
+        panes = [
+            (u[nz // 2], "z mid-plane", "x", "y"),
+            (u[:, ny // 2], "y mid-plane", "x", "z"),
+            (u[:, :, nx // 2], "x mid-plane", "y", "z"),
+        ]
+        vmin, vmax = float(u.min()), float(u.max())
+        for ax, (sl, name, xl, yl) in zip(axes, panes):
+            im = ax.imshow(sl, origin="lower", cmap=cmap, vmin=vmin, vmax=vmax)
+            ax.set_title(name)
+            ax.set_xlabel(xl)
+            ax.set_ylabel(yl)
+        fig.colorbar(im, ax=list(axes), shrink=0.85)
+
+    if title:
+        fig.suptitle(title)
+    if path:
+        fig.savefig(path, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+    return fig
+
+
+def plot_comparison(u, u_exact, grid=None, title="", path=None):
+    """Numeric vs exact side-by-side plus the error field
+    (``heat3d.m:81-103`` subplot layout)."""
+    plt = _mpl()
+    u = np.asarray(u)
+    ue = np.asarray(u_exact)
+    err = np.abs(u - ue)
+    if u.ndim == 3:
+        u, ue, err = (a[a.shape[0] // 2] for a in (u, ue, err))
+    if u.ndim == 1:
+        fig, ax = plt.subplots(figsize=(7, 4))
+        ax.plot(u, label="numeric")
+        ax.plot(ue, "--", label="exact")
+        ax.plot(err, ":", label="|error|")
+        ax.legend()
+    else:
+        fig, axes = plt.subplots(1, 3, figsize=(14, 4))
+        cmap = kwave_colormap()
+        for ax, (field, name) in zip(
+            axes, [(u, "numeric"), (ue, "exact"), (err, "|error|")]
+        ):
+            im = ax.imshow(field, origin="lower", cmap=cmap)
+            ax.set_title(name)
+            fig.colorbar(im, ax=ax, shrink=0.8)
+    if title:
+        fig.suptitle(title)
+    if path:
+        fig.savefig(path, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+    return fig

@@ -1,0 +1,93 @@
+"""Run summaries: the reference's ``PrintSummary`` block plus JSON.
+
+The reference prints a human-readable perf block per run
+(``MultiGPU/Diffusion3d_Baseline/Tools.c:255-269``: grid, iterations,
+wall seconds, GFLOPS) and the author then hand-copies the numbers into
+``Run.m`` header comments. Here the same block is printed AND written as
+machine-readable JSON (the benchmark-registry upgrade of SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import platform
+from typing import Optional
+
+import jax
+
+from multigpu_advectiondiffusion_tpu.utils.metrics import (
+    gflops_reference_convention,
+    mlups,
+)
+
+
+@dataclasses.dataclass
+class RunSummary:
+    name: str
+    grid_xyz: tuple
+    iters: int
+    stages: int
+    seconds: float
+    dt: float
+    t_final: float
+    devices: int = 1
+    dtype: str = "float32"
+    error_l1: Optional[float] = None
+    error_l2: Optional[float] = None
+    error_linf: Optional[float] = None
+    compile_seconds: Optional[float] = None
+
+    @property
+    def num_cells(self) -> int:
+        n = 1
+        for s in self.grid_xyz:
+            n *= s
+        return n
+
+    @property
+    def mlups(self) -> float:
+        return mlups(self.num_cells, self.iters, self.stages, self.seconds)
+
+    @property
+    def gflops(self) -> float:
+        return gflops_reference_convention(
+            self.num_cells, self.iters, self.seconds, self.stages
+        )
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["mlups"] = round(self.mlups, 3)
+        d["gflops_reference_convention"] = round(self.gflops, 4)
+        d["backend"] = jax.default_backend()
+        d["platform"] = platform.machine()
+        return d
+
+    def print_block(self) -> None:
+        """Human block in the spirit of PrintSummary (Tools.c:255-269)."""
+        g = "x".join(str(s) for s in self.grid_xyz)
+        print("=" * 60)
+        print(f" {self.name}")
+        print("=" * 60)
+        print(f" grid               : {g} ({self.num_cells:,} cells)")
+        print(f" devices            : {self.devices} [{jax.default_backend()}]")
+        print(f" dtype              : {self.dtype}")
+        print(f" iterations         : {self.iters} x {self.stages} RK stages")
+        print(f" dt (last)          : {self.dt:.6e}")
+        print(f" simulated time     : {self.t_final:.6f}")
+        if self.compile_seconds is not None:
+            print(f" compile time       : {self.compile_seconds:.3f} s")
+        print(f" wall time          : {self.seconds:.4f} s")
+        print(f" MLUPS              : {self.mlups:.1f}")
+        print(f" GFLOPS (ref conv.) : {self.gflops:.3f}")
+        if self.error_l1 is not None:
+            print(
+                f" error L1/L2/Linf   : {self.error_l1:.4e} / "
+                f"{self.error_l2:.4e} / {self.error_linf:.4e}"
+            )
+        print("=" * 60)
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+            f.write("\n")
