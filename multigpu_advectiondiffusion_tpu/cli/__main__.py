@@ -56,6 +56,10 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                    help="report L1/L2/Linf vs the analytic solution")
     p.add_argument("--repeats", type=int, default=1,
                    help="timed repetitions; best time is reported")
+    p.add_argument("--snapshot-every", type=int, default=0, metavar="N",
+                   help="write snap_NNNNNN.bin every N iters (async)")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="write restartable checkpoint_NNNNNN.npz every N iters")
 
 
 def _grid(args, ndim):
@@ -105,7 +109,9 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
         iters = 100
     return run_solver(solver, name, iters=iters, t_end=args.t_end,
                       save_dir=args.save, plot=args.plot,
-                      check_error=args.check_error, repeats=args.repeats)
+                      check_error=args.check_error, repeats=args.repeats,
+                      snapshot_every=args.snapshot_every,
+                      checkpoint_every=args.checkpoint_every)
 
 
 def _run_burgers(args, ndim):
@@ -135,7 +141,9 @@ def _run_burgers(args, ndim):
         iters = 100
     return run_solver(solver, f"burgers{ndim}d", iters=iters, t_end=args.t_end,
                       save_dir=args.save, plot=args.plot,
-                      check_error=False, repeats=args.repeats)
+                      check_error=False, repeats=args.repeats,
+                      snapshot_every=args.snapshot_every,
+                      checkpoint_every=args.checkpoint_every)
 
 
 def _run_convergence(args):

@@ -1,0 +1,75 @@
+"""Multi-host (DCN) execution support.
+
+The reference scales across nodes by launching MPI ranks under
+``mpirun`` with host-staged point-to-point messaging
+(``MultiGPU/*/main.c``, OpenMPI/MVAPICH2 — ``DiffusionMPICUDA.h:75-81``).
+The TPU-native equivalent: one Python process per host calls
+:func:`initialize` (``jax.distributed``), every host sees the global
+device set, and a *hybrid* mesh places the outermost decomposition axis
+on DCN while inner axes ride ICI. The same ``shard_map`` halo-exchange
+program then runs unchanged — XLA routes each ``ppermute`` hop over ICI
+or DCN by device placement.
+
+Single-host runs never need this module; it is the opt-in scale-out
+layer (SURVEY §2.4 multi-node row).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the jax.distributed runtime (InitializeMPI analog,
+    ``Tools.c:228-234``). On managed TPU pods all arguments auto-detect;
+    on hand-rolled clusters pass coordinator/process info explicitly."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+) -> Mesh:
+    """Mesh whose ``dcn_axes`` cross host (slice) boundaries and whose
+    ``ici_axes`` stay within a slice.
+
+    Example for 4 hosts of 8 chips solving a z-slab problem:
+    ``hybrid_mesh({'dz_ici': 8}, {'dz_dcn': 4})`` then decompose z over
+    ``('dz_dcn', 'dz_ici')``.
+    """
+    from jax.experimental import mesh_utils
+
+    dcn_sizes = tuple(dcn_axes.values())
+    ici_sizes = tuple(ici_axes.values())
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    devices = mesh_utils.create_hybrid_device_mesh(
+        ici_sizes, dcn_sizes, devices=jax.devices()
+    )
+    # create_hybrid_device_mesh returns shape dcn_sizes + ici_sizes
+    return Mesh(np.asarray(devices), names)
+
+
+def process_local_devices() -> Sequence:
+    """Devices attached to this process (AssignDevices analog — the
+    reference binds rank -> GPU, ``Util.cu:66-74``; JAX binds
+    process -> local chips automatically)."""
+    return jax.local_devices()
+
+
+def is_coordinator() -> bool:
+    """True on process 0 (the reference's ``rank == 0`` I/O gate,
+    ``main.c:82-86``)."""
+    return jax.process_index() == 0

@@ -1,0 +1,79 @@
+"""Startup device/topology validation and debug dumps.
+
+TPU equivalents of the reference's ``Util.cu`` host utilities:
+
+* ``device_scan``   — ``DeviceScan`` (``Util.cu:32-38``): enumerate
+  accelerators with platform/kind/memory stats.
+* ``topology_check`` — ``MPIDeviceCheck``+``AssignDevices``
+  (``Util.cu:43-74``): assert the requested mesh fits the attached
+  devices before any allocation (the reference exits when ranks exceed
+  GPUs; here the mesh factory raises, this adds the human-readable scan).
+* ``memory_report`` — ``PrintGPUmemory``/``ECCCheck`` stand-in
+  (``Kernels.cu:358-384``, ``Util.cu:79-93``): per-device memory stats.
+  ECC itself has no TPU user-visible control; HBM ECC is always on.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def device_scan(verbose: bool = True):
+    """List attached accelerator devices (DeviceScan analog)."""
+    devs = jax.devices()
+    rows = []
+    for d in devs:
+        row = {
+            "id": d.id,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", "?"),
+            "process": getattr(d, "process_index", 0),
+        }
+        rows.append(row)
+    if verbose:
+        print(f"-- device scan: {len(devs)} device(s), "
+              f"backend={jax.default_backend()}")
+        for r in rows:
+            print(f"   [{r['id']}] {r['platform']}:{r['kind']} "
+                  f"(process {r['process']})")
+    return rows
+
+
+def memory_report(verbose: bool = True):
+    """Per-device memory stats where the backend exposes them."""
+    rows = []
+    for d in jax.devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # backend without memory_stats (e.g. CPU)
+            pass
+        row = {
+            "id": d.id,
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+        }
+        rows.append(row)
+        if verbose and row["bytes_limit"]:
+            used = row["bytes_in_use"] / 1e9
+            lim = row["bytes_limit"] / 1e9
+            print(f"   [{d.id}] {used:.2f} / {lim:.2f} GB in use")
+    return rows
+
+
+def topology_check(mesh_sizes: dict, devices: Optional[list] = None) -> None:
+    """Fail fast when the requested mesh exceeds the attached devices
+    (MPIDeviceCheck analog: 'Currently only can handle at most as many
+    ranks as GPUs', Util.cu:50-57)."""
+    import math
+
+    devs = devices if devices is not None else jax.devices()
+    need = math.prod(mesh_sizes.values())
+    if need > len(devs):
+        raise RuntimeError(
+            f"mesh {mesh_sizes} needs {need} devices but only "
+            f"{len(devs)} attached ({jax.default_backend()}); "
+            f"reduce the mesh or attach more devices"
+        )

@@ -46,10 +46,70 @@ def _load_native():
                 ctypes.c_size_t,
             ]
             lib.save_binary_f32.restype = ctypes.c_int
+            lib.writer_create.argtypes = [ctypes.c_size_t]
+            lib.writer_create.restype = ctypes.c_void_p
+            lib.writer_submit.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_size_t,
+            ]
+            lib.writer_submit.restype = ctypes.c_int
+            lib.writer_flush.argtypes = [ctypes.c_void_p]
+            lib.writer_flush.restype = ctypes.c_int
+            lib.writer_destroy.argtypes = [ctypes.c_void_p]
             _native = lib
             return lib
     _native = False
     return False
+
+
+class AsyncBinaryWriter:
+    """Double-buffered background snapshot writer (native thread when
+    ``native/libtpucfd_io.so`` is built, synchronous fallback otherwise).
+
+    The solver keeps stepping while the previous snapshot drains to disk —
+    the role the reference's pinned host buffers + DtH copy staging played
+    for output (``main.c:89-114,312-343``).
+    """
+
+    def __init__(self, queue_slots: int = 2):
+        self._lib = _load_native() or None
+        self._handle = (
+            self._lib.writer_create(queue_slots) if self._lib else None
+        )
+
+    def submit(self, u, path: str) -> None:
+        arr = np.ascontiguousarray(np.asarray(u, dtype=np.float32)).ravel()
+        if self._handle:
+            import ctypes
+
+            rc = self._lib.writer_submit(
+                self._handle,
+                path.encode(),
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                arr.size,
+            )
+            if rc != 0:
+                raise IOError(f"async writer failed for {path}")
+        else:
+            arr.tofile(path)
+
+    def flush(self) -> None:
+        if self._handle and self._lib.writer_flush(self._handle) != 0:
+            raise IOError("async writer flush reported an error")
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.writer_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.flush()
+        self.close()
 
 
 def save_binary(u, path: str) -> None:
@@ -76,7 +136,23 @@ def load_binary(path: str, shape) -> np.ndarray:
 
 def save_ascii(u, path: str) -> None:
     """One value per line, ``%g`` format (``Save3D``, Tools.c:68-86)."""
-    arr = np.asarray(u, dtype=np.float64).ravel()
+    arr = np.ascontiguousarray(np.asarray(u, dtype=np.float64)).ravel()
+    lib = _load_native()
+    if lib:
+        import ctypes
+
+        lib.save_ascii_f64.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_size_t,
+        ]
+        lib.save_ascii_f64.restype = ctypes.c_int
+        if lib.save_ascii_f64(
+            path.encode(),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            arr.size,
+        ) == 0:
+            return
     with open(path, "w") as f:
         for v in arr:
             f.write(f"{v:g}\n")

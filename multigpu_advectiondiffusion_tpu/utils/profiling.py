@@ -1,0 +1,72 @@
+"""Profiling and tracing helpers.
+
+TPU replacement for the reference's profiling layer (SURVEY §5): per-rank
+``nvprof`` wrapping (``Diffusion3d_Baseline/profile.sh:2``) becomes
+``jax.profiler`` traces viewable in TensorBoard/Perfetto, and the
+MPI_Wtime double-barrier walltime sandwich (``main.c:139-147,184-187``)
+becomes :class:`Stopwatch` segments around ``block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace: ``with trace('/tmp/trace'): run(...)``.
+
+    View with TensorBoard (profile plugin) or Perfetto.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Stopwatch:
+    """Named walltime segments (HtD/compute/DtH in the reference's
+    summary become e.g. init/compile/solve/io here)."""
+
+    def __init__(self):
+        self.segments: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def segment(self, name: str, sync: Optional[object] = None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            self.segments[name] = (
+                self.segments.get(name, 0.0) + time.perf_counter() - t0
+            )
+
+    def report(self) -> str:
+        total = sum(self.segments.values())
+        lines = [f"{'segment':<16} {'seconds':>10} {'share':>7}"]
+        for name, s in self.segments.items():
+            share = 100.0 * s / total if total else 0.0
+            lines.append(f"{name:<16} {s:>10.4f} {share:>6.1f}%")
+        lines.append(f"{'total':<16} {total:>10.4f}")
+        return "\n".join(lines)
+
+
+def annotate(name: str):
+    """Decorator adding a named TraceAnnotation around a function so it
+    shows up as a labeled span in profiler timelines."""
+
+    def wrap(fn):
+        def inner(*a, **k):
+            with jax.profiler.TraceAnnotation(name):
+                return fn(*a, **k)
+
+        return inner
+
+    return wrap

@@ -1,0 +1,157 @@
+// Native IO runtime for multigpu_advectiondiffusion_tpu.
+//
+// TPU-native equivalent of the reference's host-side IO/tooling layer
+// (MultiGPU/Diffusion3d_Baseline/Tools.c: SaveBinary3D :91-119, Save3D
+// ASCII :68-86, Merge_domains :204-223). The reference writes float32
+// binaries synchronously on rank 0 after a hand-rolled MPI gather; here
+// the writer is a small C library driven from Python via ctypes: the
+// double-buffered async writer lets the solver keep stepping while the
+// previous snapshot drains to disk (the role the reference's pinned host
+// buffers + DtH copies played for output).
+//
+// Build: make -C native    (produces libtpucfd_io.so)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Synchronous float32 raw writer (SaveBinary3D layout: x fastest).
+// Returns 0 on success, -1 on failure.
+// ---------------------------------------------------------------------
+int save_binary_f32(const char* path, const float* data, size_t count) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  size_t written = std::fwrite(data, sizeof(float), count, f);
+  int rc = (written == count) ? 0 : -1;
+  if (std::fclose(f) != 0) rc = -1;
+  return rc;
+}
+
+// ---------------------------------------------------------------------
+// Synchronous ASCII writer (Save3D layout: one %g per line).
+// ---------------------------------------------------------------------
+int save_ascii_f64(const char* path, const double* data, size_t count) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  for (size_t i = 0; i < count; ++i) {
+    if (std::fprintf(f, "%g\n", data[i]) < 0) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  return std::fclose(f) == 0 ? 0 : -1;
+}
+
+int load_binary_f32(const char* path, float* out, size_t count) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  size_t got = std::fread(out, sizeof(float), count, f);
+  std::fclose(f);
+  return got == count ? 0 : -1;
+}
+
+// ---------------------------------------------------------------------
+// Async double-buffered writer.
+//
+// writer_create(n) -> handle with n queue slots; writer_submit copies the
+// snapshot into an owned buffer and returns immediately; a background
+// thread drains the queue. writer_flush blocks until empty;
+// writer_destroy flushes and frees. All functions return 0 on success.
+// ---------------------------------------------------------------------
+namespace {
+
+struct Job {
+  std::string path;
+  std::vector<float> data;
+};
+
+struct Writer {
+  std::thread thread;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_done;
+  std::queue<Job> jobs;
+  size_t max_queue;
+  std::atomic<int> error{0};
+  bool stop = false;
+  size_t in_flight = 0;
+
+  explicit Writer(size_t slots) : max_queue(slots ? slots : 1) {
+    thread = std::thread([this] { run(); });
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      cv_push.wait(lk, [this] { return stop || !jobs.empty(); });
+      if (jobs.empty()) {
+        if (stop) return;
+        continue;
+      }
+      Job job = std::move(jobs.front());
+      jobs.pop();
+      ++in_flight;
+      lk.unlock();
+      if (save_binary_f32(job.path.c_str(), job.data.data(),
+                          job.data.size()) != 0) {
+        error.store(-1);
+      }
+      lk.lock();
+      --in_flight;
+      cv_done.notify_all();
+    }
+  }
+
+  int submit(const char* path, const float* data, size_t count) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_done.wait(lk, [this] { return jobs.size() < max_queue; });
+    Job job;
+    job.path = path;
+    job.data.assign(data, data + count);
+    jobs.push(std::move(job));
+    cv_push.notify_one();
+    return error.load();
+  }
+
+  int flush() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_done.wait(lk, [this] { return jobs.empty() && in_flight == 0; });
+    return error.load();
+  }
+
+  ~Writer() {
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_done.wait(lk, [this] { return jobs.empty() && in_flight == 0; });
+      stop = true;
+      cv_push.notify_all();
+    }
+    thread.join();
+  }
+};
+
+}  // namespace
+
+void* writer_create(size_t queue_slots) { return new Writer(queue_slots); }
+
+int writer_submit(void* handle, const char* path, const float* data,
+                  size_t count) {
+  return static_cast<Writer*>(handle)->submit(path, data, count);
+}
+
+int writer_flush(void* handle) {
+  return static_cast<Writer*>(handle)->flush();
+}
+
+void writer_destroy(void* handle) { delete static_cast<Writer*>(handle); }
+
+}  // extern "C"
