@@ -6,21 +6,21 @@ Laplacian + SSP-RK3 hot loop of ``MultiGPU/Diffusion3d_Baseline``
 2 GPUs ≈ 731 MLUPS total, ``Run.m:4-13``; derivation in BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Timing methodology (sync via device→host fetch, fixed overhead
+subtracted): see ``multigpu_advectiondiffusion_tpu/bench/timing.py``.
 """
 
 from __future__ import annotations
 
 import json
-import time
 
 
 BASELINE_MLUPS = 731.0  # MultiGPU Diffusion3d, 2 GPUs total (BASELINE.md)
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
+    from multigpu_advectiondiffusion_tpu.bench.timing import timed_run
     from multigpu_advectiondiffusion_tpu import DiffusionConfig, DiffusionSolver, Grid
     from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
     from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
@@ -34,18 +34,8 @@ def main() -> None:
     state = solver.initial_state()
 
     iters = 101
-    # warm-up + compile
-    out = solver.run(state, iters)
-    out.u.block_until_ready()
-
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = solver.run(state, iters)
-        out.u.block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-
-    rate = mlups(grid.num_cells, iters, STAGES[cfg.integrator], best)
+    elapsed = timed_run(solver, state, iters).seconds
+    rate = mlups(grid.num_cells, iters, STAGES[cfg.integrator], elapsed)
     print(
         json.dumps(
             {

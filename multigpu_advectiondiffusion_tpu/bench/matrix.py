@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import time
 from typing import Optional, Tuple
+
+from multigpu_advectiondiffusion_tpu.bench.timing import timed_run
 
 BASELINES_MLUPS = {
     # name -> (reference MLUPS, reference source)
@@ -100,17 +101,10 @@ def run_case(
     solver = build_solver(case, dtype, grid_xyz, mesh_spec)
     state = solver.initial_state()
 
-    t0 = time.perf_counter()
-    out = solver.run(state, 1)
-    out.u.block_until_ready()
-    compile_s = time.perf_counter() - t0
-
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = solver.run(state, iters)
-        out.u.block_until_ready()
-        best = min(best, time.perf_counter() - t0)
+    timed = timed_run(solver, state, iters, reps=repeats)
+    best = timed.seconds
+    # warm-up = compile + one full execution of the benchmarked program
+    compile_s = max(timed.warmup_seconds - best, 0.0)
 
     cells = 1
     for g in grid_xyz:

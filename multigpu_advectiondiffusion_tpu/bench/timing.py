@@ -1,0 +1,65 @@
+"""Device-honest wall-clock timing for benchmarks.
+
+On the tunneled TPU platform this container attaches (PJRT plugin
+``axon``), ``Array.block_until_ready`` returns before the device work has
+completed — measured directly: an 8192x8192 bf16 matmul "finishes" in
+~70 us (an impossible 15.8 PFLOP/s), while forcing a device→host data
+dependency yields a plausible ~34 TFLOP/s. The only trustworthy
+synchronization point is therefore an actual host fetch; :func:`sync`
+fetches a scalar reduction of the result, which (a) depends on every
+element of every shard, and (b) is replicated, so it is addressable from
+any process in multi-host runs.
+
+The fetch and the reduction cost a fixed overhead per call, so
+:func:`timed_run` measures a zero-iteration run of the same jitted
+program (same shapes, same sync) and subtracts it. This mirrors the
+reference's accounting, which reports *kernel* time with the HtD/DtH
+transfer segments timed separately between MPI barriers
+(``MultiGPU/Diffusion3d_Baseline/main.c:139-147,184-187,305-307``), so
+the MLUPS numbers remain comparable to the ``Run.m`` baselines. If the
+subtraction is in the noise (tiny --quick grids), the raw, unsubtracted
+time is used instead — conservative, never inflating.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+def sync(arr) -> None:
+    """Force completion of ``arr``'s producing computation via a
+    device→host fetch that depends on all elements of all shards."""
+    float(jnp.sum(arr))
+
+
+class TimedRun(NamedTuple):
+    seconds: float  # best-of-reps net execution time
+    warmup_seconds: float  # compile + first full execution + sync
+
+
+def timed_run(solver, state, iters: int, reps: int = 3) -> TimedRun:
+    """Best-of-``reps`` net seconds for ``solver.run(state, iters)``."""
+    reps = max(1, reps)
+    t0 = time.perf_counter()
+    sync(solver.run(state, iters).u)  # compile + warm-up
+    warmup = time.perf_counter() - t0
+    sync(solver.run(state, 0).u)
+
+    bases, bests = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(solver.run(state, 0).u)
+        bases.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sync(solver.run(state, iters).u)
+        bests.append(time.perf_counter() - t0)
+    best, base = min(bests), min(bases)
+    net = best - base
+    # If the subtraction is within the observed jitter of the overhead
+    # measurement itself (tiny --quick grids), publish the raw time
+    # instead of a jitter-dominated rate — conservative, never inflating.
+    noise = max(bases) - base
+    return TimedRun(best if net <= noise else net, warmup)

@@ -10,8 +10,8 @@ import os
 import time
 from typing import Optional
 
-import jax
 
+from multigpu_advectiondiffusion_tpu.bench.timing import sync
 from multigpu_advectiondiffusion_tpu.models.base import SolverBase
 from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition, make_mesh
 from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
@@ -89,7 +89,7 @@ def run_solver(
         out = solver.run(state, 1)
     else:
         out = solver.step(state)
-    out.u.block_until_ready()
+    sync(out.u)
     compile_s = time.perf_counter() - t0
 
     periodic = (snapshot_every or checkpoint_every) and iters is not None
@@ -115,7 +115,7 @@ def run_solver(
                         out,
                         grid=solver.grid,
                     )
-            out.u.block_until_ready()
+            sync(out.u)
             best = time.perf_counter() - t0
     else:
         for _ in range(max(1, repeats)):
@@ -124,7 +124,7 @@ def run_solver(
                 out = solver.run(state, iters)
             else:
                 out = solver.advance_to(state, t_end)
-            out.u.block_until_ready()
+            sync(out.u)
             best = min(best, time.perf_counter() - t0)
 
     n_iters = iters if iters is not None else max(1, int(out.it) or 1)

@@ -16,6 +16,13 @@ from typing import Dict, Optional
 import jax
 
 
+def _fetch_sync(x):
+    if hasattr(x, "dtype"):
+        from multigpu_advectiondiffusion_tpu.bench.timing import sync
+
+        sync(x)
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Capture a device trace: ``with trace('/tmp/trace'): run(...)``.
@@ -43,7 +50,9 @@ class Stopwatch:
             yield
         finally:
             if sync is not None:
-                jax.block_until_ready(sync)
+                # Host-fetch sync, not block_until_ready — see bench/timing.py
+                # for why the latter is untrustworthy on tunneled platforms.
+                jax.tree.map(_fetch_sync, sync)
             self.segments[name] = (
                 self.segments.get(name, 0.0) + time.perf_counter() - t0
             )
