@@ -14,11 +14,16 @@ The kernel consumes a *pre-padded* array: BC ghost cells or ``ppermute``
 halo cells are attached by the caller (``ops.laplacian.laplacian``), so
 one kernel serves both execution worlds. Corner ghost regions are never
 read (13-point cross stencil).
+
+Mosaic tiling note: HBM→VMEM slab DMAs slice only the leading (untiled)
+axis; the trailing two axes are copied whole, so their extents must be
+multiples of the f32 (8, 128) tile — the caller-side ``align_trailing``
+pad guarantees that. Value slices *inside* the kernel carry no such
+restriction.
 """
 
 from __future__ import annotations
 
-import math
 from typing import Sequence
 
 import jax
@@ -29,9 +34,38 @@ from jax.experimental.pallas import tpu as pltpu
 R = 2  # stencil radius of the O4 second derivative
 _C = (-1.0, 16.0, -30.0, 16.0, -1.0)  # /12 dx^2 (Laplace3d.m:22-25)
 
+# f32 VMEM tile: (sublane, lane) = (8, 128)
+SUBLANE, LANE = 8, 128
+
+# Conservative per-kernel VMEM budget (bytes) for whole-array 2-D kernels.
+VMEM_BUDGET = 12 * 1024 * 1024
+
+# Scoped-VMEM ceiling passed to Mosaic (the 16 MiB default is far below
+# the chip's physical VMEM and rejects reference-scale slabs).
+VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def compiler_params():
+    return pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT)
+
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def align_trailing(up: jnp.ndarray) -> jnp.ndarray:
+    """Zero-pad the trailing two axes to (8, 128)-tile multiples so slab
+    DMAs are expressible; the pad region feeds no interior output."""
+    sl = _round_up(up.shape[-2], SUBLANE)
+    ln = _round_up(up.shape[-1], LANE)
+    if (sl, ln) == up.shape[-2:]:
+        return up
+    pw = [(0, 0)] * (up.ndim - 2) + [(0, sl - up.shape[-2]), (0, ln - up.shape[-1])]
+    return jnp.pad(up, pw)
 
 
 def pick_block(n: int, target: int = 8) -> int:
@@ -73,18 +107,18 @@ def laplacian_o4_3d(
     nzp, nyp, nxp = up.shape
     nz, ny, nx = nzp - 2 * R, nyp - 2 * R, nxp - 2 * R
     bz = block_z or pick_block(nz)
+    up = align_trailing(up)
     # identical association order to the XLA path (ops.laplacian.laplacian):
     # per-axis stencil scaled by 1/(12 dx^2), then multiplied by K_axis.
     scales = [1.0 / (12.0 * spacing[a] * spacing[a]) for a in range(3)]
 
     def kernel(up_hbm, out_ref, slab, sem):
         k = pl.program_id(0)
-        pltpu.make_async_copy(
+        cp = pltpu.make_async_copy(
             up_hbm.at[pl.ds(k * bz, bz + 2 * R)], slab, sem
-        ).start()
-        pltpu.make_async_copy(
-            up_hbm.at[pl.ds(k * bz, bz + 2 * R)], slab, sem
-        ).wait()
+        )
+        cp.start()
+        cp.wait()
         u = slab[:]
         shape = (bz, ny, nx)
         lead = (R, R, R)
@@ -102,10 +136,11 @@ def laplacian_o4_3d(
         ),
         out_shape=jax.ShapeDtypeStruct((nz, ny, nx), up.dtype),
         scratch_shapes=[
-            pltpu.VMEM((bz + 2 * R, nyp, nxp), up.dtype),
+            pltpu.VMEM((bz + 2 * R,) + up.shape[1:], up.dtype),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=_interpret(),
+        compiler_params=None if _interpret() else compiler_params(),
     )(up)
 
 
@@ -113,24 +148,20 @@ def laplacian_o4_2d(
     up: jnp.ndarray,
     spacing: Sequence[float],
     diffusivity: Sequence[float],
-    block_y: int | None = None,
 ) -> jnp.ndarray:
-    """2-D variant: ``up`` is ``(ny+4, nx+4)``, blocked over y."""
+    """2-D variant: ``up`` is ``(ny+4, nx+4)``, whole array VMEM-resident.
+
+    2-D grids at reference scale (1001², ``SingleGPU/Diffusion2d/Run.m``)
+    fit VMEM outright, so no slab pipeline is needed; ``supported`` gates
+    larger grids back to the XLA path.
+    """
     nyp, nxp = up.shape
     ny, nx = nyp - 2 * R, nxp - 2 * R
-    by = block_y or pick_block(ny, 128)
     scales = [1.0 / (12.0 * spacing[a] * spacing[a]) for a in range(2)]
 
-    def kernel(up_hbm, out_ref, slab, sem):
-        j = pl.program_id(0)
-        pltpu.make_async_copy(
-            up_hbm.at[pl.ds(j * by, by + 2 * R)], slab, sem
-        ).start()
-        pltpu.make_async_copy(
-            up_hbm.at[pl.ds(j * by, by + 2 * R)], slab, sem
-        ).wait()
-        u = slab[:]
-        shape = (by, nx)
+    def kernel(up_ref, out_ref):
+        u = up_ref[:]
+        shape = (ny, nx)
         lead = (R, R)
         acc = diffusivity[0] * _axis_term(u, 0, scales[0], lead, shape)
         acc += diffusivity[1] * _axis_term(u, 1, scales[1], lead, shape)
@@ -138,20 +169,28 @@ def laplacian_o4_2d(
 
     return pl.pallas_call(
         kernel,
-        grid=(ny // by,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(
-            (by, nx), lambda j: (j, 0), memory_space=pltpu.VMEM
-        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((ny, nx), up.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((by + 2 * R, nxp), up.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
         interpret=_interpret(),
+        compiler_params=None if _interpret() else compiler_params(),
     )(up)
+
+
+def fits_vmem(shape: Sequence[int], halo: int, n_live: int) -> bool:
+    """Whether a whole-array 2-D kernel with ``n_live`` full-size live
+    intermediates fits the conservative VMEM budget after tile rounding."""
+    rows = _round_up(shape[0] + 2 * halo, SUBLANE)
+    cols = _round_up(shape[1] + 2 * halo, LANE)
+    return n_live * rows * cols * 4 <= VMEM_BUDGET
 
 
 def supported(shape: Sequence[int], order: int) -> bool:
     """Whether the Pallas path covers this problem (else XLA fallback)."""
-    return order == 4 and len(shape) in (2, 3)
+    if order != 4:
+        return False
+    if len(shape) == 3:
+        return True
+    if len(shape) == 2:
+        return fits_vmem(shape, R, 3)
+    return False

@@ -25,7 +25,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from multigpu_advectiondiffusion_tpu.ops.flux import Flux
-from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import pick_block
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    align_trailing,
+    compiler_params,
+    pick_block,
+)
 
 R = 3  # WENO5 stencil radius
 
@@ -69,39 +73,43 @@ def flux_divergence_pallas(
 ) -> jnp.ndarray:
     """``d f(u)/dx`` along ``axis`` of an array padded by 3 on that axis.
 
-    3-D arrays are processed in z-slabs (y-slabs for 2-D); the sweep axis
-    may be any axis, including the blocked one (the slab then carries the
-    halo in-block).
+    3-D arrays are processed in z-slabs; the sweep axis may be any axis,
+    including the blocked one (the slab then carries the halo in-block).
+    Slab DMAs slice only the leading (untiled) axis, with the trailing
+    axes tile-aligned by ``align_trailing``; 2-D grids at reference scale
+    fit VMEM whole, so they use a single-block kernel.
     """
+    if up.ndim == 2:
+        # whole-array kernel: `block` has no meaning (supported() gates size)
+        return _flux_divergence_2d(up, axis, dx, flux, variant)
+
     ndim = up.ndim
     shape = list(up.shape)
     shape[axis] -= 2 * R
     n = shape[axis]  # output length along the sweep axis
     lead_axis = 0  # block over the leading axis
-    nb_padded = up.shape[0]
     nb = shape[0]
-    b = block or pick_block(nb, 8 if ndim == 3 else 128)
+    b = block or pick_block(nb, 8)
     halo_lead = 2 * R if axis == lead_axis else 0
+    up = align_trailing(up)
 
     def kernel(up_hbm, out_ref, slab, sem):
         k = pl.program_id(0)
-        pltpu.make_async_copy(
+        cp = pltpu.make_async_copy(
             up_hbm.at[pl.ds(k * b, b + halo_lead)], slab, sem
-        ).start()
-        pltpu.make_async_copy(
-            up_hbm.at[pl.ds(k * b, b + halo_lead)], slab, sem
-        ).wait()
+        )
+        cp.start()
+        cp.wait()
         window = slab[:]
         h = _face_flux(window, axis, (b if axis == lead_axis else n) + 1,
                        flux, variant)
-        lo = [slice(None)] * ndim
-        hi = [slice(None)] * ndim
-        lo[axis] = slice(0, b if axis == lead_axis else n)
-        hi[axis] = slice(1, (b if axis == lead_axis else n) + 1)
-        out_ref[:] = (h[tuple(hi)] - h[tuple(lo)]) * (1.0 / dx)
+        idx_lo = [slice(0, e) for e in (b,) + tuple(shape[1:])]
+        idx_hi = list(idx_lo)
+        idx_lo[axis] = slice(0, b if axis == lead_axis else n)
+        idx_hi[axis] = slice(1, (b if axis == lead_axis else n) + 1)
+        out_ref[:] = (h[tuple(idx_hi)] - h[tuple(idx_lo)]) * (1.0 / dx)
 
-    slab_shape = list(up.shape)
-    slab_shape[0] = b + halo_lead
+    slab_shape = (b + halo_lead,) + up.shape[1:]
     out_block = list(shape)
     out_block[0] = b
 
@@ -116,12 +124,52 @@ def flux_divergence_pallas(
         ),
         out_shape=jax.ShapeDtypeStruct(tuple(shape), up.dtype),
         scratch_shapes=[
-            pltpu.VMEM(tuple(slab_shape), up.dtype),
+            pltpu.VMEM(slab_shape, up.dtype),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=_interpret(),
+        compiler_params=None if _interpret() else compiler_params(),
     )(up)
 
 
-def supported(ndim: int, order: int, variant: str) -> bool:
-    return order == 5 and variant in ("js", "z") and ndim in (2, 3)
+def _flux_divergence_2d(
+    up: jnp.ndarray, axis: int, dx: float, flux: Flux, variant: str
+) -> jnp.ndarray:
+    """Whole-array VMEM kernel for 2-D sweeps (size-gated by ``supported``)."""
+    shape = list(up.shape)
+    shape[axis] -= 2 * R
+    n = shape[axis]
+
+    def kernel(up_ref, out_ref):
+        window = up_ref[:]
+        h = _face_flux(window, axis, n + 1, flux, variant)
+        idx_lo = [slice(0, e) for e in shape]
+        idx_hi = list(idx_lo)
+        idx_lo[axis] = slice(0, n)
+        idx_hi[axis] = slice(1, n + 1)
+        out_ref[:] = (h[tuple(idx_hi)] - h[tuple(idx_lo)]) * (1.0 / dx)
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(tuple(shape), up.dtype),
+        interpret=_interpret(),
+        compiler_params=None if _interpret() else compiler_params(),
+    )(up)
+
+
+def supported(ndim: int, order: int, variant: str, shape=None) -> bool:
+    if order != 5 or variant not in ("js", "z"):
+        return False
+    if ndim == 3:
+        return True
+    if ndim == 2:
+        from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+            fits_vmem,
+        )
+
+        # shape is required to size-gate the whole-array 2-D kernel
+        # (~10 live full-size intermediates: vp/vm shifts, betas, weights).
+        return shape is not None and fits_vmem(shape, R, 10)
+    return False
