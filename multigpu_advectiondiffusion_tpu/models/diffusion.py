@@ -173,6 +173,60 @@ class DiffusionSolver(SolverBase):
         return LocalPhysics(rhs=rhs, static_dt=self.dt, post=post)
 
     # ------------------------------------------------------------------ #
+    # Fully-fused Pallas fast path (single chip, reference-parity walls)
+    # ------------------------------------------------------------------ #
+    def _fused_stepper(self):
+        """The fused SSP-RK3 stepper when this config is eligible, else
+        ``None`` (generic path). Eligibility mirrors the assumptions the
+        kernel bakes in: frozen Dirichlet ghosts/boundary band, static dt,
+        3-D cartesian O4, one chip, f32."""
+        cfg = self.cfg
+        bcs = self.bcs
+        eligible = (
+            cfg.impl == "pallas"
+            and self.mesh is None
+            and cfg.geometry == "cartesian"
+            and cfg.order == 4
+            and cfg.integrator == "ssp_rk3"
+            and cfg.source is None
+            and cfg.reference_parity
+            and cfg.boundary_band >= 1  # kernel's face clamp lives inside
+            # the non-interior branch; band 0 would let faces evolve
+            and self.grid.ndim == 3
+            and self.dtype == jnp.float32
+            and all(b.kind == "dirichlet" for b in bcs)
+            and all(b.value == bcs[0].value for b in bcs)
+        )
+        if not eligible:
+            return None
+        if "fused" not in self._cache:
+            from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+                FusedDiffusionStepper,
+            )
+
+            self._cache["fused"] = FusedDiffusionStepper(
+                self.grid.shape,
+                self.dtype,
+                self.grid.spacing,
+                [cfg.diffusivity] * 3,
+                self.dt,
+                cfg.boundary_band,
+                bcs[0].value,
+            )
+        return self._cache["fused"]
+
+    def run(self, state: SolverState, num_iters: int) -> SolverState:
+        fused = self._fused_stepper()
+        if fused is None:
+            return super().run(state, num_iters)
+        f = self._compiled(
+            ("fused_run", num_iters),
+            lambda: jax.jit(lambda u, t: fused.run(u, t, num_iters)),
+        )
+        u, t = f(state.u, state.t)
+        return SolverState(u=u, t=t, it=state.it + num_iters)
+
+    # ------------------------------------------------------------------ #
     # Analytic solution support (heat3d.m:36; heat2d_axisymmetric.m:39)
     # ------------------------------------------------------------------ #
     def exact_solution(self, t: float) -> jnp.ndarray:

@@ -58,6 +58,42 @@ def test_weno_pallas_matches_xla(ndim, axis, variant):
                                rtol=1e-4, atol=1e-6 * scale)
 
 
+def test_fused_diffusion_run_matches_xla():
+    """The fused single-kernel-per-stage fast path (run() with
+    impl='pallas' on an eligible config) must agree with the generic XLA
+    path to f32 rounding across a multi-step run."""
+    grid = Grid.make(24, 28, 36, lengths=10.0)
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = DiffusionConfig(grid=grid, dtype="float32", impl=impl)
+        solver = DiffusionSolver(cfg)
+        if impl == "pallas":
+            assert solver._fused_stepper() is not None, "fast path not taken"
+        st = solver.run(solver.initial_state(), 9)
+        outs[impl] = (np.asarray(st.u), float(st.t))
+    np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
+                               rtol=1e-5, atol=1e-6)
+    assert outs["pallas"][1] == outs["xla"][1]
+
+
+def test_fused_diffusion_ineligible_configs_fall_back():
+    """Configs outside the fused kernel's assumptions must quietly use
+    the generic path (and still run)."""
+    grid = Grid.make(16, 16, 16, lengths=10.0)
+    for kw in (
+        {"dtype": "float64"},
+        {"integrator": "ssp_rk2"},
+        {"bc": "periodic", "ic": "gaussian"},
+        {"reference_parity": False},
+        {"order": 2},
+        {"boundary_band": 0},
+    ):
+        cfg = DiffusionConfig(grid=grid, impl="pallas", **kw)
+        solver = DiffusionSolver(cfg)
+        assert solver._fused_stepper() is None, kw
+        solver.run(solver.initial_state(), 2)
+
+
 def test_diffusion_solver_pallas_impl():
     grid = Grid.make(32, 24, 16, lengths=10.0)
     outs = {}
