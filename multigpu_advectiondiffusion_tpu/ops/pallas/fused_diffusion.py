@@ -44,13 +44,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     LANE,
+    O4_COEFFS,
     R,
     SUBLANE,
-    _C,
-    _interpret,
-    _round_up,
     compiler_params,
+    interpret_mode,
     pick_block,
+    round_up,
 )
 
 # SSP-RK3 stage combinations u_next = a*u + b*(v + dt*L(v))
@@ -65,7 +65,7 @@ def _shift(x, off: int, axis: int):
     outputs are masked back to the stage input.
     """
     n = x.shape[axis]
-    if _interpret():
+    if interpret_mode():
         return jnp.roll(x, -off, axis)
     return pltpu.roll(x, (-off) % n, axis)
 
@@ -115,7 +115,7 @@ def _stage_kernel(
     # per-axis-then-scale association by ~1 ulp per term.
     acc = None
     for axis in range(3):
-        for j, c in enumerate(_C):
+        for j, c in enumerate(O4_COEFFS):
             coef = jnp.asarray(c * scales[axis], dtype)
             term = (v[j : j + bz] if axis == 0 else _shift(vc, j - R, axis)) * coef
             acc = term if acc is None else acc + term
@@ -201,8 +201,8 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
         out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
         scratch_shapes=scratch,
         input_output_aliases={n_in - 1: 0},  # last operand -> out
-        compiler_params=None if _interpret() else compiler_params(),
-        interpret=_interpret(),
+        compiler_params=None if interpret_mode() else compiler_params(),
+        interpret=interpret_mode(),
     )
 
 
@@ -215,8 +215,8 @@ class FusedDiffusionStepper:
         self.interior_shape = tuple(interior_shape)
         self.padded_shape = (
             nz + 2 * R,
-            _round_up(ny + 2 * R, SUBLANE),
-            _round_up(nx + 2 * R, LANE),
+            round_up(ny + 2 * R, SUBLANE),
+            round_up(nx + 2 * R, LANE),
         )
         self.dtype = jnp.dtype(dtype)
         self.bc_value = float(bc_value)
@@ -231,6 +231,11 @@ class FusedDiffusionStepper:
             )
             budget_rows = (60 * 1024 * 1024) // (7 * row_bytes)
             block_z = pick_block(nz, max(1, min(32, int(budget_rows))))
+        if nz % block_z != 0:
+            raise ValueError(
+                f"block_z={block_z} must divide nz={nz}; a non-divisor "
+                "would leave the top z-rows un-stepped"
+            )
         bz = block_z
         scales = [
             float(diffusivity[i]) / (12.0 * spacing[i] * spacing[i])
@@ -256,7 +261,6 @@ class FusedDiffusionStepper:
         self._step = step
 
     def embed(self, u):
-        nz, ny, nx = self.interior_shape
         full = jnp.full(self.padded_shape, self.bc_value, self.dtype)
         return lax.dynamic_update_slice(full, u.astype(self.dtype), (R, R, R))
 

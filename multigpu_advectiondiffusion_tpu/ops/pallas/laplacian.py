@@ -57,6 +57,14 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+# Public names for the pieces other Pallas modules build on
+# (fused_diffusion, weno): the O4 stencil, interpret-mode switch, and
+# tile rounding are this module's shared vocabulary, not file-locals.
+O4_COEFFS = _C
+interpret_mode = _interpret
+round_up = _round_up
+
+
 def align_trailing(up: jnp.ndarray) -> jnp.ndarray:
     """Zero-pad the trailing two axes to (8, 128)-tile multiples so slab
     DMAs are expressible; the pad region feeds no interior output."""
