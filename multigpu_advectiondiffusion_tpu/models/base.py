@@ -188,9 +188,22 @@ class SolverBase:
         u, t = f(state.u, state.t)
         return SolverState(u=u, t=t, it=state.it + 1)
 
+    def _fused_stepper(self):
+        """Solver-specific fully-fused fast path, or ``None`` (generic).
+        Overridden by solvers that have a fused Pallas stepper."""
+        return None
+
     def run(self, state: SolverState, num_iters: int) -> SolverState:
         """Fixed-count loop (the CUDA drivers' ``max_iters`` mode,
         ``MultiGPU/Diffusion3d_Baseline/main.c:189``)."""
+        fused = self._fused_stepper()
+        if fused is not None:
+            f = self._compiled(
+                ("fused_run", num_iters),
+                lambda: jax.jit(lambda u, t: fused.run(u, t, num_iters)),
+            )
+            u, t = f(state.u, state.t)
+            return SolverState(u=u, t=t, it=state.it + num_iters)
 
         def block(u, t):
             return lax.fori_loop(

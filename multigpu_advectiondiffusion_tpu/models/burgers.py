@@ -101,3 +101,46 @@ class BurgersSolver(SolverBase):
             return LocalPhysics(rhs=rhs, dt_fn=dt_fn)
         # CUDA-parity fixed dt: CFL * dx / 1.0 (Burgers3d_Baseline/main.c:193)
         return LocalPhysics(rhs=rhs, static_dt=cfg.cfl * min(spacing))
+
+    # ------------------------------------------------------------------ #
+    # Fully-fused Pallas fast path (single chip, fixed dt, edge BCs)
+    # ------------------------------------------------------------------ #
+    def _fused_stepper(self):
+        """The fused SSP-RK3 stepper when this config is eligible, else
+        ``None``. Eligibility mirrors the kernel's assumptions: 3-D
+        cartesian WENO5, edge ghosts, fixed dt (adaptive dt needs a
+        global reduction before stage 1), one chip, f32."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        eligible = (
+            cfg.impl == "pallas"
+            and self.mesh is None
+            and self.grid.ndim == 3
+            and cfg.weno_order == 5
+            and cfg.weno_variant in ("js", "z")
+            and cfg.integrator == "ssp_rk3"
+            and not cfg.adaptive_dt
+            and (cfg.nu == 0.0 or cfg.laplacian_order == 4)
+            and self.dtype == jnp.float32
+            and all(b.kind == "edge" for b in self.bcs)
+        )
+        if not eligible:
+            return None
+        from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+            FusedBurgersStepper,
+        )
+
+        if not FusedBurgersStepper.supported(self.grid.shape, self.dtype):
+            return None
+        if "fused" not in self._cache:
+            self._cache["fused"] = FusedBurgersStepper(
+                self.grid.shape,
+                self.dtype,
+                self.grid.spacing,
+                self.flux,
+                cfg.weno_variant,
+                cfg.nu,
+                cfg.cfl * min(self.grid.spacing),
+            )
+        return self._cache["fused"]

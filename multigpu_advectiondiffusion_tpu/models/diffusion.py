@@ -215,17 +215,6 @@ class DiffusionSolver(SolverBase):
             )
         return self._cache["fused"]
 
-    def run(self, state: SolverState, num_iters: int) -> SolverState:
-        fused = self._fused_stepper()
-        if fused is None:
-            return super().run(state, num_iters)
-        f = self._compiled(
-            ("fused_run", num_iters),
-            lambda: jax.jit(lambda u, t: fused.run(u, t, num_iters)),
-        )
-        u, t = f(state.u, state.t)
-        return SolverState(u=u, t=t, it=state.it + num_iters)
-
     # ------------------------------------------------------------------ #
     # Analytic solution support (heat3d.m:36; heat2d_axisymmetric.m:39)
     # ------------------------------------------------------------------ #

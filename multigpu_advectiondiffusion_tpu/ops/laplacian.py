@@ -85,7 +85,7 @@ def laplacian(
             laplacian as pallas_lap,
         )
 
-        if pallas_lap.supported(u.shape, order):
+        if pallas_lap.supported(u.shape, order, u.dtype.itemsize):
             up = u
             for axis in range(u.ndim):
                 up = padder(up, axis, r)

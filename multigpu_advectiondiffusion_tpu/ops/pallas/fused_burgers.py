@@ -1,0 +1,387 @@
+"""Fully-fused SSP-RK3 Burgers/WENO5 stepping on a persistent padded state.
+
+The reference's hot loop launches, per RK stage, three direction-sweep
+kernels (``Compute_dF/dG/dH``), an optional Laplacian, and an RK-update
+kernel, each streaming the full state through device memory
+(``SingleGPU/Burgers3d_WENO5/main.cpp:143-149``,
+``MultiGPU/Burgers3d_Baseline/main.c:201-301``). The generic JAX path here
+mirrors that structure (pad → per-axis WENO divergence → sum → axpy), and
+measures ~1 TFLOP/s effective on v5e — far under the VPU roof — because
+XLA materializes the split fluxes and interface fluxes between fusions.
+
+This module collapses each RK stage to ONE Pallas kernel: a z-slab of the
+state is DMA'd into VMEM once and all three WENO5 flux divergences, the
+viscous Laplacian (when ``nu > 0``), and the RK stage combination are
+evaluated in-register before the slab's core rows are written back.
+
+Layout and ghost discipline (mirrors ``fused_diffusion``):
+
+* The state lives in a *padded, tile-aligned* layout
+  ``(nz+6, round8(ny+6), round128(nx+6))`` for the whole run. All
+  non-interior cells hold edge-replicated values (the reference's
+  non-periodic ghost rule, ``WENO5resAdv_X.m:53``).
+* Each stage kernel re-synthesizes the ghost cells of its output rows
+  from the freshly computed interior (x/y via broadcast selects, the z
+  ghost rows via two small extra DMAs on the first/last grid block), so
+  the padded invariant holds at every stage boundary — equivalent to the
+  generic path's re-padding of ``u`` every stage.
+* y/x stencil reads use full-width circular shifts (``pltpu.roll``);
+  wrapped lanes land only in ghost/slack outputs, which the edge
+  synthesis overwrites. z reads are in-slab row slices (the slab carries
+  a 3-row halo).
+* Buffer choreography per step (three live padded buffers, zero allocs):
+  ``T1 = stage1(S)``, ``T2 = stage2(T1, S)``, ``S' = stage3(T2, S) → S``
+  with the final stage writing in place over ``S`` (each grid block reads
+  its ``u`` rows strictly before writing them; the TPU grid is a
+  sequential loop, so no other block races the ghost-row writes).
+
+Single-chip, fixed-dt only: the sharded world and the adaptive-dt mode
+(which needs a global ``max|f'(u)|`` reduction before stage 1) keep the
+generic ``shard_map``/XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multigpu_advectiondiffusion_tpu.ops.flux import Flux
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+    _STAGES,
+    _shift,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    LANE,
+    O4_COEFFS,
+    SUBLANE,
+    compiler_params,
+    interpret_mode,
+    round_up,
+)
+from multigpu_advectiondiffusion_tpu.ops.weno import (
+    _weno5_minus,
+    _weno5_plus,
+)
+
+R = 3  # WENO5 stencil radius == persistent ghost width
+
+# Conservative VMEM budget for the per-block working set. The physical
+# VMEM is 128 MiB; the Mosaic scoped ceiling we request is 100 MiB
+# (laplacian.VMEM_LIMIT); leave headroom for double-buffered DMAs.
+_VMEM_BUDGET = 80 * 1024 * 1024
+
+# Live row-sized buffers per block, by slab height h = bz + 2R and face
+# height f = bz + 1: slab + vp + vm (3h) + one axis' WENO working set
+# (~13f: 5+5 shifted operands, betas, weights, interface flux) + rhs
+# accumulator, RK result, u rows (~4 bz). Mosaic's true liveness grows
+# faster with bz than this model (a bz=8 variant at 256^3 exceeded the
+# 128 MiB physical VMEM while the model said 77 MiB), and measured
+# throughput is flat from bz=1 to bz=2 — the kernel is VPU-bound, so the
+# z-halo re-read that a larger bz would amortize is already hidden.
+# Hence the hard bz <= 2 cap.
+_MAX_BZ = 2
+
+
+def _live_bytes(bz: int, row_bytes: int) -> int:
+    return (3 * (bz + 2 * R) + 13 * (bz + 1) + 4 * bz) * row_bytes
+
+
+def _pick_bz(nz: int, row_bytes: int) -> int | None:
+    for bz in range(min(_MAX_BZ, nz), 0, -1):
+        if nz % bz == 0 and _live_bytes(bz, row_bytes) <= _VMEM_BUDGET:
+            return bz
+    return None
+
+
+def _split(flux: Flux, v):
+    """Local Lax–Friedrichs splitting ``f± = (f(v) ± |f'(v)| v)/2``
+    (``WENO5resAdv_X.m:58-60``)."""
+    a = jnp.abs(flux.df(v))
+    fu = flux.f(v)
+    return 0.5 * (fu + a * v), 0.5 * (fu - a * v)
+
+
+def _div_roll(vp, vm, axis, inv_dx, variant):
+    """Flux divergence along a y/x axis of core rows via circular shifts.
+
+    ``hface[i]`` (interface right of cell i) = WENO5⁻(vp[i-2..i+2]) +
+    WENO5⁺(vm[i-1..i+3]); divergence = (hface[i] - hface[i-1]) / dx.
+    Wrapped lanes touch only ghost/slack outputs (masked by the caller's
+    edge synthesis).
+    """
+    qp = [_shift(vp, off, axis) for off in range(-2, 3)]
+    qm = [_shift(vm, off, axis) for off in range(-1, 4)]
+    h = _weno5_minus(*qp, variant) + _weno5_plus(*qm, variant)
+    return (h - _shift(h, -1, axis)) * inv_dx
+
+
+def _div_z(vp, vm, bz, inv_dx, variant):
+    """Flux divergence along z of the ``bz`` core rows via slab slices.
+
+    Face row ``s`` of the ``bz+1`` interface rows sits right of slab row
+    ``R-1+s``; its minus stencil reads vp rows ``s..s+4``, its plus
+    stencil vm rows ``s+1..s+5`` — exactly the 2R+bz rows of the slab.
+    """
+    qp = [vp[j : j + bz + 1] for j in range(5)]
+    qm = [vm[j + 1 : j + 2 + bz] for j in range(5)]
+    h = _weno5_minus(*qp, variant) + _weno5_plus(*qm, variant)
+    return (h[1:] - h[:-1]) * inv_dx
+
+
+def _laplacian(v, vc, bz, scales):
+    """O4 Laplacian of the core rows (radius 2 < R, fits the same halo)."""
+    acc = None
+    for axis in range(3):
+        for j, c in enumerate(O4_COEFFS):
+            coef = jnp.asarray(c * scales[axis], v.dtype)
+            term = (
+                v[j + 1 : j + 1 + bz] if axis == 0
+                else _shift(vc, j - 2, axis)
+            ) * coef
+            acc = term if acc is None else acc + term
+    return acc
+
+
+def _edge_fill(rk, ny, nx):
+    """Overwrite every non-interior y/x cell with the edge-replicated
+    interior value (``WENO5resAdv_X.m:53``); corners/slack included."""
+    gy = lax.broadcasted_iota(jnp.int32, rk.shape, 1) - R
+    gx = lax.broadcasted_iota(jnp.int32, rk.shape, 2) - R
+    t = jnp.where(gx < 0, rk[:, :, R : R + 1], rk)
+    t = jnp.where(gx >= nx, t[:, :, R + nx - 1 : R + nx], t)
+    t = jnp.where(gy < 0, t[:, R : R + 1, :], t)
+    return jnp.where(gy >= ny, t[:, R + ny - 1 : R + ny, :], t)
+
+
+def _stage_kernel(
+    v_hbm,
+    u_hbm,
+    out_hbm,
+    vs,
+    us,
+    res,
+    gres,
+    sem_v,
+    sem_u,
+    sem_w,
+    sem_g,
+    *,
+    bz: int,
+    n_blocks: int,
+    interior_shape: Sequence[int],
+    inv_dx: Sequence[float],
+    nu_scales: Sequence[float] | None,
+    flux: Flux,
+    variant: str,
+    a: float,
+    b: float,
+    dt: float,
+):
+    nz, ny, nx = interior_shape
+    k = pl.program_id(0)
+
+    cp_v = pltpu.make_async_copy(v_hbm.at[pl.ds(k * bz, bz + 2 * R)], vs, sem_v)
+    cp_v.start()
+    if us is not None:
+        src = u_hbm if u_hbm is not None else out_hbm
+        cp_u = pltpu.make_async_copy(src.at[pl.ds(R + k * bz, bz)], us, sem_u)
+        cp_u.start()
+        cp_u.wait()
+    cp_v.wait()
+
+    v = vs[:]
+    vc = v[R : R + bz]
+    dtype = v.dtype
+
+    # Split fluxes over the whole slab (z needs the halo rows); the y/x
+    # sweeps use only the core-row slice of the same arrays.
+    vp, vm = _split(flux, v)
+    rhs = -(
+        _div_z(vp, vm, bz, inv_dx[0], variant)
+        + _div_roll(vp[R : R + bz], vm[R : R + bz], 1, inv_dx[1], variant)
+        + _div_roll(vp[R : R + bz], vm[R : R + bz], 2, inv_dx[2], variant)
+    )
+    if nu_scales is not None:
+        rhs = rhs + _laplacian(v, vc, bz, nu_scales)
+
+    rk = b * (vc + dt * rhs) if a == 0.0 else a * us[:] + b * (vc + dt * rhs)
+    res[:] = _edge_fill(rk.astype(dtype), ny, nx)
+
+    cp_w = pltpu.make_async_copy(res, out_hbm.at[pl.ds(R + k * bz, bz)], sem_w)
+    cp_w.start()
+    cp_w.wait()
+
+    # z ghost rows: replicate the new boundary interior row (edge BC).
+    @pl.when(k == 0)
+    def _():
+        gres[:] = jnp.broadcast_to(res[0:1], gres.shape)
+        cp = pltpu.make_async_copy(gres, out_hbm.at[pl.ds(0, R)], sem_g)
+        cp.start()
+        cp.wait()
+
+    @pl.when(k == n_blocks - 1)
+    def _():
+        gres[:] = jnp.broadcast_to(res[bz - 1 : bz], gres.shape)
+        cp = pltpu.make_async_copy(gres, out_hbm.at[pl.ds(R + nz, R)], sem_g)
+        cp.start()
+        cp.wait()
+
+
+def _make_stage(padded_shape, interior_shape, dtype, *, bz, inv_dx, nu_scales,
+                flux, variant, a, b, dt, u_source):
+    """One fused RK-stage call; output aliased onto the last operand.
+
+    ``u_source`` as in ``fused_diffusion._make_stage``: ``"none"`` /
+    ``"operand"`` / ``"target"`` (in-place final stage).
+    """
+    nz = interior_shape[0]
+    trailing = padded_shape[1:]
+    use_u = u_source != "none"
+    n_blocks = nz // bz
+
+    kern = functools.partial(
+        _stage_kernel,
+        bz=bz,
+        n_blocks=n_blocks,
+        interior_shape=tuple(interior_shape),
+        inv_dx=tuple(inv_dx),
+        nu_scales=None if nu_scales is None else tuple(nu_scales),
+        flux=flux,
+        variant=variant,
+        a=a,
+        b=b,
+        dt=dt,
+    )
+
+    def kernel(*refs):
+        if u_source == "operand":
+            (v_hbm, u_hbm, _tgt, out_hbm, vs, us, res, gres,
+             sem_v, sem_u, sem_w, sem_g) = refs
+        elif u_source == "target":
+            (v_hbm, _tgt, out_hbm, vs, us, res, gres,
+             sem_v, sem_u, sem_w, sem_g) = refs
+            u_hbm = None  # read from out_hbm (in place)
+        else:
+            v_hbm, _tgt, out_hbm, vs, res, gres, sem_v, sem_w, sem_g = refs
+            u_hbm, us, sem_u = None, None, None
+        kern(v_hbm, u_hbm, out_hbm, vs, us, res, gres,
+             sem_v, sem_u, sem_w, sem_g)
+
+    n_in = 3 if u_source == "operand" else 2
+    scratch = [pltpu.VMEM((bz + 2 * R,) + trailing, dtype)]
+    if use_u:
+        scratch.append(pltpu.VMEM((bz,) + trailing, dtype))
+    scratch.append(pltpu.VMEM((bz,) + trailing, dtype))
+    scratch.append(pltpu.VMEM((R,) + trailing, dtype))
+    scratch.append(pltpu.SemaphoreType.DMA)
+    if use_u:
+        scratch.append(pltpu.SemaphoreType.DMA)
+    scratch.append(pltpu.SemaphoreType.DMA)
+    scratch.append(pltpu.SemaphoreType.DMA)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_in,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
+        scratch_shapes=scratch,
+        input_output_aliases={n_in - 1: 0},  # last operand -> out
+        compiler_params=None if interpret_mode() else compiler_params(),
+        interpret=interpret_mode(),
+    )
+
+
+class FusedBurgersStepper:
+    """Jit-cached fused runner for one (grid, flux, dtype, dt) config.
+
+    Returns ``None``-equivalent via :func:`supported` when the working
+    set cannot fit VMEM even at ``bz = 1``.
+    """
+
+    def __init__(self, interior_shape, dtype, spacing, flux: Flux,
+                 variant: str, nu: float, dt: float, block_z=None):
+        nz, ny, nx = interior_shape
+        self.interior_shape = tuple(interior_shape)
+        self.padded_shape = (
+            nz + 2 * R,
+            round_up(ny + 2 * R, SUBLANE),
+            round_up(nx + 2 * R, LANE),
+        )
+        self.dtype = jnp.dtype(dtype)
+        row_bytes = (
+            self.padded_shape[1] * self.padded_shape[2] * self.dtype.itemsize
+        )
+        bz = block_z if block_z is not None else _pick_bz(nz, row_bytes)
+        if bz is None or nz % bz != 0:
+            raise ValueError(
+                f"no viable z-block for nz={nz} at row size {row_bytes} B"
+            )
+        inv_dx = [1.0 / spacing[i] for i in range(3)]
+        nu_scales = None
+        if nu:
+            nu_scales = [
+                float(nu) / (12.0 * spacing[i] * spacing[i]) for i in range(3)
+            ]
+        sources = ("none", "operand", "target")
+        s1, s2, s3 = (
+            _make_stage(
+                self.padded_shape, self.interior_shape, self.dtype,
+                bz=bz, inv_dx=inv_dx, nu_scales=nu_scales, flux=flux,
+                variant=variant, a=a, b=b, dt=float(dt), u_source=src,
+            )
+            for (a, b), src in zip(_STAGES, sources)
+        )
+        self.dt = float(dt)
+        self.block_z = bz
+
+        def step(S, T1, T2):
+            T1 = s1(S, T1)       # u1 = u - dt div f(u) [+ nu lap]
+            T2 = s2(T1, S, T2)   # u2 = 3/4 u + 1/4 (u1 + dt rhs(u1))
+            S = s3(T2, S)        # u  = 1/3 u + 2/3 (u2 + dt rhs(u2))
+            return S, T1, T2
+
+        self._step = step
+
+    @staticmethod
+    def supported(interior_shape, dtype) -> bool:
+        nz, ny, nx = interior_shape
+        row_bytes = (
+            round_up(ny + 2 * R, SUBLANE)
+            * round_up(nx + 2 * R, LANE)
+            * jnp.dtype(dtype).itemsize
+        )
+        return _pick_bz(nz, row_bytes) is not None
+
+    def embed(self, u):
+        nz, ny, nx = self.interior_shape
+        pz, py, px = self.padded_shape
+        return jnp.pad(
+            u.astype(self.dtype),
+            ((R, pz - nz - R), (R, py - ny - R), (R, px - nx - R)),
+            mode="edge",
+        )
+
+    def extract(self, S):
+        nz, ny, nx = self.interior_shape
+        return lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
+
+    def run(self, u, t, num_iters: int):
+        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``."""
+        S = self.embed(u)
+        T1 = S
+        T2 = S
+
+        def body(i, carry):
+            S, T1, T2, t = carry
+            S, T1, T2 = self._step(S, T1, T2)
+            return S, T1, T2, t + self.dt
+
+        S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, T1, T2, t))
+        return self.extract(S), t

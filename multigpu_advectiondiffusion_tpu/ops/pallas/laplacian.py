@@ -185,20 +185,21 @@ def laplacian_o4_2d(
     )(up)
 
 
-def fits_vmem(shape: Sequence[int], halo: int, n_live: int) -> bool:
+def fits_vmem(shape: Sequence[int], halo: int, n_live: int,
+              itemsize: int = 4) -> bool:
     """Whether a whole-array 2-D kernel with ``n_live`` full-size live
     intermediates fits the conservative VMEM budget after tile rounding."""
     rows = _round_up(shape[0] + 2 * halo, SUBLANE)
     cols = _round_up(shape[1] + 2 * halo, LANE)
-    return n_live * rows * cols * 4 <= VMEM_BUDGET
+    return n_live * rows * cols * itemsize <= VMEM_BUDGET
 
 
-def supported(shape: Sequence[int], order: int) -> bool:
+def supported(shape: Sequence[int], order: int, itemsize: int = 4) -> bool:
     """Whether the Pallas path covers this problem (else XLA fallback)."""
     if order != 4:
         return False
     if len(shape) == 3:
         return True
     if len(shape) == 2:
-        return fits_vmem(shape, R, 3)
+        return fits_vmem(shape, R, 3, itemsize)
     return False

@@ -28,10 +28,34 @@ from multigpu_advectiondiffusion_tpu.ops.flux import Flux
 from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     align_trailing,
     compiler_params,
-    pick_block,
+    round_up,
 )
 
 R = 3  # WENO5 stencil radius
+
+# Mosaic keeps ~16 live row-sized buffers per (block-row + 1) during the
+# dual reconstruction (measured: 205 MiB at block=8 on a 512^2 trailing
+# extent), so the z-block must be sized against VMEM, not a fixed 8.
+_VMEM_BUDGET = 80 * 1024 * 1024
+
+
+def _live_bytes(b: int, halo_lead: int, row_bytes: int) -> int:
+    return (16 * (b + 1) + b + halo_lead) * row_bytes
+
+
+def _pick_vmem_block(nb: int, halo_lead: int, row_bytes: int) -> int | None:
+    for b in range(min(8, nb), 0, -1):
+        if nb % b == 0 and _live_bytes(b, halo_lead, row_bytes) <= _VMEM_BUDGET:
+            return b
+    return None
+
+
+def _row_bytes(shape, dtype) -> int:
+    """Bytes of one tile-aligned leading-axis row of a padded 3-D array."""
+    return (
+        round_up(shape[1], 8) * round_up(shape[2], 128)
+        * jnp.dtype(dtype).itemsize
+    )
 
 
 def _interpret() -> bool:
@@ -89,8 +113,10 @@ def flux_divergence_pallas(
     n = shape[axis]  # output length along the sweep axis
     lead_axis = 0  # block over the leading axis
     nb = shape[0]
-    b = block or pick_block(nb, 8)
     halo_lead = 2 * R if axis == lead_axis else 0
+    b = block or _pick_vmem_block(nb, halo_lead, _row_bytes(up.shape, up.dtype))
+    if b is None:
+        raise ValueError("no VMEM-viable block; gate with supported() first")
     up = align_trailing(up)
 
     def kernel(up_hbm, out_ref, slab, sem):
@@ -159,11 +185,20 @@ def _flux_divergence_2d(
     )(up)
 
 
-def supported(ndim: int, order: int, variant: str, shape=None) -> bool:
+def supported(ndim: int, order: int, variant: str, shape=None,
+              dtype=jnp.float32) -> bool:
     if order != 5 or variant not in ("js", "z"):
         return False
     if ndim == 3:
-        return True
+        if shape is None:
+            return True
+        # every sweep axis must admit a VMEM-viable z-block (the z sweep
+        # carries the 2R-row lead halo — the binding constraint)
+        padded = (shape[0] + 2 * R, shape[1] + 2 * R, shape[2] + 2 * R)
+        return (
+            _pick_vmem_block(shape[0], 2 * R, _row_bytes(padded, dtype))
+            is not None
+        )
     if ndim == 2:
         from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
             fits_vmem,
@@ -171,5 +206,7 @@ def supported(ndim: int, order: int, variant: str, shape=None) -> bool:
 
         # shape is required to size-gate the whole-array 2-D kernel
         # (~10 live full-size intermediates: vp/vm shifts, betas, weights).
-        return shape is not None and fits_vmem(shape, R, 10)
+        return shape is not None and fits_vmem(
+            shape, R, 10, jnp.dtype(dtype).itemsize
+        )
     return False

@@ -205,7 +205,8 @@ def flux_divergence(
             weno as pallas_weno,
         )
 
-        if pallas_weno.supported(u.ndim, order, variant, shape=u.shape):
+        if pallas_weno.supported(u.ndim, order, variant, shape=u.shape,
+                                 dtype=u.dtype):
             return pallas_weno.flux_divergence_pallas(
                 up, axis, dx, flux, variant
             )

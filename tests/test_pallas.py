@@ -114,3 +114,70 @@ def test_burgers_solver_pallas_impl():
         outs[impl] = np.asarray(solver.run(solver.initial_state(), 5).u)
     np.testing.assert_allclose(outs["pallas"], outs["xla"],
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {},
+        {"weno_variant": "z"},
+        {"nu": 1e-3},
+        {"flux": "linear"},
+        {"flux": "buckley"},
+    ],
+    ids=["js", "z", "viscous", "linear", "buckley"],
+)
+def test_fused_burgers_run_matches_xla(kw):
+    """The fused single-kernel-per-stage Burgers fast path (run() with
+    impl='pallas' on an eligible 3-D fixed-dt config) must agree with the
+    generic XLA path to f32 rounding across a multi-step run."""
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, cfl=0.3, adaptive_dt=False,
+                            dtype="float32", ic="gaussian", impl=impl, **kw)
+        solver = BurgersSolver(cfg)
+        if impl == "pallas":
+            assert solver._fused_stepper() is not None, "fast path not taken"
+        st = solver.run(solver.initial_state(), 5)
+        outs[impl] = (np.asarray(st.u), float(st.t))
+    scale = float(np.max(np.abs(outs["xla"][0])))
+    np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
+                               rtol=2e-5, atol=2e-6 * scale)
+    assert outs["pallas"][1] == outs["xla"][1]
+
+
+def test_fused_burgers_ineligible_configs_fall_back():
+    """Configs outside the fused Burgers kernel's assumptions must
+    quietly use the generic path (and still run)."""
+    grid = Grid.make(16, 16, 16, lengths=4.0)
+    for kw in (
+        {"adaptive_dt": True},
+        {"dtype": "float64"},
+        {"weno_order": 7},
+        {"integrator": "ssp_rk2"},
+        {"bc": "periodic"},
+        {"nu": 1e-3, "laplacian_order": 2},
+    ):
+        cfg = BurgersConfig(grid=grid, ic="gaussian", impl="pallas",
+                            **{"adaptive_dt": False, **kw})
+        solver = BurgersSolver(cfg)
+        assert solver._fused_stepper() is None, kw
+        solver.run(solver.initial_state(), 2)
+
+
+def test_fused_burgers_ghost_maintenance_long_run():
+    """Many fused steps: the persistent padded state's edge ghosts must
+    track the evolving boundary cells (a stale-ghost bug shows up as
+    drift against the per-step-padded XLA path — the failure mode the
+    reference actually has, SURVEY §3.2)."""
+    grid = Grid.make(16, 12, 20, lengths=[3.0, 2.0, 2.5])
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, cfl=0.25, adaptive_dt=False,
+                            dtype="float32", ic="gaussian", impl=impl)
+        solver = BurgersSolver(cfg)
+        outs[impl] = np.asarray(solver.run(solver.initial_state(), 25).u)
+    scale = float(np.max(np.abs(outs["xla"])))
+    np.testing.assert_allclose(outs["pallas"], outs["xla"],
+                               rtol=5e-5, atol=5e-6 * scale)
