@@ -38,7 +38,14 @@ def main() -> None:
     solver = DiffusionSolver(cfg)
     state = solver.initial_state()
 
-    iters = 101
+    # 5x the reference's 101 iters: at ~18 Gsteps/s the 101-iter net time
+    # (~55 ms) is the same order as the tunnel's per-fetch sync overhead
+    # (~100 ms), so the subtraction is noise-dominated; MLUPS is a rate,
+    # unaffected by the count. On CPU (mechanics validation only — the
+    # Pallas kernels run in interpret mode there) a handful suffices.
+    import jax
+
+    iters = 505 if jax.default_backend() != "cpu" else 5
     elapsed = timed_run(solver, state, iters).seconds
     rate = mlups(grid.num_cells, iters, STAGES[cfg.integrator], elapsed)
     print(

@@ -47,6 +47,7 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     O4_COEFFS,
     R,
     SUBLANE,
+    VMEM_LIMIT,
     compiler_params,
     interpret_mode,
     pick_block,
@@ -82,6 +83,7 @@ def _stage_kernel(
     sem_w,
     *,
     bz: int,
+    n_blocks: int,
     interior_shape: Sequence[int],
     scales: Sequence[float],
     a: float,
@@ -90,22 +92,57 @@ def _stage_kernel(
     band: int,
     bc_value: float,
 ):
+    """One z-block of one RK stage, 2-slot double-buffered.
+
+    The TPU grid is a sequential loop, so block ``k`` prefetches block
+    ``k+1``'s slab (and ``u`` rows) while it computes, and defers the
+    wait on its output DMA until the same slot is reused at ``k+2`` —
+    reads, compute, and writes of consecutive blocks overlap. All row
+    ranges of distinct blocks are disjoint, so the in-flight writes
+    never alias the prefetched reads (the in-place final stage reads its
+    ``u`` rows strictly before the overwriting DMA of the same block).
+    """
     nz, ny, nx = interior_shape
     k = pl.program_id(0)
+    slot = lax.rem(k, jnp.asarray(2, k.dtype))
+    nslot = lax.rem(k + 1, jnp.asarray(2, k.dtype))
 
-    cp_v = pltpu.make_async_copy(v_hbm.at[pl.ds(k * bz, bz + 2 * R)], vs, sem_v)
-    cp_v.start()
-    if us is not None:
+    def copy_v(j, s):
+        return pltpu.make_async_copy(
+            v_hbm.at[pl.ds(j * bz, bz + 2 * R)], vs.at[s], sem_v.at[s]
+        )
+
+    def copy_u(j, s):
         # u rows come from u_hbm — which for the in-place final stage is
         # the output buffer itself (read strictly before the overwrite;
-        # other blocks' reads are row-disjoint from this block's write).
+        # other blocks' reads are row-disjoint from any in-flight write).
         src = u_hbm if u_hbm is not None else out_hbm
-        cp_u = pltpu.make_async_copy(src.at[pl.ds(R + k * bz, bz)], us, sem_u)
-        cp_u.start()
-        cp_u.wait()
-    cp_v.wait()
+        return pltpu.make_async_copy(
+            src.at[pl.ds(R + j * bz, bz)], us.at[s], sem_u.at[s]
+        )
 
-    v = vs[:]
+    def copy_w(j, s):
+        return pltpu.make_async_copy(
+            res.at[s], out_hbm.at[pl.ds(R + j * bz, bz)], sem_w.at[s]
+        )
+
+    @pl.when(k == 0)
+    def _():
+        copy_v(0, 0).start()
+        if us is not None:
+            copy_u(0, 0).start()
+
+    @pl.when(k + 1 < n_blocks)
+    def _():
+        copy_v(k + 1, nslot).start()
+        if us is not None:
+            copy_u(k + 1, nslot).start()
+
+    if us is not None:
+        copy_u(k, slot).wait()
+    copy_v(k, slot).wait()
+
+    v = vs[slot]
     vc = v[R : R + bz]  # stage input, core z-rows, full y/x width
     dtype = v.dtype
 
@@ -120,7 +157,11 @@ def _stage_kernel(
             term = (v[j : j + bz] if axis == 0 else _shift(vc, j - R, axis)) * coef
             acc = term if acc is None else acc + term
 
-    rk = b * (vc + dt * acc) if a == 0.0 else a * us[:] + b * (vc + dt * acc)
+    rk = (
+        b * (vc + dt * acc)
+        if a == 0.0
+        else a * us[slot] + b * (vc + dt * acc)
+    )
 
     # Global interior-cell indices of this block (ghost offset already
     # removed for z: the written rows are exactly the core rows).
@@ -139,11 +180,22 @@ def _stage_kernel(
         | (gx == 0) | (gx == nx - 1)
     )
     frozen = jnp.where(face, jnp.asarray(bc_value, dtype), vc)
-    res[:] = jnp.where(interior, rk, frozen)
 
-    cp_w = pltpu.make_async_copy(res, out_hbm.at[pl.ds(R + k * bz, bz)], sem_w)
-    cp_w.start()
-    cp_w.wait()
+    # the res slot is recycled every other block: drain its previous
+    # write before overwriting, then issue this block's write and leave
+    # it in flight (drained at k+2, or below on the last blocks)
+    @pl.when(k >= 2)
+    def _():
+        copy_w(k - 2, slot).wait()
+
+    res[slot] = jnp.where(interior, rk, frozen)
+    copy_w(k, slot).start()
+
+    @pl.when(k == n_blocks - 1)
+    def _():
+        copy_w(k, slot).wait()
+        if n_blocks >= 2:
+            copy_w(k - 1, nslot).wait()
 
 
 def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
@@ -159,10 +211,12 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     nz = interior_shape[0]
     trailing = padded_shape[1:]
     use_u = u_source != "none"
+    n_blocks = nz // bz
 
     kern = functools.partial(
         _stage_kernel,
         bz=bz,
+        n_blocks=n_blocks,
         interior_shape=tuple(interior_shape),
         scales=tuple(scales),
         a=a,
@@ -184,18 +238,18 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
         kern(v_hbm, u_hbm, out_hbm, vs, us, res, sem_v, sem_u, sem_w)
 
     n_in = 3 if u_source == "operand" else 2
-    scratch = [pltpu.VMEM((bz + 2 * R,) + trailing, dtype)]
+    scratch = [pltpu.VMEM((2, bz + 2 * R) + trailing, dtype)]
     if use_u:
-        scratch.append(pltpu.VMEM((bz,) + trailing, dtype))
-    scratch.append(pltpu.VMEM((bz,) + trailing, dtype))
-    scratch.append(pltpu.SemaphoreType.DMA)
+        scratch.append(pltpu.VMEM((2, bz) + trailing, dtype))
+    scratch.append(pltpu.VMEM((2, bz) + trailing, dtype))
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
     if use_u:
-        scratch.append(pltpu.SemaphoreType.DMA)
-    scratch.append(pltpu.SemaphoreType.DMA)
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
 
     return pl.pallas_call(
         kernel,
-        grid=(nz // bz,),
+        grid=(n_blocks,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_in,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
@@ -221,16 +275,18 @@ class FusedDiffusionStepper:
         self.dtype = jnp.dtype(dtype)
         self.bc_value = float(bc_value)
         if block_z is None:
-            # Largest divisor of nz whose working set (~7 live row-sized
-            # buffers: slab, u, res + compute temporaries) stays well
-            # under the Mosaic scoped-VMEM ceiling; bz in [16, 32] is the
-            # measured sweet spot on v5e (z-halo over-read amortized).
+            # Largest divisor of nz whose working set stays under the
+            # Mosaic scoped-VMEM ceiling. Calibrated on v5e at the bench
+            # grid (row = 208*512*4 B): ~9 live row-sized buffers per
+            # block row plus ~56 rows of fixed overhead; bz=20 measured
+            # 91 GLUPS (vs 54 at bz=16), bz=32 exceeds VMEM. Capped at
+            # the largest measured-safe block.
             row_bytes = (
                 self.padded_shape[1] * self.padded_shape[2]
                 * self.dtype.itemsize
             )
-            budget_rows = (60 * 1024 * 1024) // (7 * row_bytes)
-            block_z = pick_block(nz, max(1, min(32, int(budget_rows))))
+            budget_rows = (VMEM_LIMIT // row_bytes - 56) // 9
+            block_z = pick_block(nz, max(1, min(20, int(budget_rows))))
         if nz % block_z != 0:
             raise ValueError(
                 f"block_z={block_z} must divide nz={nz}; a non-divisor "
