@@ -59,7 +59,10 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
     p.add_argument("--snapshot-every", type=int, default=0, metavar="N",
                    help="write snap_NNNNNN.bin every N iters (async)")
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
-                   help="write restartable checkpoint_NNNNNN.npz every N iters")
+                   help="write restartable checkpoint_NNNNNN.ckpt every N "
+                        "iters (atomic, CRC-verified)")
+    p.add_argument("--checkpoint-keep", type=int, default=0, metavar="N",
+                   help="keep only the newest N checkpoints (0 = keep all)")
 
 
 def _grid(args, ndim):
@@ -111,7 +114,8 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       save_dir=args.save, plot=args.plot,
                       check_error=args.check_error, repeats=args.repeats,
                       snapshot_every=args.snapshot_every,
-                      checkpoint_every=args.checkpoint_every)
+                      checkpoint_every=args.checkpoint_every,
+                      checkpoint_keep=args.checkpoint_keep)
 
 
 def _run_burgers(args, ndim):
@@ -143,7 +147,8 @@ def _run_burgers(args, ndim):
                       save_dir=args.save, plot=args.plot,
                       check_error=False, repeats=args.repeats,
                       snapshot_every=args.snapshot_every,
-                      checkpoint_every=args.checkpoint_every)
+                      checkpoint_every=args.checkpoint_every,
+                      checkpoint_keep=args.checkpoint_keep)
 
 
 def _run_convergence(args):

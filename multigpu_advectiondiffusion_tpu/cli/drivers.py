@@ -65,15 +65,17 @@ def run_solver(
     repeats: int = 1,
     snapshot_every: int = 0,
     checkpoint_every: int = 0,
+    checkpoint_keep: int = 0,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
     (``MultiGPU/Diffusion3d_Baseline/main.c:184-307``), then I/O.
 
     ``snapshot_every``/``checkpoint_every`` (iters mode only) emit
-    float32 ``snap_*.bin`` via the async writer / restartable ``.npz``
-    checkpoints every N iterations — the restart capability the
-    reference lacks (SURVEY §5).
+    float32 ``snap_*.bin`` via the async writer / restartable,
+    CRC-verified ``.ckpt`` checkpoints every N iterations — the restart
+    capability the reference lacks (SURVEY §5). ``checkpoint_keep``
+    bounds disk use by deleting all but the newest N checkpoints.
     """
     if (iters is None) == (t_end is None):
         raise ValueError("provide exactly one of iters/t_end")
@@ -111,10 +113,11 @@ def run_solver(
                     )
                 if checkpoint_every and done % checkpoint_every == 0:
                     io_utils.save_checkpoint(
-                        os.path.join(save_dir, f"checkpoint_{done:06d}.npz"),
+                        os.path.join(save_dir, f"checkpoint_{done:06d}.ckpt"),
                         out,
                         grid=solver.grid,
                     )
+                    io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
             sync(out.u)
             best = time.perf_counter() - t0
     else:

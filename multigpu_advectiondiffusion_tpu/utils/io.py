@@ -58,6 +58,34 @@ def _load_native():
             lib.writer_flush.argtypes = [ctypes.c_void_p]
             lib.writer_flush.restype = ctypes.c_int
             lib.writer_destroy.argtypes = [ctypes.c_void_p]
+            try:  # added with the checkpoint runtime; absent in old builds
+                lib.checkpoint_save.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_void_p,
+                    ctypes.c_uint32,
+                    ctypes.c_uint32,
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.c_double,
+                    ctypes.c_int64,
+                ]
+                lib.checkpoint_save.restype = ctypes.c_int
+                lib.checkpoint_load_header.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_uint32),
+                    ctypes.POINTER(ctypes.c_double),
+                    ctypes.POINTER(ctypes.c_int64),
+                ]
+                lib.checkpoint_load_header.restype = ctypes.c_int
+                lib.checkpoint_load_payload.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_void_p,
+                    ctypes.c_size_t,
+                ]
+                lib.checkpoint_load_payload.restype = ctypes.c_int
+            except AttributeError:
+                pass
             _native = lib
             return lib
     _native = False
@@ -158,7 +186,169 @@ def save_ascii(u, path: str) -> None:
             f.write(f"{v:g}\n")
 
 
+# --------------------------------------------------------------------- #
+# Checkpoint format (.ckpt): 64-byte header + raw payload + CRC32.
+#
+# Layout (little-endian), mirrored bit-for-bit by
+# ``native/checkpoint_native.cpp`` — the bytes are identical whether the
+# native library is built or not:
+#   0: magic "TPCFDCKP"        8s
+#   8: version                 u32 (=1)
+#  12: dtype code              u32 (0=f32, 1=f64)
+#  16: ndim                    u32 (<=4)
+#  20: shape[4]                4*u32 (unused dims = 1)
+#  36: padding                 4 bytes (keeps t 8-aligned)
+#  40: t                       f64
+#  48: iteration               i64
+#  56: crc32(payload)          u32 (zlib polynomial)
+#  60: reserved                4 bytes
+#  64: payload
+#
+# Saves are atomic (tmp + rename) and loads CRC-verify the payload — the
+# resume-safety the reference cannot offer (it has no restart at all).
+# --------------------------------------------------------------------- #
+_CKPT_MAGIC = b"TPCFDCKP"
+_CKPT_VERSION = 1
+_CKPT_DTYPES = {0: np.float32, 1: np.float64}
+_CKPT_CODES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}
+
+
+def _ckpt_header(arr: np.ndarray, t: float, it: int, crc: int) -> bytes:
+    import struct
+
+    shape4 = list(arr.shape) + [1] * (4 - arr.ndim)
+    return struct.pack(
+        "<8sIII4I4xdqI4x",
+        _CKPT_MAGIC,
+        _CKPT_VERSION,
+        _CKPT_CODES[arr.dtype],
+        arr.ndim,
+        *shape4,
+        float(t),
+        int(it),
+        crc,
+    )
+
+
+def _save_ckpt(path: str, state: SolverState) -> None:
+    import ctypes
+    import zlib
+
+    arr = np.ascontiguousarray(np.asarray(state.u))
+    if arr.dtype not in _CKPT_CODES or not 1 <= arr.ndim <= 4:
+        raise ValueError(f"checkpoint supports 1-4D f32/f64, got {arr.dtype}")
+    t, it = float(state.t), int(state.it)
+
+    lib = _load_native()
+    if lib and hasattr(lib, "checkpoint_save"):
+        shape = (ctypes.c_uint32 * 4)(*(list(arr.shape) + [1] * 4)[:4])
+        rc = lib.checkpoint_save(
+            path.encode(),
+            arr.ctypes.data_as(ctypes.c_void_p),
+            _CKPT_CODES[arr.dtype],
+            arr.ndim,
+            shape,
+            t,
+            it,
+        )
+        if rc == 0:
+            return
+    payload = arr.tobytes()
+    header = _ckpt_header(arr, t, it, zlib.crc32(payload))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(header)
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _load_ckpt(path: str) -> SolverState:
+    import struct
+    import zlib
+
+    import jax.numpy as jnp
+
+    lib = _load_native()
+    if lib and hasattr(lib, "checkpoint_load_header"):
+        return _load_ckpt_native(lib, path)
+
+    with open(path, "rb") as f:
+        header = f.read(64)
+        if len(header) != 64:
+            raise IOError(f"truncated checkpoint header: {path}")
+        (magic, version, code, ndim, s0, s1, s2, s3, t, it, crc) = (
+            struct.unpack("<8sIII4I4xdqI4x", header)
+        )
+        if magic != _CKPT_MAGIC or version != _CKPT_VERSION:
+            raise IOError(f"not a framework checkpoint: {path}")
+        if code not in _CKPT_DTYPES or not 1 <= ndim <= 4:
+            raise IOError(f"corrupt checkpoint header: {path}")
+        shape = (s0, s1, s2, s3)[:ndim]
+        dtype = np.dtype(_CKPT_DTYPES[code])
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        payload = f.read(nbytes)
+    if len(payload) != nbytes:
+        raise IOError(f"truncated checkpoint payload: {path}")
+    if zlib.crc32(payload) != crc:
+        raise IOError(f"checkpoint CRC mismatch (corrupt file): {path}")
+    u = np.frombuffer(payload, dtype=dtype).reshape(shape)
+    return SolverState(u=jnp.asarray(u), t=jnp.asarray(t), it=jnp.asarray(it))
+
+
+def _load_ckpt_native(lib, path: str) -> SolverState:
+    """Native loader: header parse + CRC-verified payload read in C."""
+    import ctypes
+
+    import jax.numpy as jnp
+
+    code = ctypes.c_uint32()
+    ndim = ctypes.c_uint32()
+    shape4 = (ctypes.c_uint32 * 4)()
+    t = ctypes.c_double()
+    it = ctypes.c_int64()
+    rc = lib.checkpoint_load_header(
+        path.encode(), ctypes.byref(code), ctypes.byref(ndim), shape4,
+        ctypes.byref(t), ctypes.byref(it),
+    )
+    if rc == -3:
+        raise IOError(f"not a framework checkpoint: {path}")
+    if rc != 0:
+        raise IOError(f"truncated checkpoint header: {path}")
+    shape = tuple(shape4[: ndim.value])
+    dtype = np.dtype(_CKPT_DTYPES[code.value])
+    out = np.empty(shape, dtype=dtype)
+    rc = lib.checkpoint_load_payload(
+        path.encode(), out.ctypes.data_as(ctypes.c_void_p), out.nbytes
+    )
+    if rc == -2:
+        raise IOError(f"checkpoint CRC mismatch (corrupt file): {path}")
+    if rc != 0:
+        raise IOError(f"truncated checkpoint payload: {path}")
+    return SolverState(
+        u=jnp.asarray(out), t=jnp.asarray(t.value), it=jnp.asarray(it.value)
+    )
+
+
 def save_checkpoint(path: str, state: SolverState, grid: Optional[Grid] = None):
+    """Restartable state. ``.npz`` paths keep the legacy numpy container;
+    anything else uses the framework ``.ckpt`` format (atomic write +
+    CRC-verified payload, native-accelerated when ``native/`` is built).
+    Grid metadata rides in a ``<path>.json`` sidecar for ``.ckpt`` (the
+    array shape itself is already in the binary header)."""
+    if not path.endswith(".npz"):
+        _save_ckpt(path, state)
+        if grid is not None:
+            meta = {
+                "shape": list(grid.shape),
+                "bounds": [list(b) for b in grid.bounds],
+            }
+            tmp = path + ".json.tmp"
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, path + ".json")
+        return
     meta = {}
     if grid is not None:
         meta = {"shape": list(grid.shape), "bounds": [list(b) for b in grid.bounds]}
@@ -174,7 +364,30 @@ def save_checkpoint(path: str, state: SolverState, grid: Optional[Grid] = None):
 def load_checkpoint(path: str) -> SolverState:
     import jax.numpy as jnp
 
+    if not path.endswith(".npz"):
+        return _load_ckpt(path)
     with np.load(path, allow_pickle=False) as z:
         return SolverState(
             u=jnp.asarray(z["u"]), t=jnp.asarray(z["t"]), it=jnp.asarray(z["it"])
         )
+
+
+def rotate_checkpoints(directory: str, keep: int, prefix: str = "checkpoint_"):
+    """Delete all but the newest ``keep`` checkpoints in ``directory``
+    (matched by ``prefix`` + a known checkpoint extension), oldest first
+    by filename — the zero-padded iteration number makes name order the
+    write order, deterministic where mtime granularity is not. Metadata
+    sidecars follow their checkpoint. Keeps disk use bounded on long runs
+    with ``--checkpoint-every``."""
+    if keep <= 0:
+        return
+    names = sorted(
+        name
+        for name in os.listdir(directory)
+        if name.startswith(prefix) and name.endswith((".ckpt", ".npz"))
+    )
+    for stale in names[:-keep]:
+        os.remove(os.path.join(directory, stale))
+        sidecar = os.path.join(directory, stale + ".json")
+        if os.path.exists(sidecar):
+            os.remove(sidecar)

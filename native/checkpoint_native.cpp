@@ -1,0 +1,165 @@
+// Native checkpoint runtime for multigpu_advectiondiffusion_tpu.
+//
+// The reference has no restart capability at all (SURVEY §5: only the IC
+// write and the final result write, MultiGPU/Diffusion3d_Baseline/
+// main.c:82-86,339-343). This module provides the framework's checkpoint
+// format as a small C library:
+//
+//   * self-describing 64-byte header (magic, version, dtype, shape, t,
+//     iteration) + raw payload,
+//   * CRC32 (zlib polynomial — verifiable from Python's zlib.crc32) over
+//     the payload, checked on load,
+//   * atomic persistence: write to "<path>.tmp", flush, fsync, rename —
+//     a crash mid-write can never leave a truncated file at the final
+//     path.
+//
+// utils/io.py mirrors the exact byte layout in numpy so the format is
+// identical whether or not this library is built.
+//
+// Build: make -C native    (part of libtpucfd_io.so)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#ifdef _WIN32
+#error "POSIX only"
+#endif
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'T', 'P', 'C', 'F', 'D', 'C', 'K', 'P'};
+constexpr uint32_t kVersion = 1;
+constexpr size_t kHeaderBytes = 64;
+constexpr uint32_t kMaxNdim = 4;
+
+// zlib CRC32 (polynomial 0xEDB88320), table-driven.
+const uint32_t* crc_table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+uint32_t crc32_update(uint32_t crc, const uint8_t* buf, size_t len) {
+  const uint32_t* table = crc_table();
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i)
+    crc = table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+struct Header {
+  char magic[8];        // offset  0
+  uint32_t version;     // offset  8
+  uint32_t dtype_code;  // offset 12: 0 = f32, 1 = f64
+  uint32_t ndim;        // offset 16
+  uint32_t shape[kMaxNdim];  // offset 20
+  uint8_t pad_[4];      // offset 36 (keeps t 8-aligned, explicit)
+  double t;             // offset 40
+  int64_t it;           // offset 48
+  uint32_t payload_crc32;  // offset 56
+  uint8_t reserved[4];  // offset 60
+};
+static_assert(sizeof(Header) == kHeaderBytes, "header layout drifted");
+
+size_t dtype_size(uint32_t code) {
+  return code == 0 ? 4 : code == 1 ? 8 : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, -1 on IO/argument failure.
+int checkpoint_save(const char* path, const void* data, uint32_t dtype_code,
+                    uint32_t ndim, const uint32_t* shape, double t,
+                    int64_t it) {
+  size_t item = dtype_size(dtype_code);
+  if (!item || ndim == 0 || ndim > kMaxNdim) return -1;
+  size_t count = 1;
+  Header h{};
+  std::memcpy(h.magic, kMagic, sizeof(kMagic));
+  h.version = kVersion;
+  h.dtype_code = dtype_code;
+  h.ndim = ndim;
+  for (uint32_t d = 0; d < kMaxNdim; ++d) {
+    h.shape[d] = d < ndim ? shape[d] : 1;
+    count *= h.shape[d];
+  }
+  h.t = t;
+  h.it = it;
+  size_t nbytes = count * item;
+  h.payload_crc32 =
+      crc32_update(0, static_cast<const uint8_t*>(data), nbytes);
+
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  bool ok = std::fwrite(&h, 1, kHeaderBytes, f) == kHeaderBytes &&
+            std::fwrite(data, 1, nbytes, f) == nbytes &&
+            std::fflush(f) == 0 && fsync(fileno(f)) == 0;
+  ok = (std::fclose(f) == 0) && ok;
+  if (!ok || std::rename(tmp.c_str(), path) != 0) {
+    std::remove(tmp.c_str());
+    return -1;
+  }
+  return 0;
+}
+
+// Reads header only. Returns 0 ok, -1 IO error, -3 bad magic/version.
+int checkpoint_load_header(const char* path, uint32_t* dtype_code,
+                           uint32_t* ndim, uint32_t* shape /* [4] */,
+                           double* t, int64_t* it) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  Header h{};
+  size_t got = std::fread(&h, 1, kHeaderBytes, f);
+  std::fclose(f);
+  if (got != kHeaderBytes) return -1;
+  if (std::memcmp(h.magic, kMagic, sizeof(kMagic)) != 0 ||
+      h.version != kVersion || !dtype_size(h.dtype_code) || h.ndim == 0 ||
+      h.ndim > kMaxNdim)
+    return -3;
+  *dtype_code = h.dtype_code;
+  *ndim = h.ndim;
+  for (uint32_t d = 0; d < kMaxNdim; ++d) shape[d] = h.shape[d];
+  *t = h.t;
+  *it = h.it;
+  return 0;
+}
+
+// Reads and CRC-verifies the payload (caller sizes `out` from the
+// header). Returns 0 ok, -1 IO error, -2 CRC mismatch, -3 bad magic.
+int checkpoint_load_payload(const char* path, void* out, size_t nbytes) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  Header h{};
+  if (std::fread(&h, 1, kHeaderBytes, f) != kHeaderBytes) {
+    std::fclose(f);
+    return -1;
+  }
+  if (std::memcmp(h.magic, kMagic, sizeof(kMagic)) != 0 ||
+      h.version != kVersion) {
+    std::fclose(f);
+    return -3;
+  }
+  size_t got = std::fread(out, 1, nbytes, f);
+  std::fclose(f);
+  if (got != nbytes) return -1;
+  uint32_t crc = crc32_update(0, static_cast<const uint8_t*>(out), nbytes);
+  return crc == h.payload_crc32 ? 0 : -2;
+}
+
+}  // extern "C"
