@@ -63,6 +63,12 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "iters (atomic, CRC-verified)")
     p.add_argument("--checkpoint-keep", type=int, default=0, metavar="N",
                    help="keep only the newest N checkpoints (0 = keep all)")
+    p.add_argument("--resume", default=None, metavar="CKPT",
+                   help="resume from a .ckpt/.npz checkpoint instead of "
+                        "the initial condition")
+    p.add_argument("--impl", default="xla", choices=["xla", "pallas"],
+                   help="kernel strategy (pallas = fused/VMEM-slab TPU "
+                        "kernels where eligible, XLA fallback otherwise)")
 
 
 def _grid(args, ndim):
@@ -103,6 +109,7 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                else ("edge", "dirichlet")),
         t0=args.t0,
         geometry=geometry,
+        impl=args.impl,
     )
     mesh, decomp = _mesh_decomp(args, grid)
     solver = DiffusionSolver(cfg, mesh=mesh, decomp=decomp)
@@ -115,7 +122,8 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       check_error=args.check_error, repeats=args.repeats,
                       snapshot_every=args.snapshot_every,
                       checkpoint_every=args.checkpoint_every,
-                      checkpoint_keep=args.checkpoint_keep)
+                      checkpoint_keep=args.checkpoint_keep,
+                      resume=args.resume)
 
 
 def _run_burgers(args, ndim):
@@ -137,6 +145,7 @@ def _run_burgers(args, ndim):
         dtype=args.dtype,
         ic=args.ic or "gaussian",
         bc=_bc(args, "edge"),
+        impl=args.impl,
     )
     mesh, decomp = _mesh_decomp(args, grid)
     solver = BurgersSolver(cfg, mesh=mesh, decomp=decomp)
@@ -148,7 +157,8 @@ def _run_burgers(args, ndim):
                       check_error=False, repeats=args.repeats,
                       snapshot_every=args.snapshot_every,
                       checkpoint_every=args.checkpoint_every,
-                      checkpoint_keep=args.checkpoint_keep)
+                      checkpoint_keep=args.checkpoint_keep,
+                      resume=args.resume)
 
 
 def _run_convergence(args):

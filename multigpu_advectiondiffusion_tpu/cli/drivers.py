@@ -6,9 +6,12 @@ build solver → save ``initial.bin`` → timed hot loop → save ``result.bin``
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Optional
+
+import numpy as np
 
 
 from multigpu_advectiondiffusion_tpu.bench.timing import sync
@@ -66,6 +69,7 @@ def run_solver(
     snapshot_every: int = 0,
     checkpoint_every: int = 0,
     checkpoint_keep: int = 0,
+    resume: Optional[str] = None,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
@@ -79,7 +83,36 @@ def run_solver(
     """
     if (iters is None) == (t_end is None):
         raise ValueError("provide exactly one of iters/t_end")
-    state = solver.initial_state()
+    if resume:
+        import jax
+        import jax.numpy as jnp
+
+        state = io_utils.load_checkpoint(resume)
+        if tuple(state.u.shape) != tuple(solver.grid.shape):
+            raise ValueError(
+                f"checkpoint grid {tuple(state.u.shape)} != configured "
+                f"grid {tuple(solver.grid.shape)}"
+            )
+        u = jnp.asarray(state.u, solver.dtype)
+        if solver.mesh is not None:
+            u = jax.device_put(u, solver.sharding())
+        state = type(state)(u=u, t=state.t, it=state.it)
+        # the .ckpt.json sidecar carries the physical bounds — a matching
+        # node count on a different domain is silently wrong physics
+        sidecar = resume + ".json"
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                meta = json.load(f)
+            want = [list(b) for b in solver.grid.bounds]
+            got = meta.get("bounds")
+            if got is not None and not np.allclose(got, want):
+                raise ValueError(
+                    f"checkpoint domain bounds {got} != configured "
+                    f"bounds {want}"
+                )
+    else:
+        state = solver.initial_state()
+    start_it = int(state.it)
 
     if save_dir:
         os.makedirs(save_dir, exist_ok=True)
@@ -107,13 +140,20 @@ def run_solver(
                 n = min(chunk, iters - done)
                 out = solver.run(out, n)
                 done += n
+                # filenames carry the GLOBAL iteration so a resumed run
+                # continues the numbering instead of overwriting earlier
+                # artifacts in the same directory
+                glob_it = start_it + done
                 if snapshot_every and done % snapshot_every == 0:
                     writer.submit(
-                        out.u, os.path.join(save_dir, f"snap_{done:06d}.bin")
+                        out.u,
+                        os.path.join(save_dir, f"snap_{glob_it:06d}.bin"),
                     )
                 if checkpoint_every and done % checkpoint_every == 0:
                     io_utils.save_checkpoint(
-                        os.path.join(save_dir, f"checkpoint_{done:06d}.ckpt"),
+                        os.path.join(
+                            save_dir, f"checkpoint_{glob_it:06d}.ckpt"
+                        ),
                         out,
                         grid=solver.grid,
                     )

@@ -55,9 +55,36 @@ def hybrid_mesh(
     dcn_sizes = tuple(dcn_axes.values())
     ici_sizes = tuple(ici_axes.values())
     names = tuple(dcn_axes) + tuple(ici_axes)
-    devices = mesh_utils.create_hybrid_device_mesh(
-        ici_sizes, dcn_sizes, devices=jax.devices()
-    )
+
+    total = 1
+    for s in dcn_sizes + ici_sizes:
+        total *= s
+    dcn_total = 1
+    for s in dcn_sizes:
+        dcn_total *= s
+
+    try:
+        # Topology-aware placement: orders devices along the ICI torus so
+        # ppermute halo neighbors are physically adjacent.
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes, dcn_sizes, devices=jax.devices()
+        )
+    except ValueError:
+        if dcn_total == 1:
+            # Platforms whose devices carry no slice topology (e.g. the
+            # virtual-CPU test mesh): with no cross-slice axis a plain
+            # row-major mesh is a valid, if unoptimized, hybrid mesh.
+            devices = np.asarray(jax.devices()[:total]).reshape(
+                dcn_sizes + ici_sizes
+            )
+            return Mesh(devices, names)
+        # Devices without a slice_index attribute but a real DCN extent:
+        # group by process instead (raises a clear ValueError if the
+        # process count cannot satisfy dcn_sizes).
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes, dcn_sizes, devices=jax.devices(),
+            process_is_granule=True,
+        )
     # create_hybrid_device_mesh returns shape dcn_sizes + ici_sizes
     return Mesh(np.asarray(devices), names)
 

@@ -208,6 +208,7 @@ def save_ascii(u, path: str) -> None:
 # resume-safety the reference cannot offer (it has no restart at all).
 # --------------------------------------------------------------------- #
 _CKPT_MAGIC = b"TPCFDCKP"
+_CKPT_STRUCT = "<8sIII4I4xdqI4x"  # one layout constant: writer and reader cannot drift
 _CKPT_VERSION = 1
 _CKPT_DTYPES = {0: np.float32, 1: np.float64}
 _CKPT_CODES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}
@@ -218,7 +219,7 @@ def _ckpt_header(arr: np.ndarray, t: float, it: int, crc: int) -> bytes:
 
     shape4 = list(arr.shape) + [1] * (4 - arr.ndim)
     return struct.pack(
-        "<8sIII4I4xdqI4x",
+        _CKPT_STRUCT,
         _CKPT_MAGIC,
         _CKPT_VERSION,
         _CKPT_CODES[arr.dtype],
@@ -279,7 +280,7 @@ def _load_ckpt(path: str) -> SolverState:
         if len(header) != 64:
             raise IOError(f"truncated checkpoint header: {path}")
         (magic, version, code, ndim, s0, s1, s2, s3, t, it, crc) = (
-            struct.unpack("<8sIII4I4xdqI4x", header)
+            struct.unpack(_CKPT_STRUCT, header)
         )
         if magic != _CKPT_MAGIC or version != _CKPT_VERSION:
             raise IOError(f"not a framework checkpoint: {path}")
@@ -381,10 +382,18 @@ def rotate_checkpoints(directory: str, keep: int, prefix: str = "checkpoint_"):
     with ``--checkpoint-every``."""
     if keep <= 0:
         return
+    def _iteration(name: str) -> int:
+        stem = name[len(prefix):].rsplit(".", 1)[0]
+        return int(stem) if stem.isdigit() else -1
+
     names = sorted(
-        name
-        for name in os.listdir(directory)
-        if name.startswith(prefix) and name.endswith((".ckpt", ".npz"))
+        (
+            name
+            for name in os.listdir(directory)
+            if name.startswith(prefix) and name.endswith((".ckpt", ".npz"))
+        ),
+        key=lambda n: (_iteration(n), n),  # numeric order survives a
+        # digit-count rollover past the %06d padding
     )
     for stale in names[:-keep]:
         os.remove(os.path.join(directory, stale))

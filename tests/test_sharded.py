@@ -100,3 +100,30 @@ def test_axisymmetric_sharded_r_axis(devices):
     solver = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.of({1: "dr"}))
     out = solver.run(solver.initial_state(), 5)
     assert _max_abs_diff(ref.u, out.u) == 0.0
+
+
+def test_hybrid_mesh_single_slice_runs_sharded_step():
+    """hybrid_mesh with a trivial DCN extent must build a usable mesh on
+    platforms without slice topology (the virtual-CPU rig) and drive the
+    sharded solver exactly like make_mesh."""
+    from multigpu_advectiondiffusion_tpu.parallel.multihost import hybrid_mesh
+
+    mesh = hybrid_mesh({"dz": 4}, {"dz_dcn": 1})
+    assert mesh.axis_names == ("dz_dcn", "dz")
+    assert dict(mesh.shape) == {"dz_dcn": 1, "dz": 4}
+
+    grid = Grid.make(16, 16, 16, lengths=4.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32")
+    ref = DiffusionSolver(cfg).run(DiffusionSolver(cfg).initial_state(), 3)
+    sharded = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.slab("dz"))
+    out = sharded.run(sharded.initial_state(), 3)
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+
+
+def test_hybrid_mesh_multi_slice_unavailable_raises_cleanly():
+    """With a real DCN extent on a platform without slice/process
+    topology the failure must be a ValueError, not an attribute crash."""
+    from multigpu_advectiondiffusion_tpu.parallel.multihost import hybrid_mesh
+
+    with pytest.raises(ValueError):
+        hybrid_mesh({"dz": 4}, {"dz_dcn": 2})
