@@ -97,15 +97,14 @@ def run_solver(
         if solver.mesh is not None:
             u = jax.device_put(u, solver.sharding())
         state = type(state)(u=u, t=state.t, it=state.it)
-        # the .ckpt.json sidecar carries the physical bounds — a matching
-        # node count on a different domain is silently wrong physics
-        sidecar = resume + ".json"
-        if os.path.exists(sidecar):
-            with open(sidecar) as f:
-                meta = json.load(f)
+        # recorded physical bounds (.npz meta field / .ckpt sidecar) — a
+        # matching node count on a different domain is silently wrong
+        # physics
+        meta = io_utils.read_checkpoint_meta(resume)
+        got = (meta or {}).get("bounds")
+        if got is not None:
             want = [list(b) for b in solver.grid.bounds]
-            got = meta.get("bounds")
-            if got is not None and not np.allclose(got, want):
+            if not np.allclose(got, want):
                 raise ValueError(
                     f"checkpoint domain bounds {got} != configured "
                     f"bounds {want}"
@@ -170,7 +169,9 @@ def run_solver(
             sync(out.u)
             best = min(best, time.perf_counter() - t0)
 
-    n_iters = iters if iters is not None else max(1, int(out.it) or 1)
+    # iterations executed THIS run — a resumed state's it starts at the
+    # checkpoint's cumulative count, which must not inflate the summary
+    n_iters = iters if iters is not None else max(1, int(out.it) - start_it)
     dt = getattr(solver, "dt", None)
     if dt is None:
         dt = (float(out.t) - float(state.t)) / max(n_iters, 1)

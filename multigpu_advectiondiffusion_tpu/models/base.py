@@ -33,7 +33,11 @@ from multigpu_advectiondiffusion_tpu.core.dtypes import canonicalize
 from multigpu_advectiondiffusion_tpu.core.grid import Grid
 from multigpu_advectiondiffusion_tpu.models.state import SolverState
 from multigpu_advectiondiffusion_tpu.ops.stencils import Padder
-from multigpu_advectiondiffusion_tpu.parallel.halo import axis_offsets, make_padder
+from multigpu_advectiondiffusion_tpu.parallel.halo import (
+    axis_offsets,
+    make_ghost_fn,
+    make_padder,
+)
 from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition, shard_map
 from multigpu_advectiondiffusion_tpu.timestepping.integrators import INTEGRATORS
 from multigpu_advectiondiffusion_tpu.utils.ic import initial_condition
@@ -48,6 +52,10 @@ class StepContext:
     local_shape: Tuple[int, ...]
     global_shape: Tuple[int, ...]
     reduce_max: Callable[[jnp.ndarray], jnp.ndarray]
+    # (lo, hi) ghost slabs for sharded axes (None per-axis when local;
+    # None entirely when unsharded) — enables the overlapped
+    # interior/boundary schedule (ops.stencils.split_axis_apply)
+    ghost_fn: Optional[Callable] = None
 
 
 @dataclasses.dataclass
@@ -145,6 +153,7 @@ class SolverBase:
             local_shape=lshape,
             global_shape=gshape,
             reduce_max=(lambda x: lax.pmax(x, names)) if names else (lambda x: x),
+            ghost_fn=make_ghost_fn(self.decomp, sizes, self.bcs),
         )
 
     def _local_step(self, u, t, t_end=None):

@@ -54,6 +54,12 @@ class BurgersConfig:
     bc: object = "edge"
     t0: float = 0.0
     impl: str = "xla"  # kernel strategy: "xla" | "pallas"
+    # sharded halo schedule: "padded" | "split" (see DiffusionConfig)
+    overlap: str = "padded"
+
+    def __post_init__(self):
+        if self.overlap not in ("padded", "split"):
+            raise ValueError(f"unknown overlap {self.overlap!r}")
 
 
 class BurgersSolver(SolverBase):
@@ -68,6 +74,8 @@ class BurgersSolver(SolverBase):
         spacing = cfg.grid.spacing
         fx = self.flux
 
+        ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
+
         def rhs(u):
             acc = None
             for axis in range(u.ndim):
@@ -80,6 +88,7 @@ class BurgersSolver(SolverBase):
                     variant=cfg.weno_variant,
                     padder=ctx.padder,
                     impl=cfg.impl,
+                    ghost_fn=ghost_fn,
                 )
                 acc = div if acc is None else acc + div
             out = -acc
@@ -91,6 +100,7 @@ class BurgersSolver(SolverBase):
                     order=cfg.laplacian_order,
                     padder=ctx.padder,
                     impl=cfg.impl,
+                    ghost_fn=ghost_fn,
                 )
             return out
 

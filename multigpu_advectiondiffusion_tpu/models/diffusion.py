@@ -61,10 +61,17 @@ class DiffusionConfig:
     source: Optional[Callable] = None  # S(u) hook (heat3d.m:26-30)
     geometry: str = "cartesian"  # or "axisymmetric" (2-D r-y)
     impl: str = "xla"  # kernel strategy: "xla" | "pallas"
+    # sharded halo schedule: "padded" (exchange -> concat -> stencil) or
+    # "split" (interior computed concurrently with the in-flight ghost
+    # collectives, boundary bands patched after — the reference's
+    # boundary-first stream choreography as dataflow, main.c:203-260)
+    overlap: str = "padded"
 
     def __post_init__(self):
         if self.geometry not in ("cartesian", "axisymmetric"):
             raise ValueError(f"unknown geometry {self.geometry!r}")
+        if self.overlap not in ("padded", "split"):
+            raise ValueError(f"unknown overlap {self.overlap!r}")
         if self.geometry == "axisymmetric" and self.grid.ndim != 2:
             raise ValueError("axisymmetric geometry requires a 2-D (y, r) grid")
 
@@ -118,6 +125,8 @@ class DiffusionSolver(SolverBase):
 
         else:
 
+            ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
+
             def operator(u):
                 return laplacian(
                     u,
@@ -126,6 +135,7 @@ class DiffusionSolver(SolverBase):
                     order=cfg.order,
                     padder=ctx.padder,
                     impl=cfg.impl,
+                    ghost_fn=ghost_fn,
                 )
 
         walled_axes = [a for a, b in enumerate(bcs) if b.kind != "periodic"]

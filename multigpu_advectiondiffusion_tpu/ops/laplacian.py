@@ -22,7 +22,12 @@ from typing import Sequence
 import jax.numpy as jnp
 
 from multigpu_advectiondiffusion_tpu.core.bc import Boundary, pad_axis
-from multigpu_advectiondiffusion_tpu.ops.stencils import Padder, shifted
+from multigpu_advectiondiffusion_tpu.ops.stencils import (
+    GhostFn,
+    Padder,
+    shifted,
+    split_axis_apply,
+)
 
 # order -> (coefficients, halo radius, denominator)
 D2_STENCILS = {
@@ -64,6 +69,7 @@ def laplacian(
     padder: Padder | None = None,
     bcs: Sequence[Boundary] | None = None,
     impl: str = "xla",
+    ghost_fn: GhostFn | None = None,
 ) -> jnp.ndarray:
     """``sum_axis K_axis * d2u/dx_axis^2`` over all array axes.
 
@@ -71,6 +77,9 @@ def laplacian(
     (single-device BC padding) must be provided. ``impl`` selects the
     kernel strategy: ``"xla"`` (fused shifted slices) or ``"pallas"``
     (VMEM slab-pipelined TPU kernel; falls back to XLA where unsupported).
+    ``ghost_fn`` (sharded axes only) switches those axes to the
+    overlapped interior/boundary schedule (:func:`split_axis_apply`);
+    ignored on the Pallas path, which consumes one padded array.
     """
     if (padder is None) == (bcs is None):
         raise ValueError("provide exactly one of padder/bcs")
@@ -100,8 +109,15 @@ def laplacian(
 
     acc = None
     for axis in range(u.ndim):
-        term = diffusivity[axis] * d2_from_padded(
-            padder(u, axis, r), axis, spacing[axis], order
-        )
+        ghosts = ghost_fn(u, axis, r) if ghost_fn is not None else None
+        if ghosts is not None:
+            term = diffusivity[axis] * split_axis_apply(
+                lambda up, a=axis: d2_from_padded(up, a, spacing[a], order),
+                u, axis, r, *ghosts,
+            )
+        else:
+            term = diffusivity[axis] * d2_from_padded(
+                padder(u, axis, r), axis, spacing[axis], order
+            )
         acc = term if acc is None else acc + term
     return acc

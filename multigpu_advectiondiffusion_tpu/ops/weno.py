@@ -33,7 +33,12 @@ import jax.numpy as jnp
 
 from multigpu_advectiondiffusion_tpu.core.bc import Boundary, pad_axis
 from multigpu_advectiondiffusion_tpu.ops.flux import Flux
-from multigpu_advectiondiffusion_tpu.ops.stencils import Padder, shifted
+from multigpu_advectiondiffusion_tpu.ops.stencils import (
+    GhostFn,
+    Padder,
+    shifted,
+    split_axis_apply,
+)
 
 HALO = {5: 3, 7: 4}
 EPSILON = 1e-6  # WENO5resAdv_X.m:75
@@ -186,6 +191,7 @@ def flux_divergence(
     padder: Padder | None = None,
     bc: Boundary | None = None,
     impl: str = "xla",
+    ghost_fn: GhostFn | None = None,
 ) -> jnp.ndarray:
     """Conservative residual ``d f(u) / dx`` along one axis.
 
@@ -194,10 +200,24 @@ def flux_divergence(
     ``WENO5resAdv_{X,Y,Z}.m``. Exactly one of ``padder``/``bc`` selects the
     ghost-cell source. ``impl``: ``"xla"`` or ``"pallas"`` (VMEM
     slab-pipelined kernel; falls back to XLA where unsupported).
+    ``ghost_fn`` switches sharded axes to the overlapped
+    interior/boundary schedule (:func:`split_axis_apply`).
     """
     if (padder is None) == (bc is None):
         raise ValueError("provide exactly one of padder/bc")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown WENO impl {impl!r}; use 'xla'/'pallas'")
     r = HALO[order]
+
+    def div_from_padded(up):
+        h = interface_flux_from_padded(up, axis, flux, order, variant)
+        m = up.shape[axis] - 2 * r
+        return (shifted(h, axis, 1, m) - shifted(h, axis, 0, m)) / dx
+
+    ghosts = ghost_fn(u, axis, r) if ghost_fn is not None else None
+    if ghosts is not None and impl != "pallas":
+        return split_axis_apply(div_from_padded, u, axis, r, *ghosts)
+
     up = padder(u, axis, r) if padder is not None else pad_axis(u, axis, r, bc)
 
     if impl == "pallas":
@@ -210,9 +230,5 @@ def flux_divergence(
             return pallas_weno.flux_divergence_pallas(
                 up, axis, dx, flux, variant
             )
-    elif impl != "xla":
-        raise ValueError(f"unknown WENO impl {impl!r}; use 'xla'/'pallas'")
 
-    h = interface_flux_from_padded(up, axis, flux, order, variant)
-    n = u.shape[axis]
-    return (shifted(h, axis, 1, n) - shifted(h, axis, 0, n)) / dx
+    return div_from_padded(up)

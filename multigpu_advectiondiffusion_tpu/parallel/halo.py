@@ -28,19 +28,23 @@ from multigpu_advectiondiffusion_tpu.ops.stencils import Padder, slice_axis
 from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition
 
 
-def exchange_axis(
+def exchange_ghosts(
     u: jnp.ndarray,
     axis: int,
     halo: int,
     mesh_axis: str,
     num_shards: int,
     bc: Boundary,
-) -> jnp.ndarray:
-    """Pad one axis of a shard-local block with neighbor (or BC) ghost cells.
+):
+    """The two ``ppermute`` shifts of a halo exchange, returned as the
+    ``(lo, hi)`` ghost slabs without concatenating onto ``u``.
 
-    Must run inside ``shard_map`` with ``mesh_axis`` in scope. Uses cyclic
-    permutes; for non-periodic axes the global-edge shards overwrite the
-    wrapped block with BC ghosts (Dirichlet fill or edge replication).
+    Building block for the overlapped interior/boundary schedule
+    (:func:`ops.stencils.split_axis_apply`): keeping the ghosts as
+    separate values lets XLA schedule the collectives concurrently with
+    interior compute that does not depend on them — the role of the
+    reference's boundary-first five-stream choreography
+    (``MultiGPU/Diffusion3d_Baseline/main.c:203-297``).
     """
     n_local = u.shape[axis]
     if n_local < halo:
@@ -65,6 +69,26 @@ def exchange_axis(
             boundary_halo(u, axis, halo, bc, "right"),
             from_right,
         )
+    return from_left, from_right
+
+
+def exchange_axis(
+    u: jnp.ndarray,
+    axis: int,
+    halo: int,
+    mesh_axis: str,
+    num_shards: int,
+    bc: Boundary,
+) -> jnp.ndarray:
+    """Pad one axis of a shard-local block with neighbor (or BC) ghost cells.
+
+    Must run inside ``shard_map`` with ``mesh_axis`` in scope. Uses cyclic
+    permutes; for non-periodic axes the global-edge shards overwrite the
+    wrapped block with BC ghosts (Dirichlet fill or edge replication).
+    """
+    from_left, from_right = exchange_ghosts(
+        u, axis, halo, mesh_axis, num_shards, bc
+    )
     return jnp.concatenate([from_left, u, from_right], axis=axis)
 
 
@@ -83,6 +107,26 @@ def make_padder(
         return exchange_axis(u, axis, halo, name, mesh_axis_sizes[name], bcs[axis])
 
     return padder
+
+
+def make_ghost_fn(
+    decomp: Decomposition,
+    mesh_axis_sizes: Dict[str, int],
+    bcs: Sequence[Boundary],
+):
+    """Ghost-slab closure for the overlapped schedule: returns
+    ``(lo, hi)`` for sharded axes, ``None`` for local axes (whose ghosts
+    are plain BC padding with nothing to overlap)."""
+
+    def ghost_fn(u: jnp.ndarray, axis: int, halo: int):
+        name = decomp.mesh_axis(axis)
+        if name is None or mesh_axis_sizes[name] == 1:
+            return None
+        return exchange_ghosts(
+            u, axis, halo, name, mesh_axis_sizes[name], bcs[axis]
+        )
+
+    return ghost_fn
 
 
 def axis_offsets(decomp: Decomposition, local_shape: Sequence[int]):

@@ -69,14 +69,21 @@ def hybrid_mesh(
         devices = mesh_utils.create_hybrid_device_mesh(
             ici_sizes, dcn_sizes, devices=jax.devices()
         )
-    except ValueError:
+    except ValueError as err:
+        if "attribute" not in str(err):
+            # A real misconfiguration (axis sizes vs device count etc.)
+            # must stay loud — only the missing-slice-topology case has a
+            # fallback.
+            raise
         if dcn_total == 1:
             # Platforms whose devices carry no slice topology (e.g. the
             # virtual-CPU test mesh): with no cross-slice axis a plain
-            # row-major mesh is a valid, if unoptimized, hybrid mesh.
-            devices = np.asarray(jax.devices()[:total]).reshape(
-                dcn_sizes + ici_sizes
-            )
+            # row-major mesh over ALL devices is a valid, if unoptimized,
+            # hybrid mesh.
+            devs = jax.devices()
+            if total != len(devs):
+                raise
+            devices = np.asarray(devs).reshape(dcn_sizes + ici_sizes)
             return Mesh(devices, names)
         # Devices without a slice_index attribute but a real DCN extent:
         # group by process instead (raises a clear ValueError if the

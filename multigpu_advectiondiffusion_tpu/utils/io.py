@@ -373,6 +373,25 @@ def load_checkpoint(path: str) -> SolverState:
         )
 
 
+def read_checkpoint_meta(path: str) -> Optional[dict]:
+    """Grid metadata recorded with a checkpoint, or ``None`` if absent.
+
+    ``.npz`` checkpoints embed it in the archive's ``meta`` field;
+    ``.ckpt`` checkpoints carry it in the ``<path>.json`` sidecar.
+    """
+    if path.endswith(".npz"):
+        with np.load(path, allow_pickle=False) as z:
+            if "meta" not in z:
+                return None
+            meta = json.loads(str(z["meta"]))
+            return meta or None
+    sidecar = path + ".json"
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            return json.load(f)
+    return None
+
+
 def rotate_checkpoints(directory: str, keep: int, prefix: str = "checkpoint_"):
     """Delete all but the newest ``keep`` checkpoints in ``directory``
     (matched by ``prefix`` + a known checkpoint extension), oldest first
