@@ -66,6 +66,10 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
     p.add_argument("--resume", default=None, metavar="CKPT",
                    help="resume from a .ckpt/.npz checkpoint instead of "
                         "the initial condition")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler device trace of the timed "
+                        "solve into DIR (TensorBoard/Perfetto viewable) — "
+                        "the nvprof wrapping of profile.sh, TPU-style")
     p.add_argument("--impl", default="xla", choices=["xla", "pallas"],
                    help="kernel strategy (pallas = fused/VMEM-slab TPU "
                         "kernels where eligible, XLA fallback otherwise)")
@@ -123,7 +127,7 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       snapshot_every=args.snapshot_every,
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_keep=args.checkpoint_keep,
-                      resume=args.resume)
+                      resume=args.resume, profile_dir=args.profile)
 
 
 def _run_burgers(args, ndim):
@@ -158,7 +162,7 @@ def _run_burgers(args, ndim):
                       snapshot_every=args.snapshot_every,
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_keep=args.checkpoint_keep,
-                      resume=args.resume)
+                      resume=args.resume, profile_dir=args.profile)
 
 
 def _run_convergence(args):

@@ -6,6 +6,7 @@ build solver → save ``initial.bin`` → timed hot loop → save ``result.bin``
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -70,6 +71,7 @@ def run_solver(
     checkpoint_every: int = 0,
     checkpoint_keep: int = 0,
     resume: Optional[str] = None,
+    profile_dir: Optional[str] = None,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
@@ -127,47 +129,57 @@ def run_solver(
     compile_s = time.perf_counter() - t0
 
     periodic = (snapshot_every or checkpoint_every) and iters is not None
+    if periodic and not save_dir:
+        raise ValueError("snapshot/checkpoint output needs save_dir")
+
     best = float("inf")
-    if periodic:
-        if not save_dir:
-            raise ValueError("snapshot/checkpoint output needs save_dir")
-        chunk = min(x for x in (snapshot_every, checkpoint_every) if x)
-        with io_utils.AsyncBinaryWriter() as writer:
-            t0 = time.perf_counter()
-            out, done = state, 0
-            while done < iters:
-                n = min(chunk, iters - done)
-                out = solver.run(out, n)
-                done += n
-                # filenames carry the GLOBAL iteration so a resumed run
-                # continues the numbering instead of overwriting earlier
-                # artifacts in the same directory
-                glob_it = start_it + done
-                if snapshot_every and done % snapshot_every == 0:
-                    writer.submit(
-                        out.u,
-                        os.path.join(save_dir, f"snap_{glob_it:06d}.bin"),
-                    )
-                if checkpoint_every and done % checkpoint_every == 0:
-                    io_utils.save_checkpoint(
-                        os.path.join(
-                            save_dir, f"checkpoint_{glob_it:06d}.ckpt"
-                        ),
-                        out,
-                        grid=solver.grid,
-                    )
-                    io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
-            sync(out.u)
-            best = time.perf_counter() - t0
-    else:
-        for _ in range(max(1, repeats)):
-            t0 = time.perf_counter()
-            if iters is not None:
-                out = solver.run(state, iters)
-            else:
-                out = solver.advance_to(state, t_end)
-            sync(out.u)
-            best = min(best, time.perf_counter() - t0)
+    # the trace context closes on every exit path, including exceptions
+    # raised inside the timed solve (a leaked jax.profiler trace poisons
+    # every later start_trace in the process)
+    profiled = contextlib.ExitStack()
+    if profile_dir:
+        from multigpu_advectiondiffusion_tpu.utils.profiling import trace
+
+        profiled.enter_context(trace(profile_dir))
+    with profiled:
+        if periodic:
+            chunk = min(x for x in (snapshot_every, checkpoint_every) if x)
+            with io_utils.AsyncBinaryWriter() as writer:
+                t0 = time.perf_counter()
+                out, done = state, 0
+                while done < iters:
+                    n = min(chunk, iters - done)
+                    out = solver.run(out, n)
+                    done += n
+                    # filenames carry the GLOBAL iteration so a resumed
+                    # run continues the numbering instead of overwriting
+                    # earlier artifacts in the same directory
+                    glob_it = start_it + done
+                    if snapshot_every and done % snapshot_every == 0:
+                        writer.submit(
+                            out.u,
+                            os.path.join(save_dir, f"snap_{glob_it:06d}.bin"),
+                        )
+                    if checkpoint_every and done % checkpoint_every == 0:
+                        io_utils.save_checkpoint(
+                            os.path.join(
+                                save_dir, f"checkpoint_{glob_it:06d}.ckpt"
+                            ),
+                            out,
+                            grid=solver.grid,
+                        )
+                        io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
+                sync(out.u)
+                best = time.perf_counter() - t0
+        else:
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                if iters is not None:
+                    out = solver.run(state, iters)
+                else:
+                    out = solver.advance_to(state, t_end)
+                sync(out.u)
+                best = min(best, time.perf_counter() - t0)
 
     # iterations executed THIS run — a resumed state's it starts at the
     # checkpoint's cumulative count, which must not inflate the summary
