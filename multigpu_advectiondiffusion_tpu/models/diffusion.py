@@ -202,7 +202,7 @@ class DiffusionSolver(SolverBase):
             and cfg.reference_parity
             and cfg.boundary_band >= 1  # kernel's face clamp lives inside
             # the non-interior branch; band 0 would let faces evolve
-            and self.grid.ndim == 3
+            and self.grid.ndim in (2, 3)
             and self.dtype == jnp.float32
             and all(b.kind == "dirichlet" for b in bcs)
             and all(b.value == bcs[0].value for b in bcs)
@@ -210,15 +210,22 @@ class DiffusionSolver(SolverBase):
         if not eligible:
             return None
         if "fused" not in self._cache:
-            from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
-                FusedDiffusionStepper,
-            )
+            if self.grid.ndim == 3:
+                from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (  # noqa: E501
+                    FusedDiffusionStepper as cls,
+                )
+            else:
+                from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion2d import (  # noqa: E501
+                    FusedDiffusion2DStepper as cls,
+                )
 
-            self._cache["fused"] = FusedDiffusionStepper(
+                if not cls.supported(self.grid.shape, self.dtype):
+                    return None
+            self._cache["fused"] = cls(
                 self.grid.shape,
                 self.dtype,
                 self.grid.spacing,
-                [cfg.diffusivity] * 3,
+                [cfg.diffusivity] * self.grid.ndim,
                 self.dt,
                 cfg.boundary_band,
                 bcs[0].value,

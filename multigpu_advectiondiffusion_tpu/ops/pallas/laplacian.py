@@ -186,12 +186,12 @@ def laplacian_o4_2d(
 
 
 def fits_vmem(shape: Sequence[int], halo: int, n_live: int,
-              itemsize: int = 4) -> bool:
+              itemsize: int = 4, budget: int = VMEM_BUDGET) -> bool:
     """Whether a whole-array 2-D kernel with ``n_live`` full-size live
-    intermediates fits the conservative VMEM budget after tile rounding."""
+    intermediates fits the VMEM ``budget`` after tile rounding."""
     rows = _round_up(shape[0] + 2 * halo, SUBLANE)
     cols = _round_up(shape[1] + 2 * halo, LANE)
-    return n_live * rows * cols * itemsize <= VMEM_BUDGET
+    return n_live * rows * cols * itemsize <= budget
 
 
 def supported(shape: Sequence[int], order: int, itemsize: int = 4) -> bool:

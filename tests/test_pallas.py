@@ -181,3 +181,47 @@ def test_fused_burgers_ghost_maintenance_long_run():
     scale = float(np.max(np.abs(outs["xla"])))
     np.testing.assert_allclose(outs["pallas"], outs["xla"],
                                rtol=5e-5, atol=5e-6 * scale)
+
+
+def test_fused_diffusion2d_run_matches_xla():
+    """The whole-run VMEM-resident 2-D stepper (run() with impl='pallas'
+    on an eligible 2-D config) must agree with the generic XLA path to
+    f32 rounding, including the accumulated t."""
+    grid = Grid.make(40, 28, lengths=10.0)
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = DiffusionConfig(grid=grid, dtype="float32", impl=impl)
+        solver = DiffusionSolver(cfg)
+        if impl == "pallas":
+            fused = solver._fused_stepper()
+            assert fused is not None, "2-D fast path not taken"
+            assert type(fused).__name__ == "FusedDiffusion2DStepper"
+        st = solver.run(solver.initial_state(), 9)
+        outs[impl] = (np.asarray(st.u), float(st.t))
+    np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
+                               rtol=1e-5, atol=1e-6)
+    assert outs["pallas"][1] == outs["xla"][1]
+
+
+def test_fused_diffusion2d_zero_iters_identity():
+    grid = Grid.make(24, 16, lengths=4.0)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas"))
+    st0 = solver.initial_state()
+    st = solver.run(st0, 0)
+    np.testing.assert_array_equal(np.asarray(st.u), np.asarray(st0.u))
+    assert float(st.t) == float(st0.t)
+
+
+def test_fused_diffusion2d_too_large_falls_back():
+    """Grids whose padded state cannot fit the VMEM budget quietly use
+    the generic path."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion2d import (
+        FusedDiffusion2DStepper,
+    )
+
+    assert not FusedDiffusion2DStepper.supported((8192, 8192), jnp.float32)
+    grid = Grid.make(8192, 8192, lengths=10.0)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas"))
+    assert solver._fused_stepper() is None
