@@ -117,16 +117,18 @@ class BurgersSolver(SolverBase):
     # ------------------------------------------------------------------ #
     def _fused_stepper(self):
         """The fused SSP-RK3 stepper when this config is eligible, else
-        ``None``. Eligibility mirrors the kernel's assumptions: 3-D
+        ``None``. Eligibility mirrors the kernels' assumptions: 2-D/3-D
         cartesian WENO5, edge ghosts, fixed dt (adaptive dt needs a
-        global reduction before stage 1), one chip, f32."""
+        global reduction before stage 1), one chip, f32. 3-D dispatches
+        the slab-pipelined per-stage kernel; 2-D the whole-run
+        VMEM-resident stepper."""
         import jax.numpy as jnp
 
         cfg = self.cfg
         eligible = (
             cfg.impl == "pallas"
             and self.mesh is None
-            and self.grid.ndim == 3
+            and self.grid.ndim in (2, 3)
             and cfg.weno_order == 5
             and cfg.weno_variant in ("js", "z")
             and cfg.integrator == "ssp_rk3"
@@ -137,14 +139,18 @@ class BurgersSolver(SolverBase):
         )
         if not eligible:
             return None
-        from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
-            FusedBurgersStepper,
-        )
-
-        if not FusedBurgersStepper.supported(self.grid.shape, self.dtype):
+        if self.grid.ndim == 3:
+            from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (  # noqa: E501
+                FusedBurgersStepper as cls,
+            )
+        else:
+            from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (  # noqa: E501
+                FusedBurgers2DStepper as cls,
+            )
+        if not cls.supported(self.grid.shape, self.dtype):
             return None
         if "fused" not in self._cache:
-            self._cache["fused"] = FusedBurgersStepper(
+            self._cache["fused"] = cls(
                 self.grid.shape,
                 self.dtype,
                 self.grid.spacing,

@@ -1,0 +1,141 @@
+"""Whole-run VMEM-resident SSP-RK3 stepping for 2-D Burgers/WENO5.
+
+Same design as :mod:`fused_diffusion2d`: a reference-scale 2-D grid
+(400×406, ``MultiGPU/Burgers2d_Baseline/Run.m``) is under 1 MB in f32,
+so the padded state is loaded into VMEM once, every WENO sweep of every
+RK stage of every iteration runs in-core, and the result is written back
+once. The reference launches 2 sweep kernels + an RK kernel per stage
+per iteration, each streaming the state through device memory
+(``Burgers2d_Baseline/Kernels.cu``); here a 200-iteration run does two
+HBM transfers total.
+
+Ghost discipline follows :mod:`fused_burgers`: all non-interior cells
+hold edge-replicated values (``WENO5resAdv_X.m:53``), re-synthesized
+from the freshly computed interior after every stage; stencil reads are
+masked circular shifts. Fixed dt only (adaptive dt needs a global
+``max|f'(u)|`` before stage 1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+from multigpu_advectiondiffusion_tpu.ops.flux import Flux
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+    _div_roll,
+    _split,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import _shift
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    LANE,
+    O4_COEFFS,
+    SUBLANE,
+    round_up,
+)
+
+R = 3  # WENO5 stencil radius == ghost width
+
+# WENO keeps many more live full-array temporaries than the Laplacian
+# (vp/vm, 10 shifted operands, betas, weights, interface fluxes).
+_VMEM_BUDGET = 64 * 1024 * 1024
+_LIVE_BUFFERS = 24
+
+
+def _edge_fill_2d(rk, ny, nx):
+    """Edge-replicate every non-interior cell (corners/slack included)."""
+    gy = lax.broadcasted_iota(jnp.int32, rk.shape, 0) - R
+    gx = lax.broadcasted_iota(jnp.int32, rk.shape, 1) - R
+    t = jnp.where(gx < 0, rk[:, R : R + 1], rk)
+    t = jnp.where(gx >= nx, t[:, R + nx - 1 : R + nx], t)
+    t = jnp.where(gy < 0, t[R : R + 1, :], t)
+    return jnp.where(gy >= ny, t[R + ny - 1 : R + ny, :], t)
+
+
+def _laplacian_2d(v, scales):
+    acc = None
+    for axis in range(2):
+        for j, c in enumerate(O4_COEFFS):
+            term = _shift(v, j - 2, axis) * jnp.asarray(c * scales[axis], v.dtype)
+            acc = term if acc is None else acc + term
+    return acc
+
+
+def _stage(u, v, *, interior_shape, inv_dx, nu_scales, flux, variant, a, b, dt):
+    """One RK stage over the full padded array, ghosts re-synthesized."""
+    ny, nx = interior_shape
+    vp, vm = _split(flux, v)
+    rhs = -(
+        _div_roll(vp, vm, 0, inv_dx[0], variant)
+        + _div_roll(vp, vm, 1, inv_dx[1], variant)
+    )
+    if nu_scales is not None:
+        rhs = rhs + _laplacian_2d(v, nu_scales)
+    rk = b * (v + dt * rhs) if a == 0.0 else a * u + b * (v + dt * rhs)
+    return _edge_fill_2d(rk.astype(v.dtype), ny, nx)
+
+
+class FusedBurgers2DStepper:
+    """Jit-cached whole-run VMEM stepper for one (grid, flux, dt)."""
+
+    def __init__(self, interior_shape, dtype, spacing, flux: Flux,
+                 variant: str, nu: float, dt: float):
+        ny, nx = interior_shape
+        self.interior_shape = tuple(interior_shape)
+        self.padded_shape = (
+            round_up(ny + 2 * R, SUBLANE),
+            round_up(nx + 2 * R, LANE),
+        )
+        self.dtype = jnp.dtype(dtype)
+        nu_scales = None
+        if nu:
+            nu_scales = tuple(
+                float(nu) / (12.0 * spacing[i] * spacing[i]) for i in range(2)
+            )
+        self._stage = functools.partial(
+            _stage,
+            interior_shape=self.interior_shape,
+            inv_dx=tuple(1.0 / spacing[i] for i in range(2)),
+            nu_scales=nu_scales,
+            flux=flux,
+            variant=variant,
+            dt=float(dt),
+        )
+        self.dt = float(dt)
+
+    @staticmethod
+    def supported(interior_shape, dtype) -> bool:
+        from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+            fits_vmem,
+        )
+
+        return fits_vmem(
+            interior_shape, R, _LIVE_BUFFERS,
+            jnp.dtype(dtype).itemsize, budget=_VMEM_BUDGET,
+        )
+
+    def embed(self, u):
+        ny, nx = self.interior_shape
+        py, px = self.padded_shape
+        return jnp.pad(
+            u.astype(self.dtype),
+            ((R, py - ny - R), (R, px - nx - R)),
+            mode="edge",
+        )
+
+    def extract(self, S):
+        ny, nx = self.interior_shape
+        return lax.slice(S, (R, R), (R + ny, R + nx))
+
+    def run(self, u, t, num_iters: int):
+        from multigpu_advectiondiffusion_tpu.ops.pallas.whole_run import (
+            accumulate_t,
+            whole_run,
+        )
+
+        if num_iters == 0:
+            return u, t
+        out = whole_run(self._stage, self.embed(u), num_iters)
+        return self.extract(out), accumulate_t(t, self.dt, num_iters)

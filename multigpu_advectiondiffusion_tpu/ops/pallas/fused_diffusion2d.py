@@ -24,23 +24,15 @@ from __future__ import annotations
 
 import functools
 
-import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
-    _STAGES,
-    _shift,
-)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import _shift
 from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     LANE,
     O4_COEFFS,
     R,
     SUBLANE,
-    compiler_params,
-    interpret_mode,
     round_up,
 )
 
@@ -50,13 +42,16 @@ _VMEM_BUDGET = 64 * 1024 * 1024
 _LIVE_BUFFERS = 8
 
 
-def _stage(u, v, *, interior, face, scales, a, b, dt, bc_value):
+def _stage(u, v, *, interior_shape, band, scales, a, b, dt, bc_value):
     """One RK stage ``a*u + b*(v + dt*L(v))`` over the full padded array.
 
-    Wraparound lanes from the circular shifts land only outside
-    ``interior`` and are replaced by the frozen boundary values.
+    Wraparound lanes from the circular shifts land only outside the
+    interior mask and are replaced by the frozen boundary values. The
+    masks are iota-derived inside the kernel (values may not be captured
+    from outside a Pallas body).
     """
     dtype = v.dtype
+    interior, face = _masks(v.shape, interior_shape, band)
     acc = None
     for axis in range(2):
         for j, c in enumerate(O4_COEFFS):
@@ -78,34 +73,6 @@ def _masks(padded_shape, interior_shape, band):
     interior = between(gy, ny) & between(gx, nx)
     face = (gy == 0) | (gy == ny - 1) | (gx == 0) | (gx == nx - 1)
     return interior, face
-
-
-def _kernel(s_hbm, out_hbm, S, T1, T2, sem, *, n_iters, padded_shape,
-            interior_shape, scales, dt, band, bc_value):
-    k = pl.program_id(0)
-    interior, face = _masks(padded_shape, interior_shape, band)
-    stage = functools.partial(
-        _stage, interior=interior, face=face, scales=scales, dt=dt,
-        bc_value=bc_value,
-    )
-
-    @pl.when(k == 0)
-    def _():
-        cp = pltpu.make_async_copy(s_hbm, S, sem)
-        cp.start()
-        cp.wait()
-
-    u = S[:]
-    (a1, b1), (a2, b2), (a3, b3) = _STAGES
-    T1[:] = stage(u, u, a=a1, b=b1)
-    T2[:] = stage(u, T1[:], a=a2, b=b2)
-    S[:] = stage(u, T2[:], a=a3, b=b3)
-
-    @pl.when(k == n_iters - 1)
-    def _():
-        cp = pltpu.make_async_copy(S, out_hbm, sem)
-        cp.start()
-        cp.wait()
 
 
 class FusedDiffusion2DStepper:
@@ -148,34 +115,16 @@ class FusedDiffusion2DStepper:
         return lax.slice(S, (R, R), (R + ny, R + nx))
 
     def run(self, u, t, num_iters: int):
+        from multigpu_advectiondiffusion_tpu.ops.pallas.whole_run import (
+            accumulate_t,
+            whole_run,
+        )
+
         if num_iters == 0:
             return u, t
-        S0 = self.embed(u)
-        kern = functools.partial(
-            _kernel,
-            n_iters=num_iters,
-            padded_shape=self.padded_shape,
-            interior_shape=self.interior_shape,
-            scales=self._scales,
-            dt=self.dt,
-            band=self._band,
-            bc_value=self.bc_value,
+        stage = functools.partial(
+            _stage, interior_shape=self.interior_shape, band=self._band,
+            scales=self._scales, dt=self.dt, bc_value=self.bc_value,
         )
-        out = pl.pallas_call(
-            kern,
-            grid=(num_iters,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pl.ANY),
-            out_shape=jax.ShapeDtypeStruct(self.padded_shape, self.dtype),
-            scratch_shapes=[
-                pltpu.VMEM(self.padded_shape, self.dtype),
-                pltpu.VMEM(self.padded_shape, self.dtype),
-                pltpu.VMEM(self.padded_shape, self.dtype),
-                pltpu.SemaphoreType.DMA,
-            ],
-            compiler_params=None if interpret_mode() else compiler_params(),
-            interpret=interpret_mode(),
-        )(S0)
-        # accumulate t iteratively, matching the generic loop's rounding
-        t = lax.fori_loop(0, num_iters, lambda i, tt: tt + self.dt, t)
-        return self.extract(out), t
+        out = whole_run(stage, self.embed(u), num_iters)
+        return self.extract(out), accumulate_t(t, self.dt, num_iters)

@@ -1,0 +1,77 @@
+"""Shared whole-run VMEM-resident SSP-RK3 driver.
+
+One Pallas program whose grid is the *iteration counter*: the padded
+state is DMA'd into VMEM scratch at the first grid step, all three RK
+stages of every iteration run in-core (the TPU grid is a sequential
+loop, so scratch persists across steps), and the result is written back
+at the last step. Used by :mod:`fused_diffusion2d` and
+:mod:`fused_burgers2d`, which differ only in the stage function.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import _STAGES
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    compiler_params,
+    interpret_mode,
+)
+
+
+def _kernel(s_hbm, out_hbm, S, T1, T2, sem, *, n_iters, stage):
+    k = pl.program_id(0)
+
+    @pl.when(k == 0)
+    def _():
+        cp = pltpu.make_async_copy(s_hbm, S, sem)
+        cp.start()
+        cp.wait()
+
+    u = S[:]
+    (a1, b1), (a2, b2), (a3, b3) = _STAGES
+    T1[:] = stage(u, u, a=a1, b=b1)
+    T2[:] = stage(u, T1[:], a=a2, b=b2)
+    S[:] = stage(u, T2[:], a=a3, b=b3)
+
+    @pl.when(k == n_iters - 1)
+    def _():
+        cp = pltpu.make_async_copy(S, out_hbm, sem)
+        cp.start()
+        cp.wait()
+
+
+def whole_run(stage, S0: jnp.ndarray, num_iters: int) -> jnp.ndarray:
+    """``num_iters`` fused SSP-RK3 steps of ``stage`` on padded state
+    ``S0``, entirely VMEM-resident; returns the final padded state.
+
+    ``stage(u, v, *, a, b)`` is one RK combination over the full padded
+    array (ghost discipline included).
+    """
+    kern = functools.partial(_kernel, n_iters=num_iters, stage=stage)
+    return pl.pallas_call(
+        kern,
+        grid=(num_iters,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(S0.shape, S0.dtype),
+        scratch_shapes=[
+            pltpu.VMEM(S0.shape, S0.dtype),
+            pltpu.VMEM(S0.shape, S0.dtype),
+            pltpu.VMEM(S0.shape, S0.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=None if interpret_mode() else compiler_params(),
+        interpret=interpret_mode(),
+    )(S0)
+
+
+def accumulate_t(t, dt: float, num_iters: int):
+    """Iterative t accumulation, matching the generic loop's rounding."""
+    return lax.fori_loop(0, num_iters, lambda i, tt: tt + dt, t)

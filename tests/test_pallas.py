@@ -225,3 +225,28 @@ def test_fused_diffusion2d_too_large_falls_back():
     solver = DiffusionSolver(
         DiffusionConfig(grid=grid, dtype="float32", impl="pallas"))
     assert solver._fused_stepper() is None
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [{}, {"weno_variant": "z"}, {"nu": 1e-3}, {"flux": "buckley"}],
+    ids=["js", "z", "viscous", "buckley"],
+)
+def test_fused_burgers2d_run_matches_xla(kw):
+    """The whole-run VMEM-resident 2-D Burgers stepper must agree with
+    the generic XLA path to f32 rounding, including accumulated t."""
+    grid = Grid.make(40, 24, lengths=[4.0, 2.5])
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, cfl=0.3, adaptive_dt=False,
+                            dtype="float32", ic="gaussian", impl=impl, **kw)
+        solver = BurgersSolver(cfg)
+        if impl == "pallas":
+            fused = solver._fused_stepper()
+            assert type(fused).__name__ == "FusedBurgers2DStepper", kw
+        st = solver.run(solver.initial_state(), 8)
+        outs[impl] = (np.asarray(st.u), float(st.t))
+    scale = float(np.max(np.abs(outs["xla"][0])))
+    np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
+                               rtol=3e-5, atol=3e-6 * scale)
+    assert outs["pallas"][1] == outs["xla"][1]
