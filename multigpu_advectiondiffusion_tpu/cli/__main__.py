@@ -70,7 +70,8 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                    help="capture a jax.profiler device trace of the timed "
                         "solve into DIR (TensorBoard/Perfetto viewable) — "
                         "the nvprof wrapping of profile.sh, TPU-style")
-    p.add_argument("--impl", default="xla", choices=["xla", "pallas"],
+    p.add_argument("--impl", default="xla",
+                   choices=["xla", "pallas", "pallas_step"],
                    help="kernel strategy (pallas = fused/VMEM-slab TPU "
                         "kernels where eligible, XLA fallback otherwise)")
 

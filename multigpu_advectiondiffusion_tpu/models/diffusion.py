@@ -60,7 +60,10 @@ class DiffusionConfig:
     boundary_band: int = 2  # width of the skipped band (Laplace3d.m:21)
     source: Optional[Callable] = None  # S(u) hook (heat3d.m:26-30)
     geometry: str = "cartesian"  # or "axisymmetric" (2-D r-y)
-    impl: str = "xla"  # kernel strategy: "xla" | "pallas"
+    # kernel strategy: "xla" | "pallas" (per-stage fused fast path) |
+    # "pallas_step" (whole-step temporal-blocking variant — a measured-
+    # slower ladder rung kept selectable for benchmarking)
+    impl: str = "xla"
     # sharded halo schedule: "padded" (exchange -> concat -> stencil) or
     # "split" (interior computed concurrently with the in-flight ghost
     # collectives, boundary bands patched after — the reference's
@@ -127,6 +130,8 @@ class DiffusionSolver(SolverBase):
 
             ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
 
+            op_impl = "pallas" if cfg.impl.startswith("pallas") else cfg.impl
+
             def operator(u):
                 return laplacian(
                     u,
@@ -134,7 +139,7 @@ class DiffusionSolver(SolverBase):
                     diffusivity=cfg.diffusivity,
                     order=cfg.order,
                     padder=ctx.padder,
-                    impl=cfg.impl,
+                    impl=op_impl,
                     ghost_fn=ghost_fn,
                 )
 
@@ -193,7 +198,7 @@ class DiffusionSolver(SolverBase):
         cfg = self.cfg
         bcs = self.bcs
         eligible = (
-            cfg.impl == "pallas"
+            cfg.impl in ("pallas", "pallas_step")
             and self.mesh is None
             and cfg.geometry == "cartesian"
             and cfg.order == 4
@@ -210,7 +215,11 @@ class DiffusionSolver(SolverBase):
         if not eligible:
             return None
         if "fused" not in self._cache:
-            if self.grid.ndim == 3:
+            if self.grid.ndim == 3 and cfg.impl == "pallas_step":
+                from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion_step import (  # noqa: E501
+                    StepFusedDiffusionStepper as cls,
+                )
+            elif self.grid.ndim == 3:
                 from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (  # noqa: E501
                     FusedDiffusionStepper as cls,
                 )
