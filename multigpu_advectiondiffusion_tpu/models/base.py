@@ -171,7 +171,12 @@ class SolverBase:
     # ------------------------------------------------------------------ #
     def _wrap(self, fn, n_out_scalars: int = 1):
         """Jit a block program ``(u, t) -> (u, *scalars)``; sharded, the
-        field follows the decomposition spec and scalars are replicated."""
+        field follows the decomposition spec and scalars are replicated.
+
+        The replication/vma checker stays on except for Pallas-flavored
+        configs, whose ``pallas_call`` outputs carry no vma typing."""
+        from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
+
         if self.mesh is None:
             return jax.jit(fn)
         spec = self.decomp.partition_spec(self.grid.ndim)
@@ -181,6 +186,7 @@ class SolverBase:
                 mesh=self.mesh,
                 in_specs=(spec, P()),
                 out_specs=(spec,) + (P(),) * n_out_scalars,
+                check=not is_pallas_impl(getattr(self.cfg, "impl", "")),
             )
         )
 

@@ -53,7 +53,10 @@ class BurgersConfig:
     ic_params: Tuple = ()
     bc: object = "edge"
     t0: float = 0.0
-    impl: str = "xla"  # kernel strategy: "xla" | "pallas"
+    # kernel strategy: "xla" | "pallas"; other pallas flavors (e.g. the
+    # CLI-global "pallas_step") are accepted and map to the per-axis
+    # pallas kernels (Burgers has no whole-step variant)
+    impl: str = "xla"
     # sharded halo schedule: "padded" | "split" (see DiffusionConfig)
     overlap: str = "padded"
 
@@ -74,7 +77,12 @@ class BurgersSolver(SolverBase):
         spacing = cfg.grid.spacing
         fx = self.flux
 
+        from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
+
         ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
+        # Burgers has no whole-step variant; any pallas flavor (e.g. the
+        # CLI's global --impl pallas_step) maps to the per-axis kernels.
+        impl = _norm(cfg.impl)
 
         def rhs(u):
             acc = None
@@ -87,7 +95,7 @@ class BurgersSolver(SolverBase):
                     order=cfg.weno_order,
                     variant=cfg.weno_variant,
                     padder=ctx.padder,
-                    impl=cfg.impl,
+                    impl=impl,
                     ghost_fn=ghost_fn,
                 )
                 acc = div if acc is None else acc + div
@@ -99,7 +107,7 @@ class BurgersSolver(SolverBase):
                     diffusivity=cfg.nu,
                     order=cfg.laplacian_order,
                     padder=ctx.padder,
-                    impl=cfg.impl,
+                    impl=impl,
                     ghost_fn=ghost_fn,
                 )
             return out
@@ -124,9 +132,11 @@ class BurgersSolver(SolverBase):
         VMEM-resident stepper."""
         import jax.numpy as jnp
 
+        from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
+
         cfg = self.cfg
         eligible = (
-            cfg.impl == "pallas"
+            is_pallas_impl(cfg.impl)
             and self.mesh is None
             and self.grid.ndim in (2, 3)
             and cfg.weno_order == 5

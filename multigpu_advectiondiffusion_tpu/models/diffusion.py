@@ -130,7 +130,9 @@ class DiffusionSolver(SolverBase):
 
             ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
 
-            op_impl = "pallas" if cfg.impl.startswith("pallas") else cfg.impl
+            from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
+
+            impl = _norm(cfg.impl)
 
             def operator(u):
                 return laplacian(
@@ -139,7 +141,7 @@ class DiffusionSolver(SolverBase):
                     diffusivity=cfg.diffusivity,
                     order=cfg.order,
                     padder=ctx.padder,
-                    impl=op_impl,
+                    impl=impl,
                     ghost_fn=ghost_fn,
                 )
 
@@ -197,8 +199,10 @@ class DiffusionSolver(SolverBase):
         3-D cartesian O4, one chip, f32."""
         cfg = self.cfg
         bcs = self.bcs
+        from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
+
         eligible = (
-            cfg.impl in ("pallas", "pallas_step")
+            is_pallas_impl(cfg.impl)
             and self.mesh is None
             and cfg.geometry == "cartesian"
             and cfg.order == 4

@@ -1,3 +1,16 @@
 from multigpu_advectiondiffusion_tpu.ops import flux, laplacian, weno, stencils, axisym
 
 __all__ = ["flux", "laplacian", "weno", "stencils", "axisym"]
+
+
+def is_pallas_impl(impl: str) -> bool:
+    """Whether a solver ``impl`` string selects a Pallas kernel flavor
+    ("pallas", "pallas_step", ...) — the single definition both solvers'
+    eligibility checks use."""
+    return impl.startswith("pallas")
+
+
+def op_impl(impl: str) -> str:
+    """Normalize a solver ``impl`` flavor to what the per-op dispatchers
+    accept: every Pallas flavor maps to "pallas"."""
+    return "pallas" if is_pallas_impl(impl) else impl

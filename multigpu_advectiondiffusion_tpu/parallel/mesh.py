@@ -10,6 +10,7 @@ or full 3-D blocks — and device placement is XLA's.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import math
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -17,9 +18,25 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 try:  # jax >= 0.4.35 promoted shard_map out of experimental
-    shard_map = jax.shard_map
+    _shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore  # noqa: E501
+
+# the replication checker kwarg was renamed check_rep -> check_vma
+_CHECK_KWARG = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(*args, check: bool = True, **kwargs):
+    """Project ``shard_map``. ``check=False`` disables the
+    varying-across-mesh-axes/replication checker — needed only for
+    programs containing ``pallas_call`` (whose output avals carry no
+    ``vma`` typing); everything else keeps the checker on."""
+    kwargs.setdefault(_CHECK_KWARG, check)
+    return _shard_map(*args, **kwargs)
 
 
 def make_mesh(
