@@ -158,6 +158,22 @@ def save_binary(u, path: str) -> None:
     arr.tofile(path)
 
 
+def print_field(u, file=None) -> None:
+    """Console dump of a field, one ``%8.2f``-style row per line — the
+    debugging role of ``Print2D/Print3D`` (``Tools.c:32-63``); 3-D arrays
+    print as z-slices separated by blank lines."""
+    import sys
+
+    out = file or sys.stdout
+    arr = np.asarray(u)
+    planes = arr.reshape((-1,) + arr.shape[-2:]) if arr.ndim >= 2 else arr[None, None]
+    for k, plane in enumerate(planes):
+        if k:
+            out.write("\n")
+        for row in plane:
+            out.write(" ".join(f"{v:8.2f}" for v in row) + "\n")
+
+
 def load_binary(path: str, shape) -> np.ndarray:
     return np.fromfile(path, dtype=np.float32).reshape(shape)
 

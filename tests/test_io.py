@@ -157,3 +157,15 @@ def test_rotate_checkpoints(tmp_path):
     # keep=0 means keep everything
     tio.rotate_checkpoints(str(tmp_path), keep=0)
     assert len(sorted(f for f in os.listdir(tmp_path) if f.endswith(".ckpt"))) == 2
+
+
+def test_print_field_layout():
+    """Print2D/Print3D console-dump analog: rows per line, blank line
+    between z-slices."""
+    import io as _io
+
+    buf = _io.StringIO()
+    tio.print_field(np.arange(12).reshape(2, 2, 3), file=buf)
+    blocks = buf.getvalue().strip().split("\n\n")
+    assert len(blocks) == 2
+    assert blocks[0].splitlines()[0].split() == ["0.00", "1.00", "2.00"]
