@@ -165,8 +165,8 @@ def print_field(u, file=None) -> None:
     import sys
 
     out = file or sys.stdout
-    arr = np.asarray(u)
-    planes = arr.reshape((-1,) + arr.shape[-2:]) if arr.ndim >= 2 else arr[None, None]
+    arr = np.atleast_2d(np.asarray(u))
+    planes = arr.reshape((-1,) + arr.shape[-2:])
     for k, plane in enumerate(planes):
         if k:
             out.write("\n")
