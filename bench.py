@@ -20,6 +20,11 @@ BASELINE_MLUPS = 731.0  # MultiGPU Diffusion3d, 2 GPUs total (BASELINE.md)
 
 
 def main() -> None:
+    from multigpu_advectiondiffusion_tpu.utils.platform_env import (
+        honor_platform_env,
+    )
+
+    honor_platform_env()
     from multigpu_advectiondiffusion_tpu.bench.timing import timed_run
     from multigpu_advectiondiffusion_tpu import DiffusionConfig, DiffusionSolver, Grid
     from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES

@@ -1,0 +1,5 @@
+#!/bin/sh
+# MultiGPU/Diffusion3d_Baseline/run.sh: K=1, L=W=2 H=2, 400x200x200, 1000 iters, 2 ranks
+python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+    --K 1.0 --lengths 2 2 2 --n 400 200 200 --iters 1000 \
+    --mesh dz=2 --save out/multigpu_diffusion3d "$@"

@@ -1,0 +1,6 @@
+#!/bin/sh
+# MultiGPU/Diffusion3d_Baseline/profile.sh: per-rank nvprof wrap ->
+# one jax.profiler device trace (TensorBoard/Perfetto viewable).
+python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+    --K 1.0 --lengths 2 2 2 --n 400 200 200 --iters 100 \
+    --profile out/trace "$@"

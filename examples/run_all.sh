@@ -1,0 +1,5 @@
+#!/bin/sh
+# SingleGPU/RunAll.m: batch over the whole variant ladder -> the
+# benchmark matrix sweeps every reference config and records MLUPS
+# next to the archived Run.m numbers.
+python -m multigpu_advectiondiffusion_tpu.bench --out out/bench.jsonl "$@"

@@ -1,0 +1,6 @@
+#!/bin/sh
+# SingleGPU/Burgers3d_WENO5/run.sh: tEnd=0.1 CFL=0.3, 2^3 domain, 1000x1000x200
+# (viscous, nu=1e-5, like the single-GPU variants)
+python -m multigpu_advectiondiffusion_tpu.cli burgers3d \
+    --t-end 0.1 --cfl 0.3 --lengths 2 2 2 --n 1000 1000 200 \
+    --nu 1e-5 --fixed-dt --save out/singlegpu_burgers3d "$@"

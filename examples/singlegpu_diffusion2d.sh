@@ -1,0 +1,5 @@
+#!/bin/sh
+# SingleGPU/Diffusion2d/run.sh: K=1, 10x10 domain, 1001^2, 10000 iters
+python -m multigpu_advectiondiffusion_tpu.cli diffusion2d \
+    --K 1.0 --lengths 10 10 --n 1001 1001 --iters 10000 \
+    --impl pallas --save out/singlegpu_diffusion2d "$@"

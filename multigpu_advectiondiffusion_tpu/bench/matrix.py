@@ -141,6 +141,12 @@ def run_case(
 def main(argv=None):
     import argparse
 
+    from multigpu_advectiondiffusion_tpu.utils.platform_env import (
+        honor_platform_env,
+    )
+
+    honor_platform_env()
+
     ap = argparse.ArgumentParser(prog="multigpu_advectiondiffusion_tpu.bench")
     ap.add_argument("--name", default=None,
                     help="run one case (default: all)")

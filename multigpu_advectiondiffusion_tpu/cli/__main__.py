@@ -251,6 +251,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None):
+    from multigpu_advectiondiffusion_tpu.utils.platform_env import (
+        honor_platform_env,
+    )
+
+    honor_platform_env()
     args = build_parser().parse_args(argv)
     if args.dtype == "float64":
         import jax

@@ -1,0 +1,20 @@
+"""Environment-variable platform selection for entry points.
+
+Site customizations may pin ``jax_platforms`` via ``jax.config`` at
+interpreter startup, which silently outranks the ``JAX_PLATFORMS`` env
+var; every CLI/benchmark entry point calls :func:`honor_platform_env`
+first so users who export ``JAX_PLATFORMS=cpu`` (e.g. to run the
+examples on a virtual device mesh) get what they asked for.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
