@@ -36,6 +36,7 @@ from multigpu_advectiondiffusion_tpu.ops.stencils import Padder
 from multigpu_advectiondiffusion_tpu.parallel.halo import (
     axis_offsets,
     make_ghost_fn,
+    make_ghost_refresh,
     make_padder,
 )
 from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition, shard_map
@@ -213,10 +214,44 @@ class SolverBase:
         ``MultiGPU/Diffusion3d_Baseline/main.c:189``)."""
         fused = self._fused_stepper()
         if fused is not None:
-            f = self._compiled(
-                ("fused_run", num_iters),
-                lambda: jax.jit(lambda u, t: fused.run(u, t, num_iters)),
-            )
+            if self.mesh is None:
+                f = self._compiled(
+                    ("fused_run", num_iters),
+                    lambda: jax.jit(lambda u, t: fused.run(u, t, num_iters)),
+                )
+            else:
+                # The tuned fused kernel shard-local inside shard_map:
+                # ghosts ppermute-refreshed after every RK stage, global
+                # wall masks fed this shard's offsets (the reference runs
+                # its tuned kernel under MPI the same way, main.c:189-303).
+                sizes = dict(self.mesh.shape)
+                refresh = (
+                    make_ghost_refresh(
+                        self.decomp, sizes, self.bcs, fused.halo,
+                        fused.interior_shape,
+                    )
+                    if fused.sharded
+                    else None
+                )
+
+                def block(u, t):
+                    offs = None
+                    if fused.sharded:
+                        offs = jnp.stack(
+                            [
+                                jnp.asarray(o, jnp.int32)
+                                for o in axis_offsets(
+                                    self.decomp, fused.interior_shape
+                                )
+                            ]
+                        )
+                    return fused.run(
+                        u, t, num_iters, refresh=refresh, offsets=offs
+                    )
+
+                f = self._compiled(
+                    ("fused_run", num_iters), lambda: self._wrap(block)
+                )
             u, t = f(state.u, state.t)
             return SolverState(u=u, t=t, it=state.it + num_iters)
 

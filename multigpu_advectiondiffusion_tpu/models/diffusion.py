@@ -190,20 +190,38 @@ class DiffusionSolver(SolverBase):
         return LocalPhysics(rhs=rhs, static_dt=self.dt, post=post)
 
     # ------------------------------------------------------------------ #
-    # Fully-fused Pallas fast path (single chip, reference-parity walls)
+    # Fully-fused Pallas fast path (single-chip or shard-local under a
+    # mesh; reference-parity walls)
     # ------------------------------------------------------------------ #
     def _fused_stepper(self):
         """The fused SSP-RK3 stepper when this config is eligible, else
         ``None`` (generic path). Eligibility mirrors the assumptions the
         kernel bakes in: frozen Dirichlet ghosts/boundary band, static dt,
-        3-D cartesian O4, one chip, f32."""
+        3-D cartesian O4, f32. Under a mesh the 3-D per-stage kernel runs
+        shard-local (ghosts ppermute-refreshed between stages — the tuned
+        kernel under MPI, ``MultiGPU/Diffusion3d_Baseline/main.c:189-303``);
+        the whole-step and whole-run variants stay single-chip (their
+        temporal blocking crosses the points where ghosts must refresh)."""
         cfg = self.cfg
         bcs = self.bcs
         from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
 
+        lshape = (
+            self.grid.shape
+            if self.mesh is None
+            else self.decomp.local_shape(self.mesh, self.grid.shape)
+        )
+        from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R
+
+        mesh_ok = self.mesh is None or (
+            self.grid.ndim == 3
+            and cfg.impl != "pallas_step"
+            # every sharded axis must serve the stencil halo from its core
+            and all(lshape[ax] >= R for ax, _ in self.decomp.axes)
+        )
         eligible = (
             is_pallas_impl(cfg.impl)
-            and self.mesh is None
+            and mesh_ok
             and cfg.geometry == "cartesian"
             and cfg.order == 4
             and cfg.integrator == "ssp_rk3"
@@ -234,14 +252,18 @@ class DiffusionSolver(SolverBase):
 
                 if not cls.supported(self.grid.shape, self.dtype):
                     return None
+            kwargs = {}
+            if self.mesh is not None:
+                kwargs["global_shape"] = self.grid.shape
             self._cache["fused"] = cls(
-                self.grid.shape,
+                lshape,
                 self.dtype,
                 self.grid.spacing,
                 [cfg.diffusivity] * self.grid.ndim,
                 self.dt,
                 cfg.boundary_band,
                 bcs[0].value,
+                **kwargs,
             )
         return self._cache["fused"]
 

@@ -27,8 +27,11 @@ traffic (read stage input + read step input + write output, ~12 B/cell):
   reads its ``u`` rows strictly before writing them, and other blocks'
   reads are row-disjoint from its writes.
 
-Single-chip only: the sharded world keeps the generic ``shard_map`` path
-(its halo exchange must rewrite ghosts every stage anyway).
+Sharded mode (``global_shape`` != ``interior_shape``): the same stage
+kernels run shard-local inside ``shard_map`` — wall masks take this
+shard's global offsets from an SMEM operand, and a per-stage ghost
+refresh (``parallel.halo.make_ghost_refresh``) rewrites the sharded-axis
+ghost slabs by ``ppermute`` between stages.
 """
 
 from __future__ import annotations
@@ -84,7 +87,8 @@ def _stage_kernel(
     *,
     bz: int,
     n_blocks: int,
-    interior_shape: Sequence[int],
+    global_shape: Sequence[int],
+    offs_ref=None,
     scales: Sequence[float],
     a: float,
     b: float,
@@ -102,7 +106,7 @@ def _stage_kernel(
     never alias the prefetched reads (the in-place final stage reads its
     ``u`` rows strictly before the overwriting DMA of the same block).
     """
-    nz, ny, nx = interior_shape
+    nz, ny, nx = global_shape
     k = pl.program_id(0)
     slot = lax.rem(k, jnp.asarray(2, k.dtype))
     nslot = lax.rem(k + 1, jnp.asarray(2, k.dtype))
@@ -164,11 +168,19 @@ def _stage_kernel(
     )
 
     # Global interior-cell indices of this block (ghost offset already
-    # removed for z: the written rows are exactly the core rows).
+    # removed for z: the written rows are exactly the core rows). When
+    # sharded, ``offs_ref`` holds this shard's global offsets so the
+    # band/face tests keep using *global* coordinates (reference-parity
+    # walls are global, Laplace3d.m:21 / heat3d.m:65-67).
     shp = vc.shape
-    gz = lax.broadcasted_iota(jnp.int32, shp, 0) + k * bz
-    gy = lax.broadcasted_iota(jnp.int32, shp, 1) - R
-    gx = lax.broadcasted_iota(jnp.int32, shp, 2) - R
+    oz, oy, ox = (
+        (offs_ref[0], offs_ref[1], offs_ref[2])
+        if offs_ref is not None
+        else (0, 0, 0)
+    )
+    gz = lax.broadcasted_iota(jnp.int32, shp, 0) + k * bz + oz
+    gy = lax.broadcasted_iota(jnp.int32, shp, 1) - R + oy
+    gx = lax.broadcasted_iota(jnp.int32, shp, 2) - R + ox
 
     def between(g, n):
         return (g >= band) & (g < n - band)
@@ -199,7 +211,7 @@ def _stage_kernel(
 
 
 def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
-                band, bc_value, u_source):
+                band, bc_value, u_source, global_shape=None, sharded=False):
     """Build one fused RK-stage call; output aliased onto the last operand.
 
     ``u_source``: where the step-input ``u`` (the ``a*u`` term) is read
@@ -207,6 +219,11 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     buffer), or ``"target"`` (the aliased output buffer itself, for the
     in-place final stage — avoids passing one buffer as two operands,
     which would force XLA to insert a defensive copy).
+
+    ``sharded``: prepend an int32 ``(3,)`` SMEM operand carrying this
+    shard's global offsets (the stage then runs shard-local inside
+    ``shard_map``; ``global_shape`` is the global interior for the
+    band/face tests).
     """
     nz = interior_shape[0]
     trailing = padded_shape[1:]
@@ -217,7 +234,7 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
         _stage_kernel,
         bz=bz,
         n_blocks=n_blocks,
-        interior_shape=tuple(interior_shape),
+        global_shape=tuple(global_shape or interior_shape),
         scales=tuple(scales),
         a=a,
         b=b,
@@ -227,6 +244,9 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     )
 
     def kernel(*refs):
+        offs_ref = None
+        if sharded:
+            offs_ref, *refs = refs
         if u_source == "operand":
             v_hbm, u_hbm, _tgt, out_hbm, vs, us, res, sem_v, sem_u, sem_w = refs
         elif u_source == "target":
@@ -235,9 +255,10 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
         else:
             v_hbm, _tgt, out_hbm, vs, res, sem_v, sem_w = refs
             u_hbm, us, sem_u = None, None, None
-        kern(v_hbm, u_hbm, out_hbm, vs, us, res, sem_v, sem_u, sem_w)
+        kern(v_hbm, u_hbm, out_hbm, vs, us, res, sem_v, sem_u, sem_w,
+             offs_ref=offs_ref)
 
-    n_in = 3 if u_source == "operand" else 2
+    n_in = (3 if u_source == "operand" else 2) + (1 if sharded else 0)
     scratch = [pltpu.VMEM((2, bz + 2 * R) + trailing, dtype)]
     if use_u:
         scratch.append(pltpu.VMEM((2, bz) + trailing, dtype))
@@ -247,10 +268,14 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
         scratch.append(pltpu.SemaphoreType.DMA((2,)))
     scratch.append(pltpu.SemaphoreType.DMA((2,)))
 
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * n_in
+    if sharded:
+        in_specs[0] = pl.BlockSpec(memory_space=pltpu.SMEM)
+
     return pl.pallas_call(
         kernel,
         grid=(n_blocks,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_in,
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
         scratch_shapes=scratch,
@@ -261,12 +286,27 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
 
 
 class FusedDiffusionStepper:
-    """Jit-cached fused runner for one (grid, dtype, dt) configuration."""
+    """Jit-cached fused runner for one (grid, dtype, dt) configuration.
+
+    ``global_shape`` (when it differs from ``interior_shape``) switches
+    the stages to shard-local mode: ``interior_shape`` is this shard's
+    block, mask tests use global coordinates from a runtime offsets
+    operand, and :meth:`run` accepts a per-stage ghost-``refresh``
+    callback (``parallel.halo.make_ghost_refresh``). This is the tuned
+    kernel running under the mesh — the reference's MultiGPU tier runs
+    the same ``LaplaceO4_async`` kernel its single-GPU ladder tuned
+    (``MultiGPU/Diffusion3d_Baseline/main.c:189-303``,
+    ``Kernels.cu:207-261``).
+    """
+
+    halo = R
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
-                 band, bc_value, block_z=None):
+                 band, bc_value, block_z=None, global_shape=None):
         nz, ny, nx = interior_shape
         self.interior_shape = tuple(interior_shape)
+        self.global_shape = tuple(global_shape or interior_shape)
+        self.sharded = self.global_shape != self.interior_shape
         self.padded_shape = (
             nz + 2 * R,
             round_up(ny + 2 * R, SUBLANE),
@@ -303,16 +343,19 @@ class FusedDiffusionStepper:
                 self.padded_shape, self.interior_shape, self.dtype,
                 bz=bz, scales=scales, a=a, b=b, dt=float(dt),
                 band=band, bc_value=float(bc_value), u_source=src,
+                global_shape=self.global_shape, sharded=self.sharded,
             )
             for (a, b), src in zip(_STAGES, sources)
         )
         self.dt = float(dt)
 
-        def step(S, T1, T2):
-            T1 = s1(S, T1)        # u1 = u + dt L(u)
-            T2 = s2(T1, S, T2)    # u2 = 3/4 u + 1/4 (u1 + dt L(u1))
-            S = s3(T2, S)         # u  = 1/3 u + 2/3 (u2 + dt L(u2)), in place
-            return S, T1, T2
+        def step(S, T1, T2, offsets=None, refresh=None):
+            pre = () if offsets is None else (offsets,)
+            fix = refresh if refresh is not None else (lambda P: P)
+            T1 = fix(s1(*pre, S, T1))      # u1 = u + dt L(u)
+            T2 = fix(s2(*pre, T1, S, T2))  # u2 = 3/4 u + 1/4 (u1 + dt L(u1))
+            S = fix(s3(*pre, T2, S))       # u  = 1/3 u + 2/3 (u2 + dt L(u2)),
+            return S, T1, T2               # in place
 
         self._step = step
 
@@ -324,15 +367,25 @@ class FusedDiffusionStepper:
         nz, ny, nx = self.interior_shape
         return lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
 
-    def run(self, u, t, num_iters: int):
-        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``."""
+    def run(self, u, t, num_iters: int, refresh=None, offsets=None):
+        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``.
+
+        Sharded mode (must run inside ``shard_map``): ``refresh`` rewrites
+        the padded buffers' sharded-axis ghosts after every stage and
+        ``offsets`` is this shard's int32 ``(3,)`` global-offset vector.
+        """
+        if self.sharded and (refresh is None or offsets is None):
+            raise ValueError("sharded fused stepper needs refresh+offsets")
         S = self.embed(u)
+        if refresh is not None:
+            S = refresh(S)
         T1 = S
         T2 = S
 
         def body(i, carry):
             S, T1, T2, t = carry
-            S, T1, T2 = self._step(S, T1, T2)
+            S, T1, T2 = self._step(S, T1, T2, offsets=offsets,
+                                   refresh=refresh)
             return S, T1, T2, t + self.dt
 
         S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, T1, T2, t))

@@ -220,6 +220,53 @@ def test_sharded_pallas_impl_matches_xla(devices):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize(
+    "mesh_axes,decomp_map",
+    [
+        ({"dz": 4}, {0: "dz"}),  # reference-style z slabs
+        ({"dz": 2, "dy": 2}, {0: "dz", 1: "dy"}),  # pencils
+        ({"dz": 2, "dy": 2, "dx": 2}, {0: "dz", 1: "dy", 2: "dx"}),  # blocks
+    ],
+)
+def test_fused_diffusion_sharded_bit_identical_to_unsharded_fused(
+    devices, mesh_axes, decomp_map
+):
+    """The fused per-stage Pallas stepper running shard-local inside
+    shard_map (ppermute ghost refresh between stages, global wall masks
+    via the offsets operand) must reproduce the single-device fused run
+    bit-for-bit — same per-cell arithmetic over the same values; the
+    ghost refresh may not change an ulp."""
+    grid = Grid.make(24, 16, 16, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    ref_solver = DiffusionSolver(cfg)
+    assert ref_solver._fused_stepper() is not None
+    ref = ref_solver.run(ref_solver.initial_state(), 8)
+
+    mesh = make_mesh(mesh_axes)
+    solver = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.of(decomp_map))
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded
+    out = solver.run(solver.initial_state(), 8)
+    assert _max_abs_diff(ref.u, out.u) == 0.0
+
+
+def test_fused_diffusion_sharded_minimal_shards(devices):
+    """2-cell shards: every shard is the minimum that can serve the O4
+    halo, and the edge shards lie entirely inside the frozen boundary
+    band — the offsets operand must keep those global-index decisions
+    right."""
+    grid = Grid.make(16, 16, 16, lengths=4.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    ref_solver = DiffusionSolver(cfg)
+    ref = ref_solver.run(ref_solver.initial_state(), 4)
+    mesh = make_mesh({"dz": 8})
+    solver = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.slab("dz"))
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded
+    out = solver.run(solver.initial_state(), 4)
+    assert _max_abs_diff(ref.u, out.u) == 0.0
+
+
 def test_cli_style_pallas_step_on_burgers_falls_back():
     """A global --impl pallas_step applied to Burgers must run the
     per-axis pallas kernels, not crash in the WENO dispatcher."""
