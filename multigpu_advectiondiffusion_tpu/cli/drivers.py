@@ -58,6 +58,32 @@ def decomposition_for(grid, mesh_sizes) -> Optional[Decomposition]:
     return Decomposition.of(mapping)
 
 
+def physics_meta(solver: SolverBase) -> dict:
+    """JSON-safe snapshot of the config fields that define the physics a
+    checkpoint will continue under (diffusivity/nu/bc/weno/cfl/...).
+    Excludes the grid (validated separately), the IC (irrelevant once a
+    state exists), and kernel-strategy knobs that cannot change results."""
+    import dataclasses
+
+    skip = {"grid", "ic", "ic_params", "impl", "overlap"}
+    out = {}
+    for f in dataclasses.fields(solver.cfg):
+        if f.name in skip:
+            continue
+        v = getattr(solver.cfg, f.name)
+        if isinstance(v, tuple):
+            v = list(v)
+        try:
+            json.dumps(v)
+        except TypeError:
+            # non-serializable fields (e.g. a source-term callable) have
+            # no stable representation across processes — recording repr()
+            # would spuriously reject legitimate resumes
+            continue
+        out[f.name] = v
+    return out
+
+
 def run_solver(
     solver: SolverBase,
     name: str,
@@ -111,6 +137,25 @@ def run_solver(
                     f"checkpoint domain bounds {got} != configured "
                     f"bounds {want}"
                 )
+        # matching grid + bounds but different physics (e.g. another --K
+        # or WENO variant) would silently continue the wrong equation
+        # under the same artifact numbering
+        recorded = (meta or {}).get("physics")
+        if recorded is not None:
+            current = physics_meta(solver)
+            diffs = {
+                k: (recorded[k], current[k])
+                for k in recorded
+                if k in current and recorded[k] != current[k]
+            }
+            if diffs:
+                detail = ", ".join(
+                    f"{k}: checkpoint={a!r} configured={b!r}"
+                    for k, (a, b) in sorted(diffs.items())
+                )
+                raise ValueError(
+                    f"checkpoint physics parameters differ: {detail}"
+                )
     else:
         state = solver.initial_state()
     start_it = int(state.it)
@@ -133,6 +178,7 @@ def run_solver(
         raise ValueError("snapshot/checkpoint output needs save_dir")
 
     best = float("inf")
+    io_s = None
     # the trace context closes on every exit path, including exceptions
     # raised inside the timed solve (a leaked jax.profiler trace poisons
     # every later start_trace in the process)
@@ -144,6 +190,7 @@ def run_solver(
     with profiled:
         if periodic:
             chunk = min(x for x in (snapshot_every, checkpoint_every) if x)
+            io_s = 0.0  # shadows the outer None: periodic runs report it
             with io_utils.AsyncBinaryWriter() as writer:
                 t0 = time.perf_counter()
                 out, done = state, 0
@@ -155,6 +202,14 @@ def run_solver(
                     # run continues the numbering instead of overwriting
                     # earlier artifacts in the same directory
                     glob_it = start_it + done
+                    # host I/O is timed separately and excluded from the
+                    # solve rate — the reference times only kernel work
+                    # (main.c:184-307; output happens after the loop).
+                    # Drain the async-dispatched chunk FIRST: otherwise
+                    # the device compute blocks inside np.asarray in the
+                    # writers and books as I/O, inflating the solve rate.
+                    sync(out.u)
+                    io_t0 = time.perf_counter()
                     if snapshot_every and done % snapshot_every == 0:
                         writer.submit(
                             out.u,
@@ -167,10 +222,12 @@ def run_solver(
                             ),
                             out,
                             grid=solver.grid,
+                            physics=physics_meta(solver),
                         )
                         io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
+                    io_s += time.perf_counter() - io_t0
                 sync(out.u)
-                best = time.perf_counter() - t0
+                best = time.perf_counter() - t0 - io_s
         else:
             for _ in range(max(1, repeats)):
                 t0 = time.perf_counter()
@@ -198,6 +255,7 @@ def run_solver(
         t_final=float(out.t),
         devices=1 if solver.mesh is None else solver.mesh.devices.size,
         dtype=str(solver.cfg.dtype),
+        io_seconds=io_s,
     )
 
     if check_error and hasattr(solver, "error_norms"):

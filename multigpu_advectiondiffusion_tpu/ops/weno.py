@@ -214,9 +214,14 @@ def flux_divergence(
         m = up.shape[axis] - 2 * r
         return (shifted(h, axis, 1, m) - shifted(h, axis, 0, m)) / dx
 
-    ghosts = ghost_fn(u, axis, r) if ghost_fn is not None else None
-    if ghosts is not None and impl != "pallas":
-        return split_axis_apply(div_from_padded, u, axis, r, *ghosts)
+    # Only build ghosts when the split schedule will consume them — a
+    # pallas impl pads via padder() below, and issuing the ppermute pair
+    # here would rely on XLA DCE to avoid doubled halo traffic (mirrors
+    # the ordering in ops/laplacian.py).
+    if ghost_fn is not None and impl != "pallas":
+        ghosts = ghost_fn(u, axis, r)
+        if ghosts is not None:
+            return split_axis_apply(div_from_padded, u, axis, r, *ghosts)
 
     up = padder(u, axis, r) if padder is not None else pad_axis(u, axis, r, bc)
 

@@ -25,7 +25,10 @@ from jax import lax
 
 from multigpu_advectiondiffusion_tpu.core.bc import Boundary, boundary_halo, pad_axis
 from multigpu_advectiondiffusion_tpu.ops.stencils import Padder, slice_axis
-from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition
+from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+    Decomposition,
+    axis_extent,
+)
 
 
 def exchange_ghosts(
@@ -102,9 +105,11 @@ def make_padder(
 
     def padder(u: jnp.ndarray, axis: int, halo: int) -> jnp.ndarray:
         name = decomp.mesh_axis(axis)
-        if name is None or mesh_axis_sizes[name] == 1:
+        if name is None or axis_extent(mesh_axis_sizes, name) == 1:
             return pad_axis(u, axis, halo, bcs[axis])
-        return exchange_axis(u, axis, halo, name, mesh_axis_sizes[name], bcs[axis])
+        return exchange_axis(
+            u, axis, halo, name, axis_extent(mesh_axis_sizes, name), bcs[axis]
+        )
 
     return padder
 
@@ -120,10 +125,10 @@ def make_ghost_fn(
 
     def ghost_fn(u: jnp.ndarray, axis: int, halo: int):
         name = decomp.mesh_axis(axis)
-        if name is None or mesh_axis_sizes[name] == 1:
+        if name is None or axis_extent(mesh_axis_sizes, name) == 1:
             return None
         return exchange_ghosts(
-            u, axis, halo, name, mesh_axis_sizes[name], bcs[axis]
+            u, axis, halo, name, axis_extent(mesh_axis_sizes, name), bcs[axis]
         )
 
     return ghost_fn
@@ -156,7 +161,7 @@ def make_ghost_refresh(
         (ax, decomp.mesh_axis(ax))
         for ax in range(len(interior_local))
         if decomp.mesh_axis(ax) is not None
-        and mesh_axis_sizes[decomp.mesh_axis(ax)] > 1
+        and axis_extent(mesh_axis_sizes, decomp.mesh_axis(ax)) > 1
     ]
 
     def refresh(P: jnp.ndarray) -> jnp.ndarray:
@@ -164,7 +169,8 @@ def make_ghost_refresh(
             n_loc = interior_local[ax]
             core = slice_axis(P, ax, halo, halo + n_loc)
             lo, hi = exchange_ghosts(
-                core, ax, halo, name, mesh_axis_sizes[name], bcs[ax]
+                core, ax, halo, name, axis_extent(mesh_axis_sizes, name),
+                bcs[ax],
             )
             P = lax.dynamic_update_slice_in_dim(P, lo, 0, axis=ax)
             P = lax.dynamic_update_slice_in_dim(P, hi, halo + n_loc, axis=ax)

@@ -57,6 +57,14 @@ def make_mesh(
     return jax.make_mesh(sizes, names, devices=tuple(devices[:need]))
 
 
+def axis_extent(sizes, name) -> int:
+    """Shard count of a mesh-axis spec: a single axis name, or a tuple of
+    names (compound axis) whose extents multiply."""
+    if isinstance(name, tuple):
+        return math.prod(sizes[n] for n in name)
+    return sizes[name]
+
+
 @dataclasses.dataclass(frozen=True)
 class Decomposition:
     """Maps array axes of the grid to mesh axes.
@@ -66,13 +74,26 @@ class Decomposition:
     axis... i.e. z in 3-D, matching ``_Nz = Nz/np`` (``main.c:69``) — note
     the reference splits the *z* axis, which in this framework's
     ``(z, y, x)`` array order is axis 0.
+
+    A mesh-axis entry may also be a *tuple* of mesh axis names — a
+    compound axis splitting one grid axis over several mesh axes,
+    outermost first. This is the multi-host layout: z over
+    ``('dz_dcn', 'dz_ici')`` puts shard blocks on hosts (DCN hops between
+    blocks) with consecutive shards inside each host riding ICI
+    (:mod:`parallel.multihost`). ``ppermute``/``axis_index`` address the
+    compound axis by its flattened row-major index, so the halo-exchange
+    program is unchanged.
     """
 
-    axes: Tuple[Tuple[int, str], ...]
+    axes: Tuple[Tuple[int, object], ...]
 
     @staticmethod
-    def of(mapping: Dict[int, str]) -> "Decomposition":
-        return Decomposition(tuple(sorted(mapping.items())))
+    def of(mapping: Dict[int, object]) -> "Decomposition":
+        norm = {
+            ax: tuple(n) if isinstance(n, (list, tuple)) else n
+            for ax, n in mapping.items()
+        }
+        return Decomposition(tuple(sorted(norm.items())))
 
     @staticmethod
     def slab(mesh_axis: str = "dz") -> "Decomposition":
@@ -83,11 +104,15 @@ class Decomposition:
     def mapping(self) -> Dict[int, str]:
         return dict(self.axes)
 
-    def mesh_axis(self, array_axis: int) -> Optional[str]:
+    def mesh_axis(self, array_axis: int):
         return self.mapping.get(array_axis)
 
     def mesh_axis_names(self) -> Tuple[str, ...]:
-        return tuple(name for _, name in self.axes)
+        """All individual mesh axis names in use (compound axes flattened)."""
+        out = []
+        for _, name in self.axes:
+            out.extend(name if isinstance(name, tuple) else (name,))
+        return tuple(out)
 
     def partition_spec(self, ndim: int) -> PartitionSpec:
         return PartitionSpec(*[self.mapping.get(ax) for ax in range(ndim)])
@@ -100,9 +125,10 @@ class Decomposition:
         analog, ``Util.cu:43-61``) — every sharded axis must divide evenly
         and leave at least one stencil-halo worth of cells per shard."""
         for ax, name in self.axes:
-            if name not in mesh.shape:
-                raise ValueError(f"mesh has no axis {name!r}")
-            parts = mesh.shape[name]
+            for n in name if isinstance(name, tuple) else (name,):
+                if n not in mesh.shape:
+                    raise ValueError(f"mesh has no axis {n!r}")
+            parts = axis_extent(mesh.shape, name)
             if global_shape[ax] % parts:
                 raise ValueError(
                     f"axis {ax} size {global_shape[ax]} not divisible by "
@@ -112,5 +138,5 @@ class Decomposition:
     def local_shape(self, mesh: Mesh, global_shape: Sequence[int]) -> Tuple[int, ...]:
         out = list(global_shape)
         for ax, name in self.axes:
-            out[ax] //= mesh.shape[name]
+            out[ax] //= axis_extent(mesh.shape, name)
         return tuple(out)

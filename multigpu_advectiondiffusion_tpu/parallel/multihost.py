@@ -63,34 +63,35 @@ def hybrid_mesh(
     for s in dcn_sizes:
         dcn_total *= s
 
-    try:
+    devs = list(jax.devices())
+    has_slice_topology = all(
+        getattr(d, "slice_index", None) is not None for d in devs
+    )
+    if has_slice_topology:
         # Topology-aware placement: orders devices along the ICI torus so
-        # ppermute halo neighbors are physically adjacent.
+        # ppermute halo neighbors are physically adjacent. Real
+        # misconfigurations (axis sizes vs device count etc.) raise from
+        # here and stay loud.
         devices = mesh_utils.create_hybrid_device_mesh(
-            ici_sizes, dcn_sizes, devices=jax.devices()
+            ici_sizes, dcn_sizes, devices=devs
         )
-    except ValueError as err:
-        if "attribute" not in str(err):
-            # A real misconfiguration (axis sizes vs device count etc.)
-            # must stay loud — only the missing-slice-topology case has a
-            # fallback.
-            raise
-        if dcn_total == 1:
-            # Platforms whose devices carry no slice topology (e.g. the
-            # virtual-CPU test mesh): with no cross-slice axis a plain
-            # row-major mesh over ALL devices is a valid, if unoptimized,
-            # hybrid mesh.
-            devs = jax.devices()
-            if total != len(devs):
-                raise
-            devices = np.asarray(devs).reshape(dcn_sizes + ici_sizes)
-            return Mesh(devices, names)
-        # Devices without a slice_index attribute but a real DCN extent:
-        # group by process instead (raises a clear ValueError if the
-        # process count cannot satisfy dcn_sizes).
+    elif dcn_total == 1:
+        # Platforms whose devices carry no slice topology (e.g. the
+        # virtual-CPU test mesh): with no cross-slice axis a plain
+        # row-major mesh over ALL devices is a valid, if unoptimized,
+        # hybrid mesh.
+        if total != len(devs):
+            raise ValueError(
+                f"hybrid_mesh axes need {total} devices, have {len(devs)}"
+            )
+        devices = np.asarray(devs).reshape(dcn_sizes + ici_sizes)
+        return Mesh(devices, names)
+    else:
+        # Devices without slice topology but a real DCN extent: group by
+        # process instead (raises a clear ValueError if the process count
+        # cannot satisfy dcn_sizes).
         devices = mesh_utils.create_hybrid_device_mesh(
-            ici_sizes, dcn_sizes, devices=jax.devices(),
-            process_is_granule=True,
+            ici_sizes, dcn_sizes, devices=devs, process_is_granule=True,
         )
     # create_hybrid_device_mesh returns shape dcn_sizes + ici_sizes
     return Mesh(np.asarray(devices), names)

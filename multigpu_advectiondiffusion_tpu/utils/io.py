@@ -348,27 +348,32 @@ def _load_ckpt_native(lib, path: str) -> SolverState:
     )
 
 
-def save_checkpoint(path: str, state: SolverState, grid: Optional[Grid] = None):
+def save_checkpoint(
+    path: str,
+    state: SolverState,
+    grid: Optional[Grid] = None,
+    physics: Optional[dict] = None,
+):
     """Restartable state. ``.npz`` paths keep the legacy numpy container;
     anything else uses the framework ``.ckpt`` format (atomic write +
     CRC-verified payload, native-accelerated when ``native/`` is built).
-    Grid metadata rides in a ``<path>.json`` sidecar for ``.ckpt`` (the
-    array shape itself is already in the binary header)."""
+    Grid metadata — plus the run's key ``physics`` parameters, so a resume
+    can refuse a silently-different configuration — rides in a
+    ``<path>.json`` sidecar for ``.ckpt`` (the array shape itself is
+    already in the binary header)."""
+    meta = {}
+    if grid is not None:
+        meta = {"shape": list(grid.shape), "bounds": [list(b) for b in grid.bounds]}
+    if physics is not None:
+        meta["physics"] = physics
     if not path.endswith(".npz"):
         _save_ckpt(path, state)
-        if grid is not None:
-            meta = {
-                "shape": list(grid.shape),
-                "bounds": [list(b) for b in grid.bounds],
-            }
+        if meta:
             tmp = path + ".json.tmp"
             with open(tmp, "w") as f:
                 json.dump(meta, f)
             os.replace(tmp, path + ".json")
         return
-    meta = {}
-    if grid is not None:
-        meta = {"shape": list(grid.shape), "bounds": [list(b) for b in grid.bounds]}
     np.savez(
         path,
         u=np.asarray(state.u),
@@ -417,15 +422,19 @@ def rotate_checkpoints(directory: str, keep: int, prefix: str = "checkpoint_"):
     with ``--checkpoint-every``."""
     if keep <= 0:
         return
-    def _iteration(name: str) -> int:
+    def _iteration(name: str):
         stem = name[len(prefix):].rsplit(".", 1)[0]
-        return int(stem) if stem.isdigit() else -1
+        return int(stem) if stem.isdigit() else None
 
     names = sorted(
         (
             name
             for name in os.listdir(directory)
-            if name.startswith(prefix) and name.endswith((".ckpt", ".npz"))
+            if name.startswith(prefix)
+            and name.endswith((".ckpt", ".npz"))
+            # only rotation-managed files (purely numeric iteration stem);
+            # a user file like checkpoint_best.ckpt must never be deleted
+            and _iteration(name) is not None
         ),
         key=lambda n: (_iteration(n), n),  # numeric order survives a
         # digit-count rollover past the %06d padding

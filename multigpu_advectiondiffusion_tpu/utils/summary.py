@@ -37,6 +37,9 @@ class RunSummary:
     error_l2: Optional[float] = None
     error_linf: Optional[float] = None
     compile_seconds: Optional[float] = None
+    # host I/O (snapshots/checkpoints) excluded from `seconds`; periodic-
+    # output runs would otherwise fold disk time into the solve rate
+    io_seconds: Optional[float] = None
 
     @property
     def num_cells(self) -> int:
@@ -78,6 +81,8 @@ class RunSummary:
         if self.compile_seconds is not None:
             print(f" compile time       : {self.compile_seconds:.3f} s")
         print(f" wall time          : {self.seconds:.4f} s")
+        if self.io_seconds is not None:
+            print(f" I/O time (excl.)   : {self.io_seconds:.4f} s")
         print(f" MLUPS              : {self.mlups:.1f}")
         print(f" GFLOPS (ref conv.) : {self.gflops:.3f}")
         if self.error_l1 is not None:

@@ -150,13 +150,23 @@ def test_rotate_checkpoints(tmp_path):
         )
     # non-checkpoint files with the prefix must never be touched
     (tmp_path / "checkpoint_notes.txt").write_text("keep me")
+    # a user .ckpt whose stem is not an iteration number (e.g. a manual
+    # "best" save) is not rotation-managed and must survive
+    tio.save_checkpoint(
+        str(tmp_path / "checkpoint_best.ckpt"),
+        SolverState(u=u, t=jnp.asarray(0.0), it=jnp.asarray(0)),
+    )
     tio.rotate_checkpoints(str(tmp_path), keep=2)
     left = sorted(f for f in os.listdir(tmp_path) if f.endswith(".ckpt"))
-    assert left == ["checkpoint_000003.ckpt", "checkpoint_000004.ckpt"]
+    assert left == [
+        "checkpoint_000003.ckpt",
+        "checkpoint_000004.ckpt",
+        "checkpoint_best.ckpt",
+    ]
     assert (tmp_path / "checkpoint_notes.txt").exists()
     # keep=0 means keep everything
     tio.rotate_checkpoints(str(tmp_path), keep=0)
-    assert len(sorted(f for f in os.listdir(tmp_path) if f.endswith(".ckpt"))) == 2
+    assert len(sorted(f for f in os.listdir(tmp_path) if f.endswith(".ckpt"))) == 3
 
 
 def test_print_field_layout():

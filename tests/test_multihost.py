@@ -1,0 +1,154 @@
+"""Multi-host (DCN) layer tests on the virtual 8-device CPU backend.
+
+The reference's multi-node story is MPI process management
+(``InitializeMPI``, ``MultiGPU/Diffusion3d_Baseline/Tools.c:228-242``;
+``MPIDeviceCheck``/``AssignDevices``, ``Util.cu:43-74``) and is untestable
+without a cluster. The TPU-native layer (``parallel/multihost.py``) is
+validated here without one: hybrid-mesh construction (DCN-outermost axis
+ordering, clear failures on impossible topologies) in-process, and the
+``jax.distributed`` runtime bring-up as a ``num_processes=1`` smoke in a
+subprocess (so this process's backend stays pristine).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+from multigpu_advectiondiffusion_tpu.parallel import multihost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_hybrid_mesh_no_dcn_axis_uses_all_devices(devices):
+    """dcn extent 1: plain mesh over all devices, ici axes innermost."""
+    mesh = multihost.hybrid_mesh({"dz_ici": 8}, {})
+    assert mesh.axis_names == ("dz_ici",)
+    assert mesh.devices.shape == (8,)
+    assert list(mesh.devices.ravel()) == list(jax.devices())
+
+
+def test_hybrid_mesh_dcn_axis_is_outermost(devices):
+    """Axis order is DCN axes then ICI axes — the outermost decomposition
+    axis rides the slow links, matching the module's design contract."""
+    mesh = multihost.hybrid_mesh({"a": 2, "b": 4}, {"d": 1})
+    assert mesh.axis_names == ("d", "a", "b")
+    assert mesh.devices.shape == (1, 2, 4)
+
+
+def test_hybrid_mesh_runs_sharded_solve(devices):
+    """A hybrid mesh is a plain Mesh: the standard sharded solver runs on
+    it with z decomposed over the DCN-outermost compound axis, exactly as
+    the module docstring prescribes for multi-host runs."""
+    import numpy as np
+
+    from multigpu_advectiondiffusion_tpu import (
+        DiffusionConfig,
+        DiffusionSolver,
+        Grid,
+    )
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition
+
+    mesh = multihost.hybrid_mesh({"dz_ici": 8}, {"dz_dcn": 1})
+    # 3 cells per shard: bit-identity vs unsharded holds empirically for
+    # shards >= 3 cells; degenerate 2-cell shards (= stencil halo) let XLA
+    # reassociate the stencil sum differently (~1e-6 drift, still correct)
+    grid = Grid.make(12, 12, 24, lengths=2.0)
+    # decompose z over both mesh axes: dcn hop outermost, ici inside
+    sharded = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32"),
+        mesh=mesh,
+        decomp=Decomposition.of({0: ("dz_dcn", "dz_ici")}),
+    )
+    plain = DiffusionSolver(DiffusionConfig(grid=grid, dtype="float32"))
+    a = sharded.run(sharded.initial_state(), 3)
+    b = plain.run(plain.initial_state(), 3)
+    np.testing.assert_array_equal(np.asarray(a.u), np.asarray(b.u))
+
+
+def test_compound_axis_all_eight_devices_bit_identical(devices):
+    """z split over a (2, 4) compound axis — 8 shards across two mesh
+    axes — reproduces the unsharded solve bit-for-bit. This is the full
+    multi-host layout (2 'hosts' x 4 'chips') on the virtual backend."""
+    import numpy as np
+
+    from multigpu_advectiondiffusion_tpu import (
+        BurgersConfig,
+        BurgersSolver,
+        DiffusionConfig,
+        DiffusionSolver,
+        Grid,
+    )
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    mesh = make_mesh({"dz_dcn": 2, "dz_ici": 4})
+    decomp = Decomposition.of({0: ("dz_dcn", "dz_ici")})
+    grid = Grid.make(8, 8, 24, lengths=2.0)
+
+    for cfg_cls, solver_cls, kw in (
+        (DiffusionConfig, DiffusionSolver, {}),
+        (BurgersConfig, BurgersSolver, {"nu": 1e-5}),
+    ):
+        sharded = solver_cls(
+            cfg_cls(grid=grid, dtype="float32", **kw),
+            mesh=mesh,
+            decomp=decomp,
+        )
+        plain = solver_cls(cfg_cls(grid=grid, dtype="float32", **kw))
+        a = sharded.run(sharded.initial_state(), 3)
+        b = plain.run(plain.initial_state(), 3)
+        np.testing.assert_array_equal(np.asarray(a.u), np.asarray(b.u))
+
+
+def test_hybrid_mesh_device_count_mismatch_is_loud(devices):
+    with pytest.raises(ValueError, match="devices"):
+        multihost.hybrid_mesh({"dz_ici": 4}, {})
+
+
+def test_hybrid_mesh_impossible_dcn_extent_is_loud(devices):
+    """CPU devices carry no slice topology; a DCN extent > process count
+    cannot be satisfied and must raise, not silently mis-place."""
+    with pytest.raises(ValueError):
+        multihost.hybrid_mesh({"dz_ici": 4}, {"dz_dcn": 2})
+
+
+def test_process_local_devices_and_coordinator(devices):
+    assert list(multihost.process_local_devices()) == list(jax.local_devices())
+    assert multihost.is_coordinator()  # single-process: process_index 0
+
+
+def test_initialize_single_process_smoke():
+    """``initialize()`` brings up jax.distributed with one process — the
+    InitializeMPI analog — in a subprocess so this process's runtime is
+    untouched."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    code = (
+        "import os;"
+        "os.environ['JAX_PLATFORMS']='cpu';"
+        f"import sys; sys.path.insert(0, {REPO!r});"
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "from multigpu_advectiondiffusion_tpu.parallel import multihost;"
+        f"multihost.initialize(coordinator_address='localhost:{port}',"
+        " num_processes=1, process_id=0);"
+        "assert jax.process_count() == 1, jax.process_count();"
+        "assert multihost.is_coordinator();"
+        "print('initialize-ok')"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 0, res.stderr
+    assert "initialize-ok" in res.stdout
