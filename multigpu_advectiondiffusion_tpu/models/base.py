@@ -113,6 +113,21 @@ class SolverBase:
             return None
         return self.decomp.sharding(self.mesh, self.grid.ndim)
 
+    def mesh_reduce_max(self):
+        """Cross-device max reduction for this solver's mesh (identity
+        when unsharded / all extents 1). Must run inside ``shard_map``.
+        The single source of the pmax axis-name set — the generic step
+        and the fused steppers' adaptive dt must agree exactly."""
+        if self.mesh is None:
+            return None
+        sizes = dict(self.mesh.shape)
+        names = tuple(
+            n for n in self.decomp.mesh_axis_names() if sizes.get(n, 1) > 1
+        )
+        if not names:
+            return None
+        return lambda x: lax.pmax(x, names)
+
     # ------------------------------------------------------------------ #
     # State creation
     # ------------------------------------------------------------------ #
@@ -144,16 +159,14 @@ class SolverBase:
                 reduce_max=lambda x: x,
             )
         sizes = dict(self.mesh.shape)
-        names = tuple(
-            n for n in self.decomp.mesh_axis_names() if sizes.get(n, 1) > 1
-        )
+        reduce = self.mesh_reduce_max()
         lshape = self.decomp.local_shape(self.mesh, gshape)
         return StepContext(
             padder=make_padder(self.decomp, sizes, self.bcs),
             offsets=axis_offsets(self.decomp, lshape),
             local_shape=lshape,
             global_shape=gshape,
-            reduce_max=(lambda x: lax.pmax(x, names)) if names else (lambda x: x),
+            reduce_max=reduce if reduce is not None else (lambda x: x),
             ghost_fn=make_ghost_fn(self.decomp, sizes, self.bcs),
         )
 
@@ -229,6 +242,7 @@ class SolverBase:
                     make_ghost_refresh(
                         self.decomp, sizes, self.bcs, fused.halo,
                         fused.interior_shape,
+                        core_offsets=getattr(fused, "core_offsets", None),
                     )
                     if fused.sharded
                     else None

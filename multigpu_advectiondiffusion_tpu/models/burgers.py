@@ -126,10 +126,13 @@ class BurgersSolver(SolverBase):
     def _fused_stepper(self):
         """The fused SSP-RK3 stepper when this config is eligible, else
         ``None``. Eligibility mirrors the kernels' assumptions: 2-D/3-D
-        cartesian WENO5, edge ghosts, fixed dt (adaptive dt needs a
-        global reduction before stage 1), one chip, f32. 3-D dispatches
-        the slab-pipelined per-stage kernel; 2-D the whole-run
-        VMEM-resident stepper."""
+        cartesian WENO5, edge ghosts, f32. The 3-D per-stage kernel
+        serves every dt mode and world: adaptive dt rides a runtime SMEM
+        scalar (global ``max|f'(u)|`` reduction between steps), and under
+        a mesh the kernel runs shard-local with ppermute ghost refresh
+        between stages (the tuned kernel under MPI,
+        ``MultiGPU/Burgers3d_Baseline/main.c:189-317``). The 2-D
+        whole-run VMEM stepper stays single-chip, fixed-dt."""
         import jax.numpy as jnp
 
         from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
@@ -137,36 +140,60 @@ class BurgersSolver(SolverBase):
         cfg = self.cfg
         eligible = (
             is_pallas_impl(cfg.impl)
-            and self.mesh is None
             and self.grid.ndim in (2, 3)
             and cfg.weno_order == 5
             and cfg.weno_variant in ("js", "z")
             and cfg.integrator == "ssp_rk3"
-            and not cfg.adaptive_dt
             and (cfg.nu == 0.0 or cfg.laplacian_order == 4)
             and self.dtype == jnp.float32
             and all(b.kind == "edge" for b in self.bcs)
         )
+        if self.grid.ndim != 3 and (self.mesh is not None or cfg.adaptive_dt):
+            eligible = False
         if not eligible:
             return None
+        lshape = (
+            self.grid.shape
+            if self.mesh is None
+            else self.decomp.local_shape(self.mesh, self.grid.shape)
+        )
         if self.grid.ndim == 3:
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (  # noqa: E501
+                R,
                 FusedBurgersStepper as cls,
             )
+
+            # every sharded axis must serve the stencil halo from its core
+            if self.mesh is not None and any(
+                lshape[ax] < R for ax, _ in self.decomp.axes
+            ):
+                return None
         else:
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (  # noqa: E501
                 FusedBurgers2DStepper as cls,
             )
-        if not cls.supported(self.grid.shape, self.dtype):
+        if not cls.supported(lshape, self.dtype):
             return None
         if "fused" not in self._cache:
-            self._cache["fused"] = cls(
-                self.grid.shape,
-                self.dtype,
-                self.grid.spacing,
-                self.flux,
-                cfg.weno_variant,
-                cfg.nu,
-                cfg.cfl * min(self.grid.spacing),
-            )
+            spacing = self.grid.spacing
+            kwargs = {}
+            if self.grid.ndim == 3:
+                if self.mesh is not None:
+                    kwargs["global_shape"] = self.grid.shape
+                if cfg.adaptive_dt:
+                    reduce = self.mesh_reduce_max()
+                    kwargs["dt_fn"] = lambda u: advective_dt(
+                        u, self.flux.df, spacing, cfg.cfl, reduce_max=reduce
+                    )
+                else:
+                    kwargs["dt"] = cfg.cfl * min(spacing)
+                self._cache["fused"] = cls(
+                    lshape, self.dtype, spacing, self.flux,
+                    cfg.weno_variant, cfg.nu, **kwargs,
+                )
+            else:
+                self._cache["fused"] = cls(
+                    lshape, self.dtype, spacing, self.flux,
+                    cfg.weno_variant, cfg.nu, cfg.cfl * min(spacing),
+                )
         return self._cache["fused"]

@@ -5,39 +5,55 @@ kernels (``Compute_dF/dG/dH``), an optional Laplacian, and an RK-update
 kernel, each streaming the full state through device memory
 (``SingleGPU/Burgers3d_WENO5/main.cpp:143-149``,
 ``MultiGPU/Burgers3d_Baseline/main.c:201-301``). The generic JAX path here
-mirrors that structure (pad → per-axis WENO divergence → sum → axpy), and
-measures ~1 TFLOP/s effective on v5e — far under the VPU roof — because
-XLA materializes the split fluxes and interface fluxes between fusions.
+mirrors that structure (pad → per-axis WENO divergence → sum → axpy) and
+is far below the VPU roof because XLA materializes the split fluxes and
+interface fluxes between fusions.
 
-This module collapses each RK stage to ONE Pallas kernel: a z-slab of the
-state is DMA'd into VMEM once and all three WENO5 flux divergences, the
-viscous Laplacian (when ``nu > 0``), and the RK stage combination are
-evaluated in-register before the slab's core rows are written back.
+This module collapses each RK stage to ONE Pallas kernel over a 2-D
+``(z, y)`` block grid: a ``(bz+6, by+16, X)`` box of the state is DMA'd
+into VMEM and all three WENO5 flux divergences, the viscous Laplacian
+(when ``nu > 0``), and the RK stage combination are evaluated in VMEM
+before the block's core cells are written back. The kernel is VPU-bound,
+so the design minimizes *arithmetic*, not just traffic:
 
-Layout and ghost discipline (mirrors ``fused_diffusion``):
+* z- and y-direction sweeps are value *slices* of the VMEM box (both
+  carry their halo in the box), so only the x sweep pays for circular
+  shifts (``pltpu.roll`` on the lane axis).
+* WENO reconstruction uses the forward-difference form
+  (``ops.weno._weno5_minus_e``): shared first-difference arrays replace
+  5-point stencil combinations, and the nonlinear weights use the
+  single-division formulation (``_weno5_alphas_unnormalized``).
+* Small z-blocks made the old 1-D-grid kernel recompute the z-direction
+  interface fluxes ~2x and the split fluxes ~7x; the (bz, by) blocking
+  brings both overheads to ~1.1-2x.
 
-* The state lives in a *padded, tile-aligned* layout
-  ``(nz+6, round8(ny+6), round128(nx+6))`` for the whole run. All
-  non-interior cells hold edge-replicated values (the reference's
-  non-periodic ghost rule, ``WENO5resAdv_X.m:53``).
-* Each stage kernel re-synthesizes the ghost cells of its output rows
-  from the freshly computed interior (x/y via broadcast selects, the z
-  ghost rows via two small extra DMAs on the first/last grid block), so
-  the padded invariant holds at every stage boundary — equivalent to the
-  generic path's re-padding of ``u`` every stage.
-* y/x stencil reads use full-width circular shifts (``pltpu.roll``);
-  wrapped lanes land only in ghost/slack outputs, which the edge
-  synthesis overwrites. z reads are in-slab row slices (the slab carries
-  a 3-row halo).
-* Buffer choreography per step (three live padded buffers, zero allocs):
-  ``T1 = stage1(S)``, ``T2 = stage2(T1, S)``, ``S' = stage3(T2, S) → S``
-  with the final stage writing in place over ``S`` (each grid block reads
-  its ``u`` rows strictly before writing them; the TPU grid is a
-  sequential loop, so no other block races the ghost-row writes).
+Layout and ghost discipline:
 
-Single-chip, fixed-dt only: the sharded world and the adaptive-dt mode
-(which needs a global ``max|f'(u)|`` reduction before stage 1) keep the
-generic ``shard_map``/XLA path.
+* The state lives in a *padded, tile-aligned* layout for the whole run:
+  ``(nz+6, 8+ny+8, round128(nx+6))`` — z carries exactly the 3-row halo
+  (the leading axis is untiled, any slice is legal), y carries an
+  8-column margin on each side (ghosts in its inner 3 columns) because
+  Mosaic requires sublane-axis DMA offsets to be 8-aligned, and x is
+  lane-padded. All non-interior cells hold edge-replicated values (the
+  reference's non-periodic ghost rule, ``WENO5resAdv_X.m:53``).
+* Block (kz, ky) reads box ``[kz*bz, kz*bz+bz+6) x [ky*by, ky*by+by+16)``
+  (both starts/extents 8-aligned in y) and writes only its disjoint core
+  box; edge blocks additionally write the adjacent ghost boxes with
+  edge-replicated values. Disjoint writes keep the 2-slot DMA pipeline
+  race-free. The (z-ghost x y-margin) corner boxes are never rewritten
+  after the initial embed; no core output ever reads them.
+* dt enters as a runtime SMEM scalar, so the same compiled stages serve
+  fixed *and* adaptive dt — the adaptive mode computes the global
+  ``max|f'(u)|`` reduction (``lax.pmax`` across a mesh) between steps,
+  restoring the physically-correct CFL the reference hard-coded away
+  (``MultiGPU/Burgers3d_Baseline/main.c:193``).
+* Sharded mode (``global_shape`` != ``interior_shape``): the stages run
+  shard-local inside ``shard_map`` with an SMEM global-offset operand
+  (edge synthesis keyed on *global* coordinates), and the caller
+  refreshes sharded-axis ghosts between stages
+  (``parallel.halo.make_ghost_refresh`` with this stepper's
+  ``core_offsets``) — the tuned kernel under the mesh, as the reference
+  runs its tuned kernels under MPI (``main.c:189-303``).
 """
 
 from __future__ import annotations
@@ -65,37 +81,42 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     round_up,
 )
 from multigpu_advectiondiffusion_tpu.ops.weno import (
-    _weno5_minus,
-    _weno5_plus,
+    _weno5_minus_e,
+    _weno5_plus_e,
 )
 
 R = 3  # WENO5 stencil radius == persistent ghost width
+MARGIN = 8  # y-side margin: >= R, multiple of the (8) sublane tile
 
-# Conservative VMEM budget for the per-block working set. The physical
-# VMEM is 128 MiB; the Mosaic scoped ceiling we request is 100 MiB
-# (laplacian.VMEM_LIMIT); leave headroom for double-buffered DMAs.
-_VMEM_BUDGET = 80 * 1024 * 1024
-
-# Live row-sized buffers per block, by slab height h = bz + 2R and face
-# height f = bz + 1: slab + vp + vm (3h) + one axis' WENO working set
-# (~13f: 5+5 shifted operands, betas, weights, interface flux) + rhs
-# accumulator, RK result, u rows (~4 bz). Mosaic's true liveness grows
-# faster with bz than this model (a bz=8 variant at 256^3 exceeded the
-# 128 MiB physical VMEM while the model said 77 MiB), and measured
-# throughput is flat from bz=1 to bz=2 — the kernel is VPU-bound, so the
-# z-halo re-read that a larger bz would amortize is already hidden.
-# Hence the hard bz <= 2 cap.
-_MAX_BZ = 2
+# Conservative VMEM budget for the per-block working set (physical VMEM
+# is 128 MiB; the Mosaic scoped ceiling requested is 100 MiB).
+_VMEM_BUDGET = 72 * 1024 * 1024
 
 
-def _live_bytes(bz: int, row_bytes: int) -> int:
-    return (3 * (bz + 2 * R) + 13 * (bz + 1) + 4 * bz) * row_bytes
+def _live_bytes(bz: int, by: int, x_pad: int, itemsize: int) -> int:
+    col = x_pad * itemsize
+    slab = (bz + 2 * R) * (by + 2 * MARGIN) * col  # one (z,y) box
+    core = bz * by * col
+    # v double-buffered (2) + vp + vm (2 slabs) + u/res double-buffered
+    # (4 cores) + ~14 live core-sized sweep intermediates
+    return 4 * slab + 18 * core
 
 
-def _pick_bz(nz: int, row_bytes: int) -> int | None:
-    for bz in range(min(_MAX_BZ, nz), 0, -1):
-        if nz % bz == 0 and _live_bytes(bz, row_bytes) <= _VMEM_BUDGET:
-            return bz
+def _pick_blocks(nz, ny, x_pad, itemsize):
+    """First viable block in measured-preference order.
+
+    v5e, 512^3: (8,64) 6045 MLUPS > (4,64) 5903 > (8,128) 5580 >
+    (16,64) 5292 — beyond (8,64) the larger working set costs more in
+    Mosaic scheduling than the halo amortization returns.
+    """
+    for by in (64, 128, 32, 16, 8):
+        if ny % by:
+            continue
+        for bz in (8, 4, 2, 1):
+            if nz % bz:
+                continue
+            if _live_bytes(bz, by, x_pad, itemsize) <= _VMEM_BUDGET:
+                return (bz, by)
     return None
 
 
@@ -107,188 +128,360 @@ def _split(flux: Flux, v):
     return 0.5 * (fu + a * v), 0.5 * (fu - a * v)
 
 
-def _div_roll(vp, vm, axis, inv_dx, variant):
-    """Flux divergence along a y/x axis of core rows via circular shifts.
+def _div_z(vp, vm, bz, by, inv_dx, variant):
+    """Flux divergence along z of the core box via slab row slices.
 
-    ``hface[i]`` (interface right of cell i) = WENO5⁻(vp[i-2..i+2]) +
-    WENO5⁺(vm[i-1..i+3]); divergence = (hface[i] - hface[i-1]) / dx.
-    Wrapped lanes touch only ghost/slack outputs (masked by the caller's
-    edge synthesis).
+    Interface row ``s`` (0..bz) sits right of slab row ``R-1+s``; the
+    minus window is vp rows ``s..s+4`` (center ``s+2``), the plus window
+    vm rows ``s+1..s+5`` (center ``s+3``).
     """
-    qp = [_shift(vp, off, axis) for off in range(-2, 3)]
-    qm = [_shift(vm, off, axis) for off in range(-1, 4)]
-    h = _weno5_minus(*qp, variant) + _weno5_plus(*qm, variant)
-    return (h - _shift(h, -1, axis)) * inv_dx
-
-
-def _div_z(vp, vm, bz, inv_dx, variant):
-    """Flux divergence along z of the ``bz`` core rows via slab slices.
-
-    Face row ``s`` of the ``bz+1`` interface rows sits right of slab row
-    ``R-1+s``; its minus stencil reads vp rows ``s..s+4``, its plus
-    stencil vm rows ``s+1..s+5`` — exactly the 2R+bz rows of the slab.
-    """
-    qp = [vp[j : j + bz + 1] for j in range(5)]
-    qm = [vm[j + 1 : j + 2 + bz] for j in range(5)]
-    h = _weno5_minus(*qp, variant) + _weno5_plus(*qm, variant)
+    yc = slice(MARGIN, MARGIN + by)
+    p = vp[:, yc]
+    m = vm[:, yc]
+    ep = p[1:] - p[:-1]
+    em = m[1:] - m[:-1]
+    h = _weno5_minus_e(
+        p[2 : 3 + bz], *(ep[j : j + bz + 1] for j in range(4)), variant
+    ) + _weno5_plus_e(
+        m[3 : 4 + bz], *(em[j + 1 : j + 2 + bz] for j in range(4)), variant
+    )
     return (h[1:] - h[:-1]) * inv_dx
 
 
-def _laplacian(v, vc, bz, scales):
-    """O4 Laplacian of the core rows (radius 2 < R, fits the same halo)."""
+def _div_y(vp, vm, bz, by, inv_dx, variant):
+    """Flux divergence along y of the core box via sublane slices.
+
+    Interface ``i`` (0..by) sits right of core column ``i-1`` (slab
+    column ``MARGIN+i-1``); minus window columns ``MARGIN+i-3 ..
+    MARGIN+i+1`` (center ``MARGIN+i-1``), plus window shifted by one.
+    """
+    p = vp[R : R + bz]
+    m = vm[R : R + bz]
+    ep = p[:, 1:] - p[:, :-1]
+    em = m[:, 1:] - m[:, :-1]
+    n = by + 1
+    h = _weno5_minus_e(
+        p[:, MARGIN - 1 : MARGIN + by],
+        *(ep[:, MARGIN - 3 + j : MARGIN - 3 + j + n] for j in range(4)),
+        variant,
+    ) + _weno5_plus_e(
+        m[:, MARGIN : MARGIN + by + 1],
+        *(em[:, MARGIN - 2 + j : MARGIN - 2 + j + n] for j in range(4)),
+        variant,
+    )
+    return (h[:, 1:] - h[:, :-1]) * inv_dx
+
+
+def _div_roll(vp, vm, axis, inv_dx, variant):
+    """Flux divergence along ``axis`` via circular shifts (e-form);
+    wrapped positions land only in ghost/slack outputs, which the edge
+    synthesis overwrites. Used for the lane (x) axis here and for both
+    axes of the 2-D whole-run stepper (:mod:`fused_burgers2d`)."""
+    ep = _shift(vp, 1, axis) - vp
+    em = _shift(vm, 1, axis) - vm
+    h = _weno5_minus_e(
+        vp, *(_shift(ep, j - 2, axis) for j in range(4)), variant
+    ) + _weno5_plus_e(
+        _shift(vm, 1, axis),
+        *(_shift(em, j - 1, axis) for j in range(4)),
+        variant,
+    )
+    return (h - _shift(h, -1, axis)) * inv_dx
+
+
+def _div_x(vp, vm, inv_dx, variant):
+    """Flux divergence along x (lanes) of the core box."""
+    return _div_roll(vp, vm, 2, inv_dx, variant)
+
+
+def _laplacian(v, vc, bz, by, scales):
+    """O4 Laplacian of the core box (radius 2 < R, fits the same halo)."""
+    yc = slice(MARGIN, MARGIN + by)
     acc = None
     for axis in range(3):
         for j, c in enumerate(O4_COEFFS):
             coef = jnp.asarray(c * scales[axis], v.dtype)
-            term = (
-                v[j + 1 : j + 1 + bz] if axis == 0
-                else _shift(vc, j - 2, axis)
-            ) * coef
+            if axis == 0:
+                term = v[j + 1 : j + 1 + bz, yc] * coef
+            elif axis == 1:
+                term = v[R : R + bz, MARGIN - 2 + j : MARGIN - 2 + j + by] * coef
+            else:
+                term = _shift(vc, j - 2, 2) * coef
             acc = term if acc is None else acc + term
     return acc
 
 
-def _edge_fill(rk, ny, nx):
-    """Overwrite every non-interior y/x cell with the edge-replicated
-    interior value (``WENO5resAdv_X.m:53``); corners/slack included."""
-    gy = lax.broadcasted_iota(jnp.int32, rk.shape, 1) - R
-    gx = lax.broadcasted_iota(jnp.int32, rk.shape, 2) - R
-    t = jnp.where(gx < 0, rk[:, :, R : R + 1], rk)
-    t = jnp.where(gx >= nx, t[:, :, R + nx - 1 : R + nx], t)
-    t = jnp.where(gy < 0, t[:, R : R + 1, :], t)
-    return jnp.where(gy >= ny, t[:, R + ny - 1 : R + ny, :], t)
-
-
 def _stage_kernel(
+    dt_ref,
     v_hbm,
     u_hbm,
     out_hbm,
     vs,
     us,
     res,
-    gres,
+    gyres,
+    gzres,
     sem_v,
     sem_u,
     sem_w,
     sem_g,
     *,
     bz: int,
-    n_blocks: int,
-    interior_shape: Sequence[int],
+    by: int,
+    n_bz: int,
+    n_by: int,
+    local_shape: Sequence[int],
     inv_dx: Sequence[float],
     nu_scales: Sequence[float] | None,
     flux: Flux,
     variant: str,
     a: float,
     b: float,
-    dt: float,
 ):
-    nz, ny, nx = interior_shape
-    k = pl.program_id(0)
+    """One (z, y) block of one RK stage, 2-slot double-buffered.
 
-    cp_v = pltpu.make_async_copy(v_hbm.at[pl.ds(k * bz, bz + 2 * R)], vs, sem_v)
-    cp_v.start()
-    if us is not None:
+    The TPU grid is a sequential loop, so block ``k`` prefetches block
+    ``k+1``'s box while it computes, and defers the wait on its core
+    write until the slot is reused at ``k+2``. All core write boxes are
+    disjoint (and disjoint from the edge-ghost boxes), so in-flight
+    writes never alias prefetched reads; the in-place final stage reads
+    its ``u`` box strictly before the overwriting DMA of the same block.
+    """
+    lz, ly, lx = local_shape
+    kz = pl.program_id(0)
+    ky = pl.program_id(1)
+    k = kz * n_by + ky
+    slot = lax.rem(k, jnp.asarray(2, k.dtype))
+    nslot = lax.rem(k + 1, jnp.asarray(2, k.dtype))
+
+    def boxes(j):
+        nb = jnp.asarray(n_by, jnp.int32)
+        j = jnp.asarray(j, jnp.int32)
+        return lax.div(j, nb) * bz, lax.rem(j, nb) * by
+
+    def copy_v(j, s):
+        z0, y0 = boxes(j)
+        return pltpu.make_async_copy(
+            v_hbm.at[
+                pl.ds(z0, bz + 2 * R),
+                pl.ds(pl.multiple_of(y0, SUBLANE), by + 2 * MARGIN),
+            ],
+            vs.at[s],
+            sem_v.at[s],
+        )
+
+    def copy_u(j, s):
+        z0, y0 = boxes(j)
         src = u_hbm if u_hbm is not None else out_hbm
-        cp_u = pltpu.make_async_copy(src.at[pl.ds(R + k * bz, bz)], us, sem_u)
-        cp_u.start()
-        cp_u.wait()
-    cp_v.wait()
+        return pltpu.make_async_copy(
+            src.at[
+                pl.ds(R + z0, bz),
+                pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
+            ],
+            us.at[s],
+            sem_u.at[s],
+        )
 
-    v = vs[:]
-    vc = v[R : R + bz]
-    dtype = v.dtype
+    def copy_w(j, s):
+        z0, y0 = boxes(j)
+        return pltpu.make_async_copy(
+            res.at[s],
+            out_hbm.at[
+                pl.ds(R + z0, bz),
+                pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
+            ],
+            sem_w.at[s],
+        )
 
-    # Split fluxes over the whole slab (z needs the halo rows); the y/x
-    # sweeps use only the core-row slice of the same arrays.
-    vp, vm = _split(flux, v)
-    rhs = -(
-        _div_z(vp, vm, bz, inv_dx[0], variant)
-        + _div_roll(vp[R : R + bz], vm[R : R + bz], 1, inv_dx[1], variant)
-        + _div_roll(vp[R : R + bz], vm[R : R + bz], 2, inv_dx[2], variant)
-    )
-    if nu_scales is not None:
-        rhs = rhs + _laplacian(v, vc, bz, nu_scales)
-
-    rk = b * (vc + dt * rhs) if a == 0.0 else a * us[:] + b * (vc + dt * rhs)
-    res[:] = _edge_fill(rk.astype(dtype), ny, nx)
-
-    cp_w = pltpu.make_async_copy(res, out_hbm.at[pl.ds(R + k * bz, bz)], sem_w)
-    cp_w.start()
-    cp_w.wait()
-
-    # z ghost rows: replicate the new boundary interior row (edge BC).
     @pl.when(k == 0)
     def _():
-        gres[:] = jnp.broadcast_to(res[0:1], gres.shape)
-        cp = pltpu.make_async_copy(gres, out_hbm.at[pl.ds(0, R)], sem_g)
-        cp.start()
-        cp.wait()
+        copy_v(0, 0).start()
+        if us is not None:
+            copy_u(0, 0).start()
 
-    @pl.when(k == n_blocks - 1)
+    @pl.when(k + 1 < n_bz * n_by)
     def _():
-        gres[:] = jnp.broadcast_to(res[bz - 1 : bz], gres.shape)
-        cp = pltpu.make_async_copy(gres, out_hbm.at[pl.ds(R + nz, R)], sem_g)
+        copy_v(k + 1, nslot).start()
+        if us is not None:
+            copy_u(k + 1, nslot).start()
+
+    if us is not None:
+        copy_u(k, slot).wait()
+    copy_v(k, slot).wait()
+
+    v = vs[slot]
+    vc = v[R : R + bz, MARGIN : MARGIN + by]
+    dtype = v.dtype
+    dt = dt_ref[0].astype(dtype)
+
+    # Split fluxes once over the whole box; each sweep slices what it
+    # needs (z: rows, y: columns, x: lane shifts of the core).
+    vp, vm = _split(flux, v)
+    rhs = -(
+        _div_z(vp, vm, bz, by, inv_dx[0], variant)
+        + _div_y(vp, vm, bz, by, inv_dx[1], variant)
+        + _div_x(
+            vp[R : R + bz, MARGIN : MARGIN + by],
+            vm[R : R + bz, MARGIN : MARGIN + by],
+            inv_dx[2],
+            variant,
+        )
+    )
+    if nu_scales is not None:
+        rhs = rhs + _laplacian(v, vc, bz, by, nu_scales)
+
+    rk = b * (vc + dt * rhs) if a == 0.0 else a * us[slot] + b * (vc + dt * rhs)
+    rk = rk.astype(dtype)
+
+    # x edge synthesis on every block (all blocks span the full lane
+    # width): replicate the local edge interior column into ghost and
+    # slack lanes (WENO5resAdv_X.m:53). At global edges the local edge
+    # IS the global edge; at internal shard edges the between-stage
+    # ghost refresh overwrites these lanes, so the fill value there is
+    # irrelevant — local replication is correct in every world.
+    gx = lax.broadcasted_iota(jnp.int32, rk.shape, 2) - R
+    rk = jnp.where(gx < 0, rk[:, :, R : R + 1], rk)
+    rk = jnp.where(gx >= lx, rk[:, :, R + lx - 1 : R + lx], rk)
+
+    @pl.when(k >= 2)
+    def _():
+        copy_w(k - 2, slot).wait()
+
+    res[slot] = rk
+    copy_w(k, slot).start()
+
+    z0, y0 = boxes(k)
+
+    # y ghost+margin boxes: written by the shard-edge y-blocks with the
+    # edge-replicated core column (meaningful only at *global* edges —
+    # elsewhere the refresh overwrites the inner R ghost columns).
+    @pl.when(ky == 0)
+    def _():
+        gyres[:] = jnp.broadcast_to(res[slot][:, 0:1], gyres.shape)
+        cp = pltpu.make_async_copy(
+            gyres, out_hbm.at[pl.ds(R + z0, bz), pl.ds(0, MARGIN)], sem_g
+        )
         cp.start()
         cp.wait()
 
+    @pl.when(ky == n_by - 1)
+    def _():
+        gyres[:] = jnp.broadcast_to(res[slot][:, by - 1 : by], gyres.shape)
+        cp = pltpu.make_async_copy(
+            gyres,
+            out_hbm.at[
+                pl.ds(R + z0, bz),
+                pl.ds(pl.multiple_of(MARGIN + ly, SUBLANE), MARGIN),
+            ],
+            sem_g,
+        )
+        cp.start()
+        cp.wait()
 
-def _make_stage(padded_shape, interior_shape, dtype, *, bz, inv_dx, nu_scales,
-                flux, variant, a, b, dt, u_source):
+    # z ghost rows: replicate the new boundary interior row (edge BC).
+    @pl.when(kz == 0)
+    def _():
+        gzres[:] = jnp.broadcast_to(res[slot][0:1], gzres.shape)
+        cp = pltpu.make_async_copy(
+            gzres,
+            out_hbm.at[
+                pl.ds(0, R),
+                pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
+            ],
+            sem_g,
+        )
+        cp.start()
+        cp.wait()
+
+    @pl.when(kz == n_bz - 1)
+    def _():
+        gzres[:] = jnp.broadcast_to(res[slot][bz - 1 : bz], gzres.shape)
+        cp = pltpu.make_async_copy(
+            gzres,
+            out_hbm.at[
+                pl.ds(R + lz, R),
+                pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
+            ],
+            sem_g,
+        )
+        cp.start()
+        cp.wait()
+
+    @pl.when(k == n_bz * n_by - 1)
+    def _():
+        copy_w(k, slot).wait()
+        if n_bz * n_by >= 2:
+            copy_w(k - 1, nslot).wait()
+
+
+def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
+                nu_scales, flux, variant, a, b, u_source):
     """One fused RK-stage call; output aliased onto the last operand.
 
-    ``u_source`` as in ``fused_diffusion._make_stage``: ``"none"`` /
-    ``"operand"`` / ``"target"`` (in-place final stage).
+    ``u_source``: ``"none"`` / ``"operand"`` / ``"target"`` (in-place
+    final stage), as in ``fused_diffusion._make_stage``. Operands:
+    ``dt (SMEM (1,))`` + arrays. The same stage serves sharded mode
+    unchanged — edge synthesis is local replication, and the caller's
+    between-stage refresh fixes non-global shard edges.
     """
-    nz = interior_shape[0]
-    trailing = padded_shape[1:]
+    lz = local_shape[0]
+    ly = local_shape[1]
+    trailing = padded_shape[2:]
     use_u = u_source != "none"
-    n_blocks = nz // bz
+    n_bz, n_by = lz // bz, ly // by
 
     kern = functools.partial(
         _stage_kernel,
         bz=bz,
-        n_blocks=n_blocks,
-        interior_shape=tuple(interior_shape),
+        by=by,
+        n_bz=n_bz,
+        n_by=n_by,
+        local_shape=tuple(local_shape),
         inv_dx=tuple(inv_dx),
         nu_scales=None if nu_scales is None else tuple(nu_scales),
         flux=flux,
         variant=variant,
         a=a,
         b=b,
-        dt=dt,
     )
 
     def kernel(*refs):
+        dt_ref, *refs = refs
         if u_source == "operand":
-            (v_hbm, u_hbm, _tgt, out_hbm, vs, us, res, gres,
+            (v_hbm, u_hbm, _tgt, out_hbm, vs, us, res, gyres, gzres,
              sem_v, sem_u, sem_w, sem_g) = refs
         elif u_source == "target":
-            (v_hbm, _tgt, out_hbm, vs, us, res, gres,
+            (v_hbm, _tgt, out_hbm, vs, us, res, gyres, gzres,
              sem_v, sem_u, sem_w, sem_g) = refs
             u_hbm = None  # read from out_hbm (in place)
         else:
-            v_hbm, _tgt, out_hbm, vs, res, gres, sem_v, sem_w, sem_g = refs
+            (v_hbm, _tgt, out_hbm, vs, res, gyres, gzres,
+             sem_v, sem_w, sem_g) = refs
             u_hbm, us, sem_u = None, None, None
-        kern(v_hbm, u_hbm, out_hbm, vs, us, res, gres,
-             sem_v, sem_u, sem_w, sem_g)
+        kern(dt_ref, v_hbm, u_hbm, out_hbm, vs, us, res,
+             gyres, gzres, sem_v, sem_u, sem_w, sem_g)
 
-    n_in = 3 if u_source == "operand" else 2
-    scratch = [pltpu.VMEM((bz + 2 * R,) + trailing, dtype)]
+    n_in = (3 if u_source == "operand" else 2) + 1
+    yb = by + 2 * MARGIN
+    scratch = [pltpu.VMEM((2, bz + 2 * R, yb) + trailing, dtype)]
     if use_u:
-        scratch.append(pltpu.VMEM((bz,) + trailing, dtype))
-    scratch.append(pltpu.VMEM((bz,) + trailing, dtype))
-    scratch.append(pltpu.VMEM((R,) + trailing, dtype))
-    scratch.append(pltpu.SemaphoreType.DMA)
+        scratch.append(pltpu.VMEM((2, bz, by) + trailing, dtype))
+    scratch.append(pltpu.VMEM((2, bz, by) + trailing, dtype))
+    scratch.append(pltpu.VMEM((bz, MARGIN) + trailing, dtype))
+    scratch.append(pltpu.VMEM((R, by) + trailing, dtype))
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
     if use_u:
-        scratch.append(pltpu.SemaphoreType.DMA)
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
     scratch.append(pltpu.SemaphoreType.DMA)
-    scratch.append(pltpu.SemaphoreType.DMA)
+
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 1)
 
     return pl.pallas_call(
         kernel,
-        grid=(n_blocks,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_in,
+        grid=(n_bz, n_by),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
         scratch_shapes=scratch,
@@ -299,30 +492,41 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, inv_dx, nu_scales,
 
 
 class FusedBurgersStepper:
-    """Jit-cached fused runner for one (grid, flux, dtype, dt) config.
+    """Jit-cached fused runner for one (grid, flux, dtype) config.
 
-    Returns ``None``-equivalent via :func:`supported` when the working
-    set cannot fit VMEM even at ``bz = 1``.
+    ``dt`` fixes the step (CUDA-parity mode); ``dt_fn`` (a callable
+    ``core_interior -> scalar``) enables adaptive CFL stepping — it runs
+    between fused steps on a no-copy interior view of the padded state.
+    Exactly one must be provided. ``global_shape`` switches to
+    shard-local mode (see module docstring).
     """
 
+    halo = R
+    core_offsets = (R, MARGIN, R)  # interior origin in the padded layout
+
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
-                 variant: str, nu: float, dt: float, block_z=None):
-        nz, ny, nx = interior_shape
+                 variant: str, nu: float, dt: float | None = None,
+                 dt_fn=None, block=None, global_shape=None):
+        if (dt is None) == (dt_fn is None):
+            raise ValueError("provide exactly one of dt/dt_fn")
+        lz, ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
+        self.global_shape = tuple(global_shape or interior_shape)
+        self.sharded = self.global_shape != self.interior_shape
         self.padded_shape = (
-            nz + 2 * R,
-            round_up(ny + 2 * R, SUBLANE),
-            round_up(nx + 2 * R, LANE),
+            lz + 2 * R,
+            ly + 2 * MARGIN,
+            round_up(lx + 2 * R, LANE),
         )
         self.dtype = jnp.dtype(dtype)
-        row_bytes = (
-            self.padded_shape[1] * self.padded_shape[2] * self.dtype.itemsize
+        blk = block if block is not None else _pick_blocks(
+            lz, ly, self.padded_shape[2], self.dtype.itemsize
         )
-        bz = block_z if block_z is not None else _pick_bz(nz, row_bytes)
-        if bz is None or nz % bz != 0:
+        if blk is None or ly % 8 or lz % blk[0] or ly % blk[1] or blk[1] % 8:
             raise ValueError(
-                f"no viable z-block for nz={nz} at row size {row_bytes} B"
+                f"no viable (bz, by) block for interior {interior_shape}"
             )
+        bz, by = blk
         inv_dx = [1.0 / spacing[i] for i in range(3)]
         nu_scales = None
         if nu:
@@ -333,55 +537,76 @@ class FusedBurgersStepper:
         s1, s2, s3 = (
             _make_stage(
                 self.padded_shape, self.interior_shape, self.dtype,
-                bz=bz, inv_dx=inv_dx, nu_scales=nu_scales, flux=flux,
-                variant=variant, a=a, b=b, dt=float(dt), u_source=src,
+                bz=bz, by=by, inv_dx=inv_dx, nu_scales=nu_scales,
+                flux=flux, variant=variant, a=a, b=b, u_source=src,
             )
             for (a, b), src in zip(_STAGES, sources)
         )
-        self.dt = float(dt)
-        self.block_z = bz
+        self.dt = None if dt is None else float(dt)
+        self._dt_fn = dt_fn
+        self.block = (bz, by)
 
-        def step(S, T1, T2):
-            T1 = s1(S, T1)       # u1 = u - dt div f(u) [+ nu lap]
-            T2 = s2(T1, S, T2)   # u2 = 3/4 u + 1/4 (u1 + dt rhs(u1))
-            S = s3(T2, S)        # u  = 1/3 u + 2/3 (u2 + dt rhs(u2))
+        def step(S, T1, T2, dt_arr, refresh=None):
+            fix = refresh if refresh is not None else (lambda P: P)
+            T1 = fix(s1(dt_arr, S, T1))
+            T2 = fix(s2(dt_arr, T1, S, T2))
+            S = fix(s3(dt_arr, T2, S))
             return S, T1, T2
 
         self._step = step
 
     @staticmethod
     def supported(interior_shape, dtype) -> bool:
-        nz, ny, nx = interior_shape
-        row_bytes = (
-            round_up(ny + 2 * R, SUBLANE)
-            * round_up(nx + 2 * R, LANE)
-            * jnp.dtype(dtype).itemsize
-        )
-        return _pick_bz(nz, row_bytes) is not None
+        lz, ly, lx = interior_shape
+        if ly % 8:
+            return False
+        x_pad = round_up(lx + 2 * R, LANE)
+        return _pick_blocks(lz, ly, x_pad, jnp.dtype(dtype).itemsize) is not None
 
     def embed(self, u):
-        nz, ny, nx = self.interior_shape
+        lz, ly, lx = self.interior_shape
         pz, py, px = self.padded_shape
         return jnp.pad(
             u.astype(self.dtype),
-            ((R, pz - nz - R), (R, py - ny - R), (R, px - nx - R)),
+            ((R, pz - lz - R), (MARGIN, py - ly - MARGIN), (R, px - lx - R)),
             mode="edge",
         )
 
     def extract(self, S):
-        nz, ny, nx = self.interior_shape
-        return lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
+        lz, ly, lx = self.interior_shape
+        return lax.slice(
+            S, (R, MARGIN, R), (R + lz, MARGIN + ly, R + lx)
+        )
 
-    def run(self, u, t, num_iters: int):
-        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``."""
+    def _dt_value(self, S):
+        if self.dt is not None:
+            return jnp.asarray(self.dt, jnp.float32)
+        # no-copy interior view: XLA fuses the slice into the reduction
+        return self._dt_fn(self.extract(S)).astype(jnp.float32)
+
+    def run(self, u, t, num_iters: int, refresh=None, offsets=None):
+        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``.
+
+        Sharded mode (must run inside ``shard_map``): ``refresh`` rewrites
+        the padded buffers' sharded-axis ghosts after every stage.
+        ``offsets`` is accepted for interface parity with the diffusion
+        stepper and unused — edge synthesis here needs no global
+        coordinates (local replication + refresh cover every world).
+        """
+        del offsets
+        if self.sharded and refresh is None:
+            raise ValueError("sharded fused stepper needs a ghost refresh")
         S = self.embed(u)
+        if refresh is not None:
+            S = refresh(S)
         T1 = S
         T2 = S
 
         def body(i, carry):
             S, T1, T2, t = carry
-            S, T1, T2 = self._step(S, T1, T2)
-            return S, T1, T2, t + self.dt
+            dt = self._dt_value(S)
+            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1), refresh=refresh)
+            return S, T1, T2, t + dt.astype(t.dtype)
 
         S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, T1, T2, t))
         return self.extract(S), t

@@ -55,37 +55,120 @@ def _weno5_betas(q0, q1, q2, q3, q4):
     return b0, b1, b2
 
 
-def _weno5_weights(betas, d, variant):
+def _weno5_alphas_unnormalized(betas, d, variant):
+    """Unnormalized nonlinear weights, single-division form.
+
+    The textbook JS weights ``alpha_k = d_k/(eps+beta_k)^2`` cost one
+    division per stencil plus one for the normalization — 4 per
+    reconstruction, and divisions dominate the WENO op mix on the TPU VPU
+    (no native divide; each lowers to a Newton-iterated reciprocal).
+    Multiplying every alpha by ``prod_j (eps+beta_j)^2`` — which cancels
+    in the normalized weights exactly — gives the division-free form
+    ``alpha_k' = d_k * (prod_{j != k} (eps+beta_j))^2``; the caller then
+    spends the reconstruction's single division on the normalization.
+    Same algebra for the Z weights ``d_k (1 + tau5/(beta_k+eps))``:
+    ``alpha_k' = d_k (beta_k+eps+tau5) * prod_{j != k} (beta_j+eps)``.
+
+    Range note (f32): alphas' scale as ``beta^4`` (JS), overflowing only
+    when ``beta > ~4e9``, i.e. cell-to-cell jumps beyond ~3e4 — far
+    outside any physical use of these solvers; f64 is available for more.
+    """
+    s0, s1, s2 = (b + EPSILON for b in betas)
     if variant == "js":
-        alphas = [dk / (EPSILON + b) ** 2 for dk, b in zip(d, betas)]
-    elif variant == "z":
+        return (
+            d[0] * (s1 * s2) ** 2,
+            d[1] * (s0 * s2) ** 2,
+            d[2] * (s0 * s1) ** 2,
+        )
+    if variant == "z":
         tau5 = jnp.abs(betas[0] - betas[2])
-        alphas = [dk * (1.0 + tau5 / (b + EPSILON)) for dk, b in zip(d, betas)]
-    else:
-        raise ValueError(f"unknown WENO5 variant {variant!r}; use 'js' or 'z'")
-    inv = 1.0 / sum(alphas[1:], alphas[0])
-    return [a * inv for a in alphas]
+        return (
+            d[0] * (s0 + tau5) * (s1 * s2),
+            d[1] * (s1 + tau5) * (s0 * s2),
+            d[2] * (s2 + tau5) * (s0 * s1),
+        )
+    raise ValueError(f"unknown WENO5 variant {variant!r}; use 'js' or 'z'")
 
 
 def _weno5_minus(q0, q1, q2, q3, q4, variant):
     """Reconstruct u^- at the interface right of center cell q2."""
-    w0, w1, w2 = _weno5_weights(_weno5_betas(q0, q1, q2, q3, q4), _D5, variant)
-    return (
-        w0 * (2 * q0 - 7 * q1 + 11 * q2)
-        + w1 * (-q1 + 5 * q2 + 2 * q3)
-        + w2 * (2 * q2 + 5 * q3 - q4)
-    ) / 6.0
+    a0, a1, a2 = _weno5_alphas_unnormalized(
+        _weno5_betas(q0, q1, q2, q3, q4), _D5, variant
+    )
+    num = (
+        a0 * (2 * q0 - 7 * q1 + 11 * q2)
+        + a1 * (-q1 + 5 * q2 + 2 * q3)
+        + a2 * (2 * q2 + 5 * q3 - q4)
+    )
+    return num / (6.0 * (a0 + a1 + a2))
 
 
 def _weno5_plus(q0, q1, q2, q3, q4, variant):
     """Reconstruct u^+ at the interface left of center cell q2."""
     d = tuple(reversed(_D5))
-    w0, w1, w2 = _weno5_weights(_weno5_betas(q0, q1, q2, q3, q4), d, variant)
+    a0, a1, a2 = _weno5_alphas_unnormalized(
+        _weno5_betas(q0, q1, q2, q3, q4), d, variant
+    )
+    num = (
+        a0 * (-q0 + 5 * q1 + 2 * q2)
+        + a1 * (2 * q1 + 5 * q2 - q3)
+        + a2 * (11 * q2 - 7 * q3 + 2 * q4)
+    )
+    return num / (6.0 * (a0 + a1 + a2))
+
+
+def _weno5_betas_from_e(e0, e1, e2, e3):
+    """The three smoothness indicators expressed in forward differences
+    ``e_j = q_{j+1} - q_j`` of the 5-cell window ``q0..q4``.
+
+    Mathematically identical to :func:`_weno5_betas` — the curvature
+    terms are differences of adjacent ``e`` and the linear terms 2-term
+    ``e`` combinations — but cheaper: the ``e`` array is shared between
+    all three indicators and (in stencil sweeps) between neighboring
+    interfaces, replacing 5-point combinations with 2-point ones.
+    """
+    c = 13.0 / 12.0
+    d0, d1, d2 = e1 - e0, e2 - e1, e3 - e2
+    l0 = 3.0 * e1 - e0
+    l1 = e1 + e2  # -(q1 - q3); sign irrelevant, it is squared
+    l2 = e3 - 3.0 * e2
     return (
-        w0 * (-q0 + 5 * q1 + 2 * q2)
-        + w1 * (2 * q1 + 5 * q2 - q3)
-        + w2 * (11 * q2 - 7 * q3 + 2 * q4)
-    ) / 6.0
+        c * d0 * d0 + 0.25 * l0 * l0,
+        c * d1 * d1 + 0.25 * l1 * l1,
+        c * d2 * d2 + 0.25 * l2 * l2,
+    )
+
+
+def _weno5_minus_e(q2, e0, e1, e2, e3, variant):
+    """:func:`_weno5_minus` in forward-difference form: ``q2`` is the
+    window's center cell and ``e_j = q_{j+1} - q_j``. The candidate
+    polynomials become ``(6 q2 + <2-term e combo>)/6``."""
+    a0, a1, a2 = _weno5_alphas_unnormalized(
+        _weno5_betas_from_e(e0, e1, e2, e3), _D5, variant
+    )
+    t6 = 6.0 * q2
+    num = (
+        a0 * (t6 + 5.0 * e1 - 2.0 * e0)
+        + a1 * (t6 + e1 + 2.0 * e2)
+        + a2 * (t6 + 4.0 * e2 - e3)
+    )
+    return num / (6.0 * (a0 + a1 + a2))
+
+
+def _weno5_plus_e(q2, e0, e1, e2, e3, variant):
+    """:func:`_weno5_plus` in forward-difference form (same window
+    convention: ``q2`` the center cell, ``e_j = q_{j+1} - q_j``)."""
+    d = tuple(reversed(_D5))
+    a0, a1, a2 = _weno5_alphas_unnormalized(
+        _weno5_betas_from_e(e0, e1, e2, e3), d, variant
+    )
+    t6 = 6.0 * q2
+    num = (
+        a0 * (t6 - 4.0 * e1 + e0)
+        + a1 * (t6 - 2.0 * e1 - e2)
+        + a2 * (t6 - 5.0 * e2 + 2.0 * e3)
+    )
+    return num / (6.0 * (a0 + a1 + a2))
 
 
 def _weno7_betas(q):

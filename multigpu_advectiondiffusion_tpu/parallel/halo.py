@@ -140,6 +140,7 @@ def make_ghost_refresh(
     bcs: Sequence[Boundary],
     halo: int,
     interior_local: Sequence[int],
+    core_offsets: Sequence[int] | None = None,
 ):
     """Refresh the ghost slabs of a *persistent padded* buffer in place.
 
@@ -156,7 +157,15 @@ def make_ghost_refresh(
 
     ``interior_local`` is the shard-local interior shape; axes whose mesh
     extent is 1 (or unsharded) keep their frozen BC ghosts untouched.
+    ``core_offsets`` gives the interior origin in the padded layout per
+    axis (default ``halo`` on every axis — steppers with alignment
+    margins, e.g. the fused Burgers y axis, sit deeper).
     """
+    offs = (
+        tuple(core_offsets)
+        if core_offsets is not None
+        else (halo,) * len(interior_local)
+    )
     sharded = [
         (ax, decomp.mesh_axis(ax))
         for ax in range(len(interior_local))
@@ -167,13 +176,14 @@ def make_ghost_refresh(
     def refresh(P: jnp.ndarray) -> jnp.ndarray:
         for ax, name in sharded:
             n_loc = interior_local[ax]
-            core = slice_axis(P, ax, halo, halo + n_loc)
+            off = offs[ax]
+            core = slice_axis(P, ax, off, off + n_loc)
             lo, hi = exchange_ghosts(
                 core, ax, halo, name, axis_extent(mesh_axis_sizes, name),
                 bcs[ax],
             )
-            P = lax.dynamic_update_slice_in_dim(P, lo, 0, axis=ax)
-            P = lax.dynamic_update_slice_in_dim(P, hi, halo + n_loc, axis=ax)
+            P = lax.dynamic_update_slice_in_dim(P, lo, off - halo, axis=ax)
+            P = lax.dynamic_update_slice_in_dim(P, hi, off + n_loc, axis=ax)
         return P
 
     return refresh

@@ -104,7 +104,13 @@ def test_compound_axis_all_eight_devices_bit_identical(devices):
         plain = solver_cls(cfg_cls(grid=grid, dtype="float32", **kw))
         a = sharded.run(sharded.initial_state(), 3)
         b = plain.run(plain.initial_state(), 3)
-        np.testing.assert_array_equal(np.asarray(a.u), np.asarray(b.u))
+        if cfg_cls is DiffusionConfig:
+            np.testing.assert_array_equal(np.asarray(a.u), np.asarray(b.u))
+        else:
+            # WENO: per-shape FMA contraction drifts a few ulps per step
+            # (see tests/test_sharded.py::_WENO_ULPS for the rationale)
+            bound = 32 * np.finfo(np.float32).eps
+            assert np.abs(np.asarray(a.u) - np.asarray(b.u)).max() <= bound
 
 
 def test_hybrid_mesh_device_count_mismatch_is_loud(devices):
