@@ -1,11 +1,19 @@
-"""Headline benchmark: 3-D diffusion cell-update rate (MLUPS) on one chip.
+"""Benchmark matrix: cell-update rates (MLUPS) against every reference
+baseline family, one JSON line per metric (headline first).
 
-Mirrors the reference's north-star measurement — the 4th-order 13-point
-Laplacian + SSP-RK3 hot loop of ``MultiGPU/Diffusion3d_Baseline``
-(401×201×207 including reference halo, 101 iters, 5.87 "GFLOPS" on
-2 GPUs ≈ 731 MLUPS total, ``Run.m:4-13``; derivation in BASELINE.md).
+Mirrors the reference's measurement ladder (`SingleGPU/RunAll.m:1-17`
+plus the per-project `Run.m` timings archived in BASELINE.md), but
+machine-captured instead of hand-pasted into Run.m comments.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Unit: MLUPS = cells * iters * RK_stages / seconds (stage-update rate).
+The reference's own "GFLOPS" differs per tier — the MultiGPU and
+single-GPU *Burgers* conventions include the x3 RK factor, the
+single-GPU *Diffusion* one omits it (BASELINE.md footnote 1) — so every
+`vs_baseline` below divides by the reference number converted to the
+same stage-update MLUPS.
+
+Prints one JSON line per metric:
+  {"metric", "value", "unit", "vs_baseline"}
 
 Timing methodology (sync via device→host fetch, fixed overhead
 subtracted): see ``multigpu_advectiondiffusion_tpu/bench/timing.py``.
@@ -15,8 +23,106 @@ from __future__ import annotations
 
 import json
 
+def _cases(on_tpu: bool):
+    """(metric, make_solver, iters, baseline) rows. CPU mode shrinks the
+    grids — it validates mechanics only (Pallas runs interpreted there)."""
+    # Reference baselines in stage-update MLUPS — single source of truth
+    # is bench/matrix.py BASELINES_MLUPS (derivations in BASELINE.md).
+    # Imported here so main() can set the platform before any jax import.
+    from multigpu_advectiondiffusion_tpu.bench.matrix import BASELINES_MLUPS
 
-BASELINE_MLUPS = 731.0  # MultiGPU Diffusion3d, 2 GPUs total (BASELINE.md)
+    B_DIFF3D = BASELINES_MLUPS["diffusion3d_multigpu"][0]
+    B_DIFF2D = BASELINES_MLUPS["diffusion2d"][0]
+    B_BURG3D = BASELINES_MLUPS["burgers3d_512"][0]
+    B_BURG2D = BASELINES_MLUPS["burgers2d_multigpu"][0]
+    from multigpu_advectiondiffusion_tpu import (
+        BurgersConfig,
+        BurgersSolver,
+        DiffusionConfig,
+        DiffusionSolver,
+        Grid,
+    )
+
+    def diff3d_tiled():
+        # Reference interior 400x200x206 (~16.5M cells) re-proportioned
+        # to exact (8,128) f32 tiles at the same scale: (nz,ny,nx) =
+        # (160,204,508) => padded trailing dims (208,512), zero slack.
+        g = (
+            Grid.make(508, 204, 160, lengths=(12.7, 5.1, 4.0))
+            if on_tpu
+            else Grid.make(64, 28, 16, lengths=(1.6, 0.7, 0.4))
+        )
+        return DiffusionSolver(
+            DiffusionConfig(grid=g, diffusivity=1.0, dtype="float32",
+                            impl="pallas")
+        )
+
+    def diff3d_ref_grid():
+        # The literal MultiGPU north-star interior, NOT tile-aligned
+        # (padded trailing dims carry slack) — reported next to the
+        # headline so the number is not best-case-only.
+        g = (
+            Grid.make(400, 200, 206, lengths=(10.0, 5.0, 5.15))
+            if on_tpu
+            else Grid.make(50, 25, 26, lengths=(1.0, 0.5, 0.52))
+        )
+        return DiffusionSolver(
+            DiffusionConfig(grid=g, diffusivity=1.0, dtype="float32",
+                            impl="pallas")
+        )
+
+    def diff2d():
+        # SingleGPU Diffusion2d ladder grid (1001^2).
+        g = (
+            Grid.make(1001, 1001, lengths=20.0)
+            if on_tpu
+            else Grid.make(65, 65, lengths=2.0)
+        )
+        return DiffusionSolver(
+            DiffusionConfig(grid=g, diffusivity=1.0, dtype="float32",
+                            impl="pallas")
+        )
+
+    def burg3d(adaptive: bool):
+        def make():
+            # SingleGPU Burgers3d_WENO5 512^3 config: WENO5-JS, viscous
+            # nu=1e-5 (main.cpp:56-59). adaptive=False reproduces the
+            # reference's hard-coded unit wave speed (main.c:193);
+            # adaptive=True is the physically-correct default.
+            g = (
+                Grid.make(512, 512, 512, lengths=2.0)
+                if on_tpu
+                else Grid.make(24, 16, 16, lengths=2.0)
+            )
+            return BurgersSolver(
+                BurgersConfig(grid=g, nu=1e-5, dtype="float32",
+                              adaptive_dt=adaptive, impl="pallas")
+            )
+
+        return make
+
+    def burg2d():
+        # MultiGPU Burgers2d interior 400x406 (Run.m:4-14), here on one
+        # chip via the whole-run VMEM stepper (fixed dt, CUDA parity).
+        g = (
+            Grid.make(400, 406, lengths=2.0)
+            if on_tpu
+            else Grid.make(40, 46, lengths=2.0)
+        )
+        return BurgersSolver(
+            BurgersConfig(grid=g, dtype="float32", adaptive_dt=False,
+                          impl="pallas")
+        )
+
+    it = (lambda n: n) if on_tpu else (lambda n: min(n, 4))
+    return [
+        ("diffusion3d_mlups", diff3d_tiled, it(505), B_DIFF3D),
+        ("diffusion3d_ref_grid_mlups", diff3d_ref_grid, it(303), B_DIFF3D),
+        ("diffusion2d_mlups", diff2d, it(2000), B_DIFF2D),
+        ("burgers3d_mlups", burg3d(False), it(20), B_BURG3D),
+        ("burgers3d_adaptive_mlups", burg3d(True), it(20), B_BURG3D),
+        ("burgers2d_mlups", burg2d, it(600), B_BURG2D),
+    ]
 
 
 def main() -> None:
@@ -25,44 +131,32 @@ def main() -> None:
     )
 
     honor_platform_env()
+    import jax
+
     from multigpu_advectiondiffusion_tpu.bench.timing import timed_run
-    from multigpu_advectiondiffusion_tpu import DiffusionConfig, DiffusionSolver, Grid
     from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
     from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
 
-    # Reference interior grid 400x200x206 (z,y,x) = (206,200,400),
-    # ~16.5M cells, re-proportioned to TPU tile sizes at the same scale:
-    # (nz,ny,nx) = (160,204,508) => padded trailing dims (208, 512) are
-    # exact (8,128) f32 tiles (zero slack traffic), 16.58M cells.
-    # Double precision in the reference, f32 here (the framework's TPU
-    # dtype policy, core/dtypes.py). MLUPS is per-cell-update, so the
-    # slight size difference does not bias the rate.
-    grid = Grid.make(508, 204, 160, lengths=(12.7, 5.1, 4.0))
-    cfg = DiffusionConfig(grid=grid, diffusivity=1.0, dtype="float32",
-                          impl="pallas")
-    solver = DiffusionSolver(cfg)
-    state = solver.initial_state()
-
-    # 5x the reference's 101 iters: at ~18 Gsteps/s the 101-iter net time
-    # (~55 ms) is the same order as the tunnel's per-fetch sync overhead
-    # (~100 ms), so the subtraction is noise-dominated; MLUPS is a rate,
-    # unaffected by the count. On CPU (mechanics validation only — the
-    # Pallas kernels run in interpret mode there) a handful suffices.
-    import jax
-
-    iters = 505 if jax.default_backend() != "cpu" else 5
-    elapsed = timed_run(solver, state, iters).seconds
-    rate = mlups(grid.num_cells, iters, STAGES[cfg.integrator], elapsed)
-    print(
-        json.dumps(
-            {
-                "metric": "diffusion3d_mlups",
-                "value": round(rate, 2),
-                "unit": "MLUPS",
-                "vs_baseline": round(rate / BASELINE_MLUPS, 3),
-            }
+    on_tpu = jax.default_backend() != "cpu"
+    for metric, make_solver, iters, baseline in _cases(on_tpu):
+        solver = make_solver()
+        state = solver.initial_state()
+        elapsed = timed_run(solver, state, iters).seconds
+        rate = mlups(
+            solver.grid.num_cells, iters, STAGES[solver.cfg.integrator],
+            elapsed,
         )
-    )
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": round(rate, 2),
+                    "unit": "MLUPS",
+                    "vs_baseline": round(rate / baseline, 3),
+                }
+            ),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

@@ -17,12 +17,19 @@ from typing import Optional, Tuple
 
 from multigpu_advectiondiffusion_tpu.bench.timing import timed_run
 
+# name -> (reference MLUPS, reference source). Single source of truth
+# (bench.py imports these). All values are STAGE-update rates
+# (cells*iters*3/time) so they divide our stage-counting mlups() metric
+# like-for-like; the single-GPU *diffusion* Run.m numbers omit the x3 RK
+# factor in the reference's own GFLOPS (BASELINE.md footnote 1), so those
+# rows are converted here (x3) rather than quoted raw.
 BASELINES_MLUPS = {
-    # name -> (reference MLUPS, reference source)
-    "diffusion2d": (972.8, "SingleGPU/Diffusion2d_PitchedMem/Run.m:3-12"),
-    "diffusion3d": (927.3, "SingleGPU/Diffusion3d_Blocking/Run.m:3-12"),
+    "diffusion2d": (2681.0, "SingleGPU/Diffusion2d_PitchedMem/Run.m:3-12"),
+    "diffusion3d": (2782.0, "SingleGPU/Diffusion3d_Blocking/Run.m:3-12"),
     "diffusion3d_multigpu": (731.0, "MultiGPU/Diffusion3d_Baseline/Run.m:4-13"),
     "burgers3d_512": (879.8, "SingleGPU/Burgers3d_WENO5/Run.m:15-25"),
+    "burgers3d_512_axis": (879.8, "SingleGPU/Burgers3d_WENO5/Run.m:15-25"),
+    "burgers3d_512_xla": (879.8, "SingleGPU/Burgers3d_WENO5/Run.m:15-25"),
     "burgers2d_multigpu": (15.5, "MultiGPU/Burgers2d_Baseline/Run.m:4-14"),
     "burgers3d_multigpu": (37.9, "MultiGPU/Burgers3d_Baseline/Run.m:4-14"),
 }
@@ -37,6 +44,11 @@ class BenchCase:
     quick_scale: int = 4  # divide grid/iters by this in --quick mode
     weno_order: int = 5
     fixed_dt: bool = True  # reference parity: CUDA drivers fix dt
+    # kernel-strategy rung (f32 only; other dtypes run XLA): "pallas"
+    # engages the fused steppers, "pallas_axis" pins the per-axis slab
+    # kernels, "xla" the shifted-slice stencils — the ladder axis that
+    # replaces the reference's pitched/texture/shared variants.
+    impl: str = "pallas"
 
 
 CASES = [
@@ -45,9 +57,22 @@ CASES = [
     BenchCase("diffusion3d", "diffusion", (208, 200, 200), 605),
     BenchCase("diffusion3d_multigpu", "diffusion", (400, 200, 208), 101),
     BenchCase("burgers3d_512", "burgers", (512, 512, 512), 86),
+    # explicit slower rungs of the same flagship config (the reference
+    # benches its non-winning variants too, RunAll.m)
+    BenchCase("burgers3d_512_axis", "burgers", (512, 512, 512), 21,
+              impl="pallas_axis"),
+    BenchCase("burgers3d_512_xla", "burgers", (512, 512, 512), 21,
+              impl="xla"),
     BenchCase("burgers2d_multigpu", "burgers", (400, 408), 200),
     BenchCase("burgers3d_multigpu", "burgers", (400, 400, 408), 267),
 ]
+
+
+def resolve_impl(case: BenchCase, dtype: str) -> str:
+    """Kernel strategy actually benchmarked: the Pallas rungs' DMA tiling
+    is f32-calibrated, other dtypes run XLA. One definition — the JSON
+    'impl' field and the constructed solver must never diverge."""
+    return case.impl if dtype == "float32" else "xla"
 
 
 def build_solver(case: BenchCase, dtype: str, grid_xyz, mesh_spec: Optional[str]):
@@ -68,14 +93,8 @@ def build_solver(case: BenchCase, dtype: str, grid_xyz, mesh_spec: Optional[str]
     grid = Grid.make(*grid_xyz, lengths=[10.0] * len(grid_xyz))
     mesh, sizes = parse_mesh_spec(mesh_spec)
     decomp = decomposition_for(grid, sizes)
+    impl = resolve_impl(case, dtype)
     if case.kind == "diffusion":
-        # impl="pallas" engages the fused single-kernel-per-stage stepper
-        # on eligible 3-D f32 configs (2-D and sharded fall back
-        # gracefully; non-f32 keeps XLA — the Pallas slab kernels' DMA
-        # tiling is f32-calibrated). Burgers stays on XLA — measured
-        # fastest (the WENO sweep is VPU-bound, so the fused kernel only
-        # matches it).
-        impl = "pallas" if dtype == "float32" else "xla"
         cfg = DiffusionConfig(
             grid=grid, diffusivity=1.0, dtype=dtype, impl=impl
         )
@@ -87,6 +106,7 @@ def build_solver(case: BenchCase, dtype: str, grid_xyz, mesh_spec: Optional[str]
         adaptive_dt=not case.fixed_dt,
         dtype=dtype,
         ic="gaussian",
+        impl=impl,
     )
     return BurgersSolver(cfg, mesh=mesh, decomp=decomp)
 
@@ -125,6 +145,7 @@ def run_case(
         "grid": "x".join(map(str, grid_xyz)),
         "iters": iters,
         "dtype": dtype,
+        "impl": resolve_impl(case, dtype),
         "seconds": round(best, 4),
         "compile_seconds": round(compile_s, 3),
         "mlups": round(rate, 1),

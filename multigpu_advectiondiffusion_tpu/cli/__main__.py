@@ -71,9 +71,12 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "solve into DIR (TensorBoard/Perfetto viewable) — "
                         "the nvprof wrapping of profile.sh, TPU-style")
     p.add_argument("--impl", default="xla",
-                   choices=["xla", "pallas", "pallas_step"],
+                   choices=["xla", "pallas", "pallas_axis", "pallas_step"],
                    help="kernel strategy (pallas = fused/VMEM-slab TPU "
-                        "kernels where eligible, XLA fallback otherwise)")
+                        "kernels where eligible, XLA fallback otherwise; "
+                        "pallas_axis = per-axis slab kernels without the "
+                        "fused stepper; pallas_step = whole-step temporal "
+                        "blocking)")
 
 
 def _grid(args, ndim):

@@ -135,11 +135,11 @@ class BurgersSolver(SolverBase):
         whole-run VMEM stepper stays single-chip, fixed-dt."""
         import jax.numpy as jnp
 
-        from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
+        from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
 
         cfg = self.cfg
         eligible = (
-            is_pallas_impl(cfg.impl)
+            is_fused_impl(cfg.impl)
             and self.grid.ndim in (2, 3)
             and cfg.weno_order == 5
             and cfg.weno_variant in ("js", "z")

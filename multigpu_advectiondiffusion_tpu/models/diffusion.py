@@ -204,7 +204,7 @@ class DiffusionSolver(SolverBase):
         temporal blocking crosses the points where ghosts must refresh)."""
         cfg = self.cfg
         bcs = self.bcs
-        from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
+        from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
 
         lshape = (
             self.grid.shape
@@ -220,7 +220,7 @@ class DiffusionSolver(SolverBase):
             and all(lshape[ax] >= R for ax, _ in self.decomp.axes)
         )
         eligible = (
-            is_pallas_impl(cfg.impl)
+            is_fused_impl(cfg.impl)
             and mesh_ok
             and cfg.geometry == "cartesian"
             and cfg.order == 4

@@ -5,9 +5,17 @@ __all__ = ["flux", "laplacian", "weno", "stencils", "axisym"]
 
 def is_pallas_impl(impl: str) -> bool:
     """Whether a solver ``impl`` string selects a Pallas kernel flavor
-    ("pallas", "pallas_step", ...) — the single definition both solvers'
-    eligibility checks use."""
+    ("pallas", "pallas_axis", "pallas_step", ...) — the single definition
+    both solvers' eligibility checks use."""
     return impl.startswith("pallas")
+
+
+def is_fused_impl(impl: str) -> bool:
+    """Whether the flavor may engage a fused whole-stage/whole-run
+    stepper. "pallas_axis" explicitly opts out — it pins the per-axis
+    slab kernels, an explicit rung of the kernel-strategy ladder (the
+    analog of benchmarking the reference's non-fused variants)."""
+    return is_pallas_impl(impl) and impl != "pallas_axis"
 
 
 def op_impl(impl: str) -> str:

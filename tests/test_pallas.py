@@ -58,6 +58,65 @@ def test_weno_pallas_matches_xla(ndim, axis, variant):
                                rtol=1e-4, atol=1e-6 * scale)
 
 
+def test_weno_pallas_supported_at_flagship_grid():
+    """The per-axis Pallas WENO kernel must accept the 512^3 benchmark
+    grid (the one Burgers config with a published reference number,
+    SingleGPU/Burgers3d_WENO5/Run.m:15-25) — the z-block shrinks against
+    VMEM rather than rejecting large rows."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas import weno as pw
+
+    for variant in ("js", "z"):
+        assert pw.supported(3, 5, variant, shape=(512, 512, 512),
+                            dtype=jnp.float32)
+    # the flagship row size forces a small (but viable) z-block
+    b = pw._pick_vmem_block(
+        512, 6, pw._row_bytes((518, 512, 512), jnp.float32)
+    )
+    assert b is not None and 512 % b == 0
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_weno_pallas_explicit_multi_block(axis):
+    """Force multiple leading-axis blocks (the flagship-grid regime) and
+    check the blocked DMA path against XLA for every sweep axis —
+    including the blocked axis itself (in-block halo)."""
+    from multigpu_advectiondiffusion_tpu.core.bc import pad_axis
+    from multigpu_advectiondiffusion_tpu.ops.pallas.weno import (
+        flux_divergence_pallas,
+    )
+
+    shape = (12, 16, 32)
+    u = _field(shape, seed=10 + axis)
+    fx = flux_lib.burgers()
+    bc = Boundary("edge")
+    ref = flux_divergence(u, axis, 0.05, fx, bc=bc, impl="xla")
+    up = pad_axis(u, axis, 3, bc)
+    out = flux_divergence_pallas(up, axis, 0.05, fx, block=2)
+    scale = float(np.max(np.abs(np.asarray(ref))))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-6 * scale)
+
+
+def test_impl_pallas_axis_pins_per_axis_kernels():
+    """impl='pallas_axis' is the explicit per-axis-kernel rung: the fused
+    steppers must NOT engage, and the physics must match XLA."""
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    outs = {}
+    for impl in ("xla", "pallas_axis"):
+        cfg = BurgersConfig(grid=grid, cfl=0.3, adaptive_dt=False,
+                            dtype="float32", ic="gaussian", impl=impl)
+        solver = BurgersSolver(cfg)
+        assert solver._fused_stepper() is None
+        st = solver.run(solver.initial_state(), 4)
+        outs[impl] = np.asarray(st.u)
+    scale = float(np.max(np.abs(outs["xla"])))
+    np.testing.assert_allclose(outs["pallas_axis"], outs["xla"],
+                               rtol=1e-4, atol=1e-6 * scale)
+
+    dcfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas_axis")
+    assert DiffusionSolver(dcfg)._fused_stepper() is None
+
+
 def test_fused_diffusion_run_matches_xla():
     """The fused single-kernel-per-stage fast path (run() with
     impl='pallas' on an eligible config) must agree with the generic XLA

@@ -31,7 +31,9 @@ def _max_abs_diff(a, b):
 # global compilations may round differently by a few ulps per step, and
 # the nonlinear weights compound that over the 5-step runs below
 # (measured: ~11 ulps at step 5). Diffusion stays exactly bit-identical
-# (its linear stencil leaves XLA no such freedom).
+# (its linear stencil leaves XLA no such freedom). float64 eps because
+# every WENO config below runs dtype="float64"; the float32 analog lives
+# in test_multihost.py.
 _WENO_ULPS = 32 * np.finfo(np.float64).eps
 
 
