@@ -183,9 +183,10 @@ class SolverBase:
     # ------------------------------------------------------------------ #
     # Execution: wrap a (u, t) -> (u, t) block program for this world
     # ------------------------------------------------------------------ #
-    def _wrap(self, fn, n_out_scalars: int = 1):
-        """Jit a block program ``(u, t) -> (u, *scalars)``; sharded, the
-        field follows the decomposition spec and scalars are replicated.
+    def _wrap(self, fn, n_out_scalars: int = 1, n_in_scalars: int = 1):
+        """Jit a block program ``(u, *scalars) -> (u, *scalars)``;
+        sharded, the field follows the decomposition spec and scalars
+        are replicated.
 
         The replication/vma checker stays on except for Pallas-flavored
         configs, whose ``pallas_call`` outputs carry no vma typing."""
@@ -198,7 +199,7 @@ class SolverBase:
             shard_map(
                 fn,
                 mesh=self.mesh,
-                in_specs=(spec, P()),
+                in_specs=(spec,) + (P(),) * n_in_scalars,
                 out_specs=(spec,) + (P(),) * n_out_scalars,
                 check=not is_pallas_impl(getattr(self.cfg, "impl", "")),
             )
@@ -280,20 +281,26 @@ class SolverBase:
 
     def advance_to(self, state: SolverState, t_end: float) -> SolverState:
         """March until ``t_end`` with the last step trimmed to land exactly
-        (the corrected version of the MATLAB drivers' loop, heat3d.m:48-77)."""
-        eps = 1e-12 * max(1.0, abs(t_end))
+        (the corrected version of the MATLAB drivers' loop, heat3d.m:48-77).
 
-        def block(u, t):
+        ``t_end`` is a traced operand: one compilation serves every end
+        time, so parameter sweeps do not recompile per value."""
+
+        def block(u, t, te):
+            eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+
             def cond(c):
-                return c[1] < t_end - eps
+                return c[1] < te - eps
 
             def body(c):
                 u, t, it = c
-                u, t = self._local_step(u, t, t_end=t_end)
+                u, t = self._local_step(u, t, t_end=te)
                 return (u, t, it + 1)
 
             return lax.while_loop(cond, body, (u, t, jnp.zeros((), jnp.int32)))
 
-        f = self._compiled(("adv", float(t_end)), lambda: self._wrap(block, 2))
-        u, t, steps = f(state.u, state.t)
+        f = self._compiled("adv", lambda: self._wrap(block, 2, 2))
+        u, t, steps = f(
+            state.u, state.t, jnp.asarray(t_end, state.t.dtype)
+        )
         return SolverState(u=u, t=t, it=state.it + steps)

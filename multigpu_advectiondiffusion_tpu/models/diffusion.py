@@ -304,9 +304,9 @@ class DiffusionSolver(SolverBase):
         ``TestingAccuracy.log``."""
         from jax import lax
 
-        def block(u, t):
+        def block(u, t, te):
             def cond(c):
-                return c[1] < t_end
+                return c[1] < te
 
             def body(c):
                 u, t, dt = c
@@ -314,13 +314,15 @@ class DiffusionSolver(SolverBase):
                 u = self.integrator(phys.rhs, u, dt.astype(u.dtype), None)
                 if phys.post is not None:
                     u = phys.post(u)
-                dt = jnp.where(t + dt > t_end, t_end - t, dt)
+                dt = jnp.where(t + dt > te, te - t, dt)
                 return (u, t + dt, dt)
 
             dt0 = jnp.asarray(self.dt, dtype=t.dtype)
             u, t, _ = lax.while_loop(cond, body, (u, t, dt0))
             return u, t
 
-        f = self._compiled(("advref", float(t_end)), lambda: self._wrap(block))
-        u, t = f(state.u, state.t)
+        # t_end is a traced operand — one compilation serves the whole
+        # grid-refinement sweep (the convergence CLI calls this per nc)
+        f = self._compiled("advref", lambda: self._wrap(block, 1, 2))
+        u, t = f(state.u, state.t, jnp.asarray(t_end, state.t.dtype))
         return SolverState(u=u, t=t, it=state.it)

@@ -225,10 +225,11 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     ``shard_map``; ``global_shape`` is the global interior for the
     band/face tests).
     """
-    nz = interior_shape[0]
     trailing = padded_shape[1:]
     use_u = u_source != "none"
-    n_blocks = nz // bz
+    # blocks cover the padded buffer's (possibly block-rounded) z extent;
+    # dead tail rows beyond the real interior stay frozen via the masks
+    n_blocks = (padded_shape[0] - 2 * R) // bz
 
     kern = functools.partial(
         _stage_kernel,
@@ -307,32 +308,51 @@ class FusedDiffusionStepper:
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
+        self.dtype = jnp.dtype(dtype)
+        self.bc_value = float(bc_value)
+        row_bytes = (
+            round_up(ny + 2 * R, SUBLANE) * round_up(nx + 2 * R, LANE)
+            * self.dtype.itemsize
+        )
+        # VMEM model calibrated on v5e at the bench grid (row =
+        # 208*512*4 B): ~9 live row-sized buffers per block row plus ~56
+        # rows of fixed overhead; bz=20 measured fastest, bz=32 exceeds
+        # VMEM. Capped at the largest measured-safe block.
+        budget_rows = max(1, min(20, int((VMEM_LIMIT // row_bytes - 56) // 9)))
+        if block_z is None:
+            if self.sharded:
+                # sharded shards exchange their core rows — dead padding
+                # rows inside the domain would corrupt neighbor ghosts,
+                # so the block must divide the local extent exactly
+                block_z = pick_block(nz, budget_rows)
+            else:
+                # unsharded: pad z up to a block multiple instead of
+                # shrinking the block to a divisor (nz=206 would force
+                # bz=2). Dead tail rows hold bc_value from embed() and
+                # stay frozen (they are neither interior nor face in the
+                # global-index masks), so interior cell nz-1 reads them
+                # as the Dirichlet ghosts it needs. Score balances z-halo
+                # amortization bz/(bz+2R) against wasted dead rows.
+                def score(b):
+                    blocks = -(-nz // b)
+                    return (b / (b + 2 * R)) * (nz / (blocks * b))
+
+                block_z = max(range(1, budget_rows + 1), key=score)
+        elif self.sharded and nz % block_z != 0:
+            raise ValueError(
+                f"block_z={block_z} must divide local nz={nz} when "
+                "sharded; a non-divisor would leave dead rows inside "
+                "the exchanged core"
+            )
+        bz = block_z
+        # nz rounded up to a block multiple (== nz when sharded: both
+        # branches above guarantee an exact divisor there)
+        nz_eff = -(-nz // bz) * bz
         self.padded_shape = (
-            nz + 2 * R,
+            nz_eff + 2 * R,
             round_up(ny + 2 * R, SUBLANE),
             round_up(nx + 2 * R, LANE),
         )
-        self.dtype = jnp.dtype(dtype)
-        self.bc_value = float(bc_value)
-        if block_z is None:
-            # Largest divisor of nz whose working set stays under the
-            # Mosaic scoped-VMEM ceiling. Calibrated on v5e at the bench
-            # grid (row = 208*512*4 B): ~9 live row-sized buffers per
-            # block row plus ~56 rows of fixed overhead; bz=20 measured
-            # 91 GLUPS (vs 54 at bz=16), bz=32 exceeds VMEM. Capped at
-            # the largest measured-safe block.
-            row_bytes = (
-                self.padded_shape[1] * self.padded_shape[2]
-                * self.dtype.itemsize
-            )
-            budget_rows = (VMEM_LIMIT // row_bytes - 56) // 9
-            block_z = pick_block(nz, max(1, min(20, int(budget_rows))))
-        if nz % block_z != 0:
-            raise ValueError(
-                f"block_z={block_z} must divide nz={nz}; a non-divisor "
-                "would leave the top z-rows un-stepped"
-            )
-        bz = block_z
         scales = [
             float(diffusivity[i]) / (12.0 * spacing[i] * spacing[i])
             for i in range(3)

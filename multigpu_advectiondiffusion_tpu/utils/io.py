@@ -360,7 +360,14 @@ def save_checkpoint(
     Grid metadata — plus the run's key ``physics`` parameters, so a resume
     can refuse a silently-different configuration — rides in a
     ``<path>.json`` sidecar for ``.ckpt`` (the array shape itself is
-    already in the binary header)."""
+    already in the binary header).
+
+    Scale limit (documented, not hidden): a *sharded* state is gathered
+    to one host (``np.asarray`` on the global ``jax.Array``) before
+    writing — fine at reference scale (the reference's own gather does
+    the same over MPI, ``main.c:326-335``, and has no restart at all),
+    but a multi-host run whose global array exceeds one host's memory
+    needs a per-shard format this writer does not implement."""
     meta = {}
     if grid is not None:
         meta = {"shape": list(grid.shape), "bounds": [list(b) for b in grid.bounds]}

@@ -135,6 +135,44 @@ def test_fused_diffusion_run_matches_xla():
     assert outs["pallas"][1] == outs["xla"][1]
 
 
+@pytest.mark.parametrize("nz,block_z", [(23, None), (14, 4)])
+def test_fused_diffusion_non_multiple_nz_pads_dead_rows(nz, block_z):
+    """Unsharded fused diffusion pads z to a block multiple instead of
+    shrinking the block to a divisor (a prime-ish nz like the literal
+    reference grid's 206 would otherwise force a tiny block). The dead
+    tail rows hold the Dirichlet value and stay frozen, so results match
+    the XLA path exactly as for multiple sizes. nz=23 (prime, above no
+    viable same-size block) and an explicit non-divisor block both force
+    real dead rows — asserted, so the padding path cannot silently stop
+    being exercised."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+        R as DIFF_R,
+    )
+
+    grid = Grid.make(24, 16, nz, lengths=2.0)
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = DiffusionConfig(grid=grid, dtype="float32", impl=impl)
+        solver = DiffusionSolver(cfg)
+        if impl == "pallas":
+            fused = solver._fused_stepper()
+            assert fused is not None
+            if block_z is not None:
+                fused = type(fused)(
+                    grid.shape, solver.dtype, grid.spacing, [1.0] * 3,
+                    solver.dt, 2, 0.0, block_z=block_z,
+                )
+                solver._cache["fused"] = fused
+            dead = fused.padded_shape[0] - 2 * DIFF_R - nz
+            assert dead > 0, "test must exercise the dead-row path"
+        st = solver.run(solver.initial_state(), 6)
+        outs[impl] = np.asarray(st.u)
+    assert outs["pallas"].shape == outs["xla"].shape
+    scale = float(np.max(np.abs(outs["xla"])))
+    np.testing.assert_allclose(outs["pallas"], outs["xla"],
+                               rtol=1e-5, atol=2e-6 * scale)
+
+
 def test_fused_diffusion_ineligible_configs_fall_back():
     """Configs outside the fused kernel's assumptions must quietly use
     the generic path (and still run)."""

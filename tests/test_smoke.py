@@ -57,3 +57,25 @@ def test_advance_to_lands_exactly():
     state = solver.initial_state()  # t = t0 = 0.1
     out = solver.advance_to(state, 0.2)
     assert abs(float(out.t) - 0.2) < 1e-10
+
+
+def test_advance_to_does_not_recompile_per_t_end():
+    """t_end is a traced operand: a parameter sweep over end times must
+    reuse ONE compiled program (the cache previously keyed on the float,
+    compiling once per value)."""
+    grid = Grid.make(33, lengths=10.0)
+    solver = DiffusionSolver(DiffusionConfig(grid=grid, dtype="float64"))
+    state = solver.initial_state()
+    for te in (0.15, 0.2, 0.3):
+        out = solver.advance_to(state, te)
+        assert abs(float(out.t) - te) < 1e-10
+    adv_keys = [k for k in solver._cache if k == "adv" or (
+        isinstance(k, tuple) and k and k[0] == "adv")]
+    assert adv_keys == ["adv"]
+
+    # same property for the MATLAB-exact accuracy loop
+    for te in (0.15, 0.2):
+        solver.advance_reference(state, te)
+    ref_keys = [k for k in solver._cache if k == "advref" or (
+        isinstance(k, tuple) and k and k[0] == "advref")]
+    assert ref_keys == ["advref"]
