@@ -51,6 +51,7 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     R,
     SUBLANE,
     VMEM_LIMIT,
+    _aligned_row_bytes_3d,
     compiler_params,
     interpret_mode,
     pick_block,
@@ -310,10 +311,7 @@ class FusedDiffusionStepper:
         self.sharded = self.global_shape != self.interior_shape
         self.dtype = jnp.dtype(dtype)
         self.bc_value = float(bc_value)
-        row_bytes = (
-            round_up(ny + 2 * R, SUBLANE) * round_up(nx + 2 * R, LANE)
-            * self.dtype.itemsize
-        )
+        row_bytes = _aligned_row_bytes_3d((nz, ny, nx), self.dtype.itemsize)
         # VMEM model calibrated on v5e at the bench grid (row =
         # 208*512*4 B): ~9 live row-sized buffers per block row plus ~56
         # rows of fixed overhead; bz=20 measured fastest, bz=32 exceeds

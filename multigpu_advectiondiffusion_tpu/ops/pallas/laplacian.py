@@ -44,6 +44,12 @@ VMEM_BUDGET = 12 * 1024 * 1024
 # the chip's physical VMEM and rejects reference-scale slabs).
 VMEM_LIMIT = 100 * 1024 * 1024
 
+# Working-set budget for the 3-D slab kernels' block picker — below
+# VMEM_LIMIT to leave headroom for Mosaic's own spills/double-buffering
+# (calibrated on v5e: a 6.6 MB-row slab compiles at bz=1 under this
+# budget, bz=2 exceeds the chip's 128 MiB physical VMEM).
+VMEM_BLOCK_BUDGET_3D = 72 * 1024 * 1024
+
 
 def compiler_params():
     return pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT)
@@ -102,6 +108,26 @@ def _axis_term(u, axis, scale, lead, shape):
     return acc
 
 
+def _aligned_row_bytes_3d(interior_shape, itemsize: int) -> int:
+    """Tile-aligned bytes of one padded leading-axis row."""
+    return (
+        _round_up(interior_shape[1] + 2 * R, SUBLANE)
+        * _round_up(interior_shape[2] + 2 * R, LANE)
+        * itemsize
+    )
+
+
+def pick_vmem_block_3d(nz: int, row_bytes: int, target: int = 8):
+    """Largest divisor of ``nz`` (<= target) whose working set fits the
+    3-D block budget, or ``None``. Liveness model: ~7 live row-sized
+    buffers per block row plus the slab halo (see
+    ``VMEM_BLOCK_BUDGET_3D`` for the calibration)."""
+    for b in range(min(target, nz), 0, -1):
+        if nz % b == 0 and (7 * b + 2 * R) * row_bytes <= VMEM_BLOCK_BUDGET_3D:
+            return b
+    return None
+
+
 def laplacian_o4_3d(
     up: jnp.ndarray,
     spacing: Sequence[float],
@@ -114,7 +140,11 @@ def laplacian_o4_3d(
     """
     nzp, nyp, nxp = up.shape
     nz, ny, nx = nzp - 2 * R, nyp - 2 * R, nxp - 2 * R
-    bz = block_z or pick_block(nz)
+    bz = block_z or pick_vmem_block_3d(
+        nz, _aligned_row_bytes_3d((nz, ny, nx), up.dtype.itemsize)
+    )
+    if bz is None:
+        raise ValueError("no VMEM-viable z-block; gate with supported()")
     up = align_trailing(up)
     # identical association order to the XLA path (ops.laplacian.laplacian):
     # per-axis stencil scaled by 1/(12 dx^2), then multiplied by K_axis.
@@ -199,7 +229,12 @@ def supported(shape: Sequence[int], order: int, itemsize: int = 4) -> bool:
     if order != 4:
         return False
     if len(shape) == 3:
-        return True
+        # very wide trailing extents (e.g. the reference's 1601x986 slab
+        # planes) can exceed VMEM even at a 1-row block
+        return (
+            pick_vmem_block_3d(shape[0], _aligned_row_bytes_3d(shape, itemsize))
+            is not None
+        )
     if len(shape) == 2:
         return fits_vmem(shape, R, 3, itemsize)
     return False

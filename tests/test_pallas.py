@@ -58,6 +58,23 @@ def test_weno_pallas_matches_xla(ndim, axis, variant):
                                rtol=1e-4, atol=1e-6 * scale)
 
 
+def test_laplacian_pallas_gates_vmem_exceeding_rows():
+    """The 3-D block picker must size the z-block against VMEM, not a
+    fixed 8: the reference's 1601x986x35 slab workload (6.6 MB rows)
+    OOM'd the compiler at the old divisor-only default (bz=7) and is
+    viable only at bz=1; rows too wide for even a 1-row block must be
+    rejected to the XLA path."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas import laplacian as pl_lap
+
+    row = pl_lap._aligned_row_bytes_3d((35, 986, 1601), 4)
+    assert pl_lap.pick_vmem_block_3d(35, row) == 1
+    assert pl_lap.supported((35, 986, 1601), 4, 4)
+    # ~33 MB rows: no viable block at all -> XLA fallback
+    assert not pl_lap.supported((35, 2000, 4000), 4, 4)
+    assert pl_lap.supported((512, 512, 512), 4, 4)
+    assert pl_lap.supported((160, 204, 508), 4, 4)
+
+
 def test_weno_pallas_supported_at_flagship_grid():
     """The per-axis Pallas WENO kernel must accept the 512^3 benchmark
     grid (the one Burgers config with a published reference number,
