@@ -168,18 +168,24 @@ class BurgersSolver(SolverBase):
                 lshape[ax] < R for ax, _ in self.decomp.axes
             ):
                 return None
+            # y-rounding is incompatible only with a y-sharded axis
+            # (dead columns would be exchanged as neighbor ghosts)
+            y_sharded = self.mesh is not None and 1 in dict(self.decomp.axes)
+            if not cls.supported(lshape, self.dtype, y_sharded=y_sharded):
+                return None
         else:
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (  # noqa: E501
                 FusedBurgers2DStepper as cls,
             )
-        if not cls.supported(lshape, self.dtype):
-            return None
+            if not cls.supported(lshape, self.dtype):
+                return None
         if "fused" not in self._cache:
             spacing = self.grid.spacing
             kwargs = {}
             if self.grid.ndim == 3:
                 if self.mesh is not None:
                     kwargs["global_shape"] = self.grid.shape
+                    kwargs["y_sharded"] = y_sharded
                 if cfg.adaptive_dt:
                     reduce = self.mesh_reduce_max()
                     kwargs["dt_fn"] = lambda u: advective_dt(

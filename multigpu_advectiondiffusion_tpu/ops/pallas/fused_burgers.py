@@ -112,7 +112,7 @@ def _pick_blocks(nz, ny, x_pad, itemsize):
     for by in (64, 128, 32, 16, 8):
         if ny % by:
             continue
-        for bz in (8, 4, 2, 1):
+        for bz in (8, 7, 6, 5, 4, 3, 2, 1):
             if nz % bz:
                 continue
             if _live_bytes(bz, by, x_pad, itemsize) <= _VMEM_BUDGET:
@@ -231,6 +231,7 @@ def _stage_kernel(
     n_bz: int,
     n_by: int,
     local_shape: Sequence[int],
+    ly_eff: int,
     inv_dx: Sequence[float],
     nu_scales: Sequence[float] | None,
     flux: Flux,
@@ -343,6 +344,16 @@ def _stage_kernel(
     rk = jnp.where(gx < 0, rk[:, :, R : R + 1], rk)
     rk = jnp.where(gx >= lx, rk[:, :, R + lx - 1 : R + lx], rk)
 
+    if ly_eff != ly:
+        # y-rounding margin: core columns >= ly are dead — refill them
+        # with the edge replica of the last interior column (they serve
+        # as that column's y-sweep ghosts next stage). Dead columns live
+        # only in the last y-block, where column ly-1 sits at this static
+        # local index; other blocks' masks are all-false.
+        gy = lax.broadcasted_iota(jnp.int32, rk.shape, 1) + ky * by
+        edge = (ly - 1) - (n_by - 1) * by
+        rk = jnp.where(gy >= ly, rk[:, edge : edge + 1], rk)
+
     @pl.when(k >= 2)
     def _():
         copy_w(k - 2, slot).wait()
@@ -371,7 +382,7 @@ def _stage_kernel(
             gyres,
             out_hbm.at[
                 pl.ds(R + z0, bz),
-                pl.ds(pl.multiple_of(MARGIN + ly, SUBLANE), MARGIN),
+                pl.ds(pl.multiple_of(MARGIN + ly_eff, SUBLANE), MARGIN),
             ],
             sem_g,
         )
@@ -425,10 +436,10 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     between-stage refresh fixes non-global shard edges.
     """
     lz = local_shape[0]
-    ly = local_shape[1]
+    ly_eff = padded_shape[1] - 2 * MARGIN  # ly rounded up to by multiple
     trailing = padded_shape[2:]
     use_u = u_source != "none"
-    n_bz, n_by = lz // bz, ly // by
+    n_bz, n_by = lz // bz, ly_eff // by
 
     kern = functools.partial(
         _stage_kernel,
@@ -437,6 +448,7 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
         n_bz=n_bz,
         n_by=n_by,
         local_shape=tuple(local_shape),
+        ly_eff=ly_eff,
         inv_dx=tuple(inv_dx),
         nu_scales=None if nu_scales is None else tuple(nu_scales),
         flux=flux,
@@ -506,23 +518,33 @@ class FusedBurgersStepper:
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
-                 dt_fn=None, block=None, global_shape=None):
+                 dt_fn=None, block=None, global_shape=None,
+                 y_sharded: bool = False):
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
         lz, ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
+        if y_sharded and ly % SUBLANE:
+            # dead y-rounding columns inside a y-exchanged core would be
+            # sent to neighbors as ghosts; a y-sharded axis keeps exact
+            # tiling (z/x-only decompositions may still round y — their
+            # exchanges never ship y columns as ghosts)
+            raise ValueError(
+                f"y-sharded fused Burgers needs ly % {SUBLANE} == 0, got {ly}"
+            )
+        ly_eff = round_up(ly, SUBLANE)
         self.padded_shape = (
             lz + 2 * R,
-            ly + 2 * MARGIN,
+            ly_eff + 2 * MARGIN,
             round_up(lx + 2 * R, LANE),
         )
         self.dtype = jnp.dtype(dtype)
         blk = block if block is not None else _pick_blocks(
-            lz, ly, self.padded_shape[2], self.dtype.itemsize
+            lz, ly_eff, self.padded_shape[2], self.dtype.itemsize
         )
-        if blk is None or ly % 8 or lz % blk[0] or ly % blk[1] or blk[1] % 8:
+        if blk is None or lz % blk[0] or ly_eff % blk[1] or blk[1] % 8:
             raise ValueError(
                 f"no viable (bz, by) block for interior {interior_shape}"
             )
@@ -556,12 +578,16 @@ class FusedBurgersStepper:
         self._step = step
 
     @staticmethod
-    def supported(interior_shape, dtype) -> bool:
+    def supported(interior_shape, dtype, y_sharded: bool = False) -> bool:
         lz, ly, lx = interior_shape
-        if ly % 8:
+        if y_sharded and ly % SUBLANE:
             return False
+        ly_eff = round_up(ly, SUBLANE)
         x_pad = round_up(lx + 2 * R, LANE)
-        return _pick_blocks(lz, ly, x_pad, jnp.dtype(dtype).itemsize) is not None
+        return (
+            _pick_blocks(lz, ly_eff, x_pad, jnp.dtype(dtype).itemsize)
+            is not None
+        )
 
     def embed(self, u):
         lz, ly, lx = self.interior_shape

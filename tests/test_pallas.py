@@ -71,6 +71,10 @@ def test_laplacian_pallas_gates_vmem_exceeding_rows():
     assert pl_lap.supported((35, 986, 1601), 4, 4)
     # ~33 MB rows: no viable block at all -> XLA fallback
     assert not pl_lap.supported((35, 2000, 4000), 4, 4)
+    # 512^2 trailing: bz=8 measured 105.1 MB (over the 100 MiB scope);
+    # the picker must stop at 4
+    row512 = pl_lap._aligned_row_bytes_3d((512, 512, 512), 4)
+    assert pl_lap.pick_vmem_block_3d(512, row512) == 4
     assert pl_lap.supported((512, 512, 512), 4, 4)
     assert pl_lap.supported((160, 204, 508), 4, 4)
 
@@ -310,6 +314,61 @@ def test_fused_burgers_sharded_bit_identical_to_unsharded_fused(
     out = solver.run(solver.initial_state(), 5)
     np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
     assert float(out.t) == float(ref.t)
+
+
+@pytest.mark.parametrize("ny", [14, 19])
+def test_fused_burgers_non_multiple_ny_rounds_with_dead_columns(ny):
+    """Unsharded fused Burgers rounds y up to the sublane tile instead of
+    rejecting unaligned extents (the reference's 1601x986x35 workload);
+    the dead columns are re-filled as edge replicas every stage, so
+    results match XLA. Dead columns must actually exist or the path is
+    untested."""
+    grid = Grid.make(24, ny, 16, lengths=2.0)
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                            adaptive_dt=True, impl=impl)
+        solver = BurgersSolver(cfg)
+        if impl == "pallas":
+            fused = solver._fused_stepper()
+            assert fused is not None
+            assert fused.padded_shape[1] - 16 > ny, "need dead y columns"
+        st = solver.run(solver.initial_state(), 5)
+        outs[impl] = np.asarray(st.u)
+    assert outs["pallas"].shape == outs["xla"].shape
+    scale = float(np.max(np.abs(outs["xla"])))
+    np.testing.assert_allclose(outs["pallas"], outs["xla"],
+                               rtol=2e-5, atol=2e-6 * scale)
+
+
+def test_fused_burgers_y_rounding_composes_with_z_sharding(devices):
+    """y-rounding is legal when the y axis is NOT sharded: a z-slab
+    decomposition never ships y columns as ghosts, so an unaligned ny
+    may still take the fused path — bit-identical to the unsharded
+    fused run. (A y-sharded unaligned ny falls back instead.)"""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(24, 14, 16, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                        adaptive_dt=True, impl="pallas")
+    solver = BurgersSolver(
+        cfg, mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz")
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded
+    out = solver.run(solver.initial_state(), 5)
+    ref_solver = BurgersSolver(cfg)
+    ref = ref_solver.run(ref_solver.initial_state(), 5)
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+
+    # y-sharded + unaligned ny must NOT take the fused path
+    ysolver = BurgersSolver(
+        cfg, mesh=make_mesh({"dy": 2}), decomp=Decomposition.of({1: "dy"})
+    )
+    assert ysolver._fused_stepper() is None
 
 
 def test_fused_burgers_ineligible_configs_fall_back():
