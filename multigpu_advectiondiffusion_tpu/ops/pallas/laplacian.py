@@ -45,15 +45,16 @@ VMEM_BUDGET = 12 * 1024 * 1024
 VMEM_LIMIT = 100 * 1024 * 1024
 
 # Model budget for the 3-D slab kernels' block picker. The model counts
-# the kernel's raw materializations — 15 axis-term buffers per block row
-# (5 coefficients x 3 axes) plus the slab's 2R halo rows, i.e.
-# (16 b + 2R) aligned rows — which OVERestimates what Mosaic actually
-# allocates (it fuses the adds), so this budget is deliberately above
-# the 100 MiB scoped ceiling. Calibrated on two v5e anchors with
-# _aligned_row_bytes_3d rows: 6.6 MB rows (990x1605 trailing) compile at
-# bz=1 (model 132 MB) and fail at bz=2 (model 238 MB); 1.33 MB rows
-# (512^2 trailing, aligned 520x640) fail at bz=8 (model 176 MB, actual
-# 105.1 MB vs the 100 MiB scope) and compile at bz=4 (model 90 MB).
+# the kernel's raw materializations per block row — 15 axis-term
+# buffers (5 coefficients x 3 axes) plus the VMEM output block — plus
+# the slab's 2R halo rows, i.e. ((15 + 1) b + 2R) aligned rows. This
+# OVERestimates what Mosaic actually allocates (it fuses the adds), so
+# the budget is deliberately above the 100 MiB scoped ceiling.
+# Calibrated on two v5e anchors with _aligned_row_bytes_3d rows:
+# 6.6 MB rows (990x1605 trailing) compile at bz=1 (model 132 MB) and
+# fail at bz=2 (model 238 MB); 1.33 MB rows (512^2 trailing, aligned
+# 520x640) fail at bz=8 (model 176 MB, actual 105.1 MB vs the 100 MiB
+# scope) and compile at bz=4 (model 90 MB).
 VMEM_BLOCK_BUDGET_3D = 134 * 1024 * 1024
 
 
@@ -125,9 +126,10 @@ def _aligned_row_bytes_3d(interior_shape, itemsize: int) -> int:
 
 def pick_vmem_block_3d(nz: int, row_bytes: int, target: int = 8):
     """Largest divisor of ``nz`` (<= target) whose modeled working set
-    fits ``VMEM_BLOCK_BUDGET_3D``, or ``None``. Model: the 15 per-row
-    axis-term materializations plus the slab's 2R halo rows (see the
-    budget constant for the measured calibration anchors)."""
+    fits ``VMEM_BLOCK_BUDGET_3D``, or ``None``. Model: 16 row-sized
+    buffers per block row (15 axis terms + the output block) plus the
+    slab's 2R halo rows (see the budget constant for the measured
+    calibration anchors)."""
     for b in range(min(target, nz), 0, -1):
         need = (16 * b + 2 * R) * row_bytes
         if nz % b == 0 and need <= VMEM_BLOCK_BUDGET_3D:

@@ -64,10 +64,10 @@ def hybrid_mesh(
         dcn_total *= s
 
     devs = list(jax.devices())
-    has_slice_topology = all(
-        getattr(d, "slice_index", None) is not None for d in devs
-    )
-    if has_slice_topology:
+    slice_ids = {getattr(d, "slice_index", None) for d in devs}
+    n_slices = len(slice_ids) if None not in slice_ids else 0
+    n_procs = len({d.process_index for d in devs})
+    if n_slices == dcn_total:
         # Topology-aware placement: orders devices along the ICI torus so
         # ppermute halo neighbors are physically adjacent. Real
         # misconfigurations (axis sizes vs device count etc.) raise from
@@ -75,11 +75,21 @@ def hybrid_mesh(
         devices = mesh_utils.create_hybrid_device_mesh(
             ici_sizes, dcn_sizes, devices=devs
         )
+    elif n_procs == dcn_total:
+        # The slice topology does not match the requested DCN extent
+        # (e.g. multi-process CPU, where every device reports slice 0),
+        # but the process count does: one process = one DCN granule —
+        # the MPI-rank view of the world (Tools.c:228-242).
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes, dcn_sizes, devices=devs, process_is_granule=True,
+        )
     elif dcn_total == 1:
-        # Platforms whose devices carry no slice topology (e.g. the
-        # virtual-CPU test mesh): with no cross-slice axis a plain
-        # row-major mesh over ALL devices is a valid, if unoptimized,
-        # hybrid mesh.
+        # Last-resort single-granule fallback. NOTE a single process
+        # never reaches here (n_procs == 1 == dcn_total matches the
+        # branch above); this covers only multi-process platforms whose
+        # devices carry neither a matching slice topology nor a matching
+        # process count, where a plain row-major mesh over ALL devices
+        # is still a valid, if unoptimized, hybrid mesh.
         if total != len(devs):
             raise ValueError(
                 f"hybrid_mesh axes need {total} devices, have {len(devs)}"
@@ -87,14 +97,13 @@ def hybrid_mesh(
         devices = np.asarray(devs).reshape(dcn_sizes + ici_sizes)
         return Mesh(devices, names)
     else:
-        # Devices without slice topology but a real DCN extent: group by
-        # process instead (raises a clear ValueError if the process count
-        # cannot satisfy dcn_sizes).
-        devices = mesh_utils.create_hybrid_device_mesh(
-            ici_sizes, dcn_sizes, devices=devs, process_is_granule=True,
+        raise ValueError(
+            f"cannot place DCN extent {dcn_total}: platform reports "
+            f"{n_slices} slice(s) and {n_procs} process(es)"
         )
-    # create_hybrid_device_mesh returns shape dcn_sizes + ici_sizes
-    return Mesh(np.asarray(devices), names)
+    # create_hybrid_device_mesh returns the devices in dcn-major order
+    # (some backends flatten) — impose the dcn_sizes + ici_sizes shape
+    return Mesh(np.asarray(devices).reshape(dcn_sizes + ici_sizes), names)
 
 
 def process_local_devices() -> Sequence:

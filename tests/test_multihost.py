@@ -130,6 +130,91 @@ def test_process_local_devices_and_coordinator(devices):
     assert multihost.is_coordinator()  # single-process: process_index 0
 
 
+_WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, sys.argv[3])
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, port = int(sys.argv[1]), sys.argv[2]
+
+from multigpu_advectiondiffusion_tpu.parallel import multihost
+multihost.initialize(coordinator_address=f"localhost:{port}",
+                     num_processes=2, process_id=pid)
+import numpy as np
+from multigpu_advectiondiffusion_tpu import (
+    BurgersConfig, BurgersSolver, DiffusionConfig, DiffusionSolver, Grid)
+from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition
+
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+mesh = multihost.hybrid_mesh({"dz_ici": 4}, {"dz_dcn": 2})
+assert mesh.devices.shape == (2, 4)
+grid = Grid.make(12, 12, 24, lengths=2.0)
+decomp = Decomposition.of({0: ("dz_dcn", "dz_ici")})
+for name, cfg_cls, solver_cls, kw, tol in (
+    ("diffusion", DiffusionConfig, DiffusionSolver, {}, 0.0),
+    # WENO: per-shape FMA contraction drifts a few f32 ulps per step
+    # (see test_sharded.py::_WENO_ULPS); adaptive dt adds a cross-
+    # process gloo pmax to the mix
+    ("burgers", BurgersConfig, BurgersSolver, {"nu": 1e-5},
+     32 * np.finfo(np.float32).eps),
+):
+    cfg = cfg_cls(grid=grid, dtype="float32", **kw)
+    solver = solver_cls(cfg, mesh=mesh, decomp=decomp)
+    out = solver.run(solver.initial_state(), 4)
+    ref_solver = solver_cls(cfg_cls(grid=grid, dtype="float32", **kw))
+    ref = np.asarray(ref_solver.run(ref_solver.initial_state(), 4).u)
+    worst = max(
+        float(np.abs(np.asarray(sh.data) - ref[sh.index]).max())
+        for sh in out.u.addressable_shards
+    )
+    assert worst <= tol, (name, worst, tol)
+    print(f"proc {pid}: {name} ok (worst {worst:.2e})", flush=True)
+print(f"proc {pid}: MULTIPROC-OK", flush=True)
+'''
+
+
+def test_two_process_distributed_execution(tmp_path):
+    """REAL multi-process execution — the capability the reference gets
+    from mpirun (``MultiGPU/*/run.sh``): two OS processes, 4 virtual CPU
+    devices each, joined by ``multihost.initialize``; ``hybrid_mesh``
+    places the DCN axis on process granules; the unchanged sharded
+    solvers run with ppermute halo hops (and the adaptive-dt pmax)
+    crossing the process boundary over gloo. Every process's local
+    shards must match a locally-computed unsharded reference —
+    bit-exactly for diffusion, to the documented WENO ulp bound for
+    Burgers."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(port), REPO],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert "MULTIPROC-OK" in out
+
+
 def test_initialize_single_process_smoke():
     """``initialize()`` brings up jax.distributed with one process — the
     InitializeMPI analog — in a subprocess so this process's runtime is
