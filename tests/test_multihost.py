@@ -191,26 +191,34 @@ def test_two_process_distributed_execution(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(i), str(port), REPO],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-        )
-        for i in range(2)
-    ]
-    outs = []
+    # worker output goes to files, not pipes: a full 64 KiB pipe would
+    # stall that worker mid-collective, deadlocking its peer until the
+    # timeout AND losing all diagnostics
+    logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    handles = [open(log, "w") for log in logs]
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=300)
-            outs.append(out)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(i), str(port), REPO],
+                stdout=handles[i],
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+            )
+            for i in range(2)
+        ]
+        try:
+            for p in procs:
+                p.wait(timeout=300)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
+        for h in handles:
+            h.close()
+    for i, (p, log) in enumerate(zip(procs, logs)):
+        out = log.read_text()
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert "MULTIPROC-OK" in out
 
