@@ -23,8 +23,9 @@ time is used instead — conservative, never inflating.
 
 from __future__ import annotations
 
+import statistics
 import time
-from typing import NamedTuple
+from typing import Callable, NamedTuple
 
 import jax.numpy as jnp
 
@@ -38,28 +39,66 @@ def sync(arr) -> None:
 class TimedRun(NamedTuple):
     seconds: float  # best-of-reps net execution time
     warmup_seconds: float  # compile + first full execution + sync
+    median_seconds: float  # median-of-reps net execution time
+    spread: float  # (max - min) / median of the per-rep net times
 
 
-def timed_run(solver, state, iters: int, reps: int = 3) -> TimedRun:
-    """Best-of-``reps`` net seconds for ``solver.run(state, iters)``."""
+def _timed(full: Callable, zero: Callable, reps: int) -> TimedRun:
+    """Measure ``full()`` minus the fixed sync/dispatch overhead of
+    ``zero()`` (the same jitted program at zero work), best- and
+    median-of-``reps``."""
     reps = max(1, reps)
     t0 = time.perf_counter()
-    sync(solver.run(state, iters).u)  # compile + warm-up
+    sync(full())  # compile + warm-up
     warmup = time.perf_counter() - t0
-    sync(solver.run(state, 0).u)
+    sync(zero())
 
-    bases, bests = [], []
+    bases, raws = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
-        sync(solver.run(state, 0).u)
+        sync(zero())
         bases.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        sync(solver.run(state, iters).u)
-        bests.append(time.perf_counter() - t0)
-    best, base = min(bests), min(bases)
-    net = best - base
+        sync(full())
+        raws.append(time.perf_counter() - t0)
+    base = min(bases)
+    nets = [r - base for r in raws]
+    best, med = min(nets), statistics.median(nets)
     # If the subtraction is within the observed jitter of the overhead
     # measurement itself (tiny --quick grids), publish the raw time
     # instead of a jitter-dominated rate — conservative, never inflating.
     noise = max(bases) - base
-    return TimedRun(best if net <= noise else net, warmup)
+    if best <= noise:
+        best, med = min(raws), statistics.median(raws)
+        nets = raws
+    spread = (max(nets) - min(nets)) / med if med > 0 else 0.0
+    return TimedRun(best, warmup, med, spread)
+
+
+def timed_run(solver, state, iters: int, reps: int = 3) -> TimedRun:
+    """Best/median-of-``reps`` net seconds for ``solver.run(state, iters)``."""
+    return _timed(
+        lambda: solver.run(state, iters).u,
+        lambda: solver.run(state, 0).u,
+        reps,
+    )
+
+
+class TimedAdvance(NamedTuple):
+    timing: TimedRun
+    steps: int  # steps the while-loop actually took to reach t_end
+
+
+def timed_advance(solver, state, t_end: float, reps: int = 3) -> TimedAdvance:
+    """Best/median-of-``reps`` net seconds for
+    ``solver.advance_to(state, t_end)`` — the reference drivers' native
+    ``while (t < tEnd)`` mode. The zero-work overhead run is the same
+    jitted program asked to advance to ``state.t`` (zero loop trips)."""
+    steps = int(solver.advance_to(state, t_end).it - state.it)
+    t_start = float(state.t)
+    timing = _timed(
+        lambda: solver.advance_to(state, t_end).u,
+        lambda: solver.advance_to(state, t_start).u,
+        reps,
+    )
+    return TimedAdvance(timing, steps)

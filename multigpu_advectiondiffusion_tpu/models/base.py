@@ -223,50 +223,52 @@ class SolverBase:
         Overridden by solvers that have a fused Pallas stepper."""
         return None
 
+    def _fused_sharded_ctx(self, fused):
+        """``(refresh, offsets_fn)`` for running a fused stepper
+        shard-local inside ``shard_map``: ghosts ppermute-refreshed after
+        every RK stage, global wall masks fed this shard's offsets (the
+        reference runs its tuned kernel under MPI the same way,
+        ``MultiGPU/Diffusion3d_Baseline/main.c:189-303``). Both are
+        ``None`` when unsharded. ``offsets_fn`` must be called inside
+        ``shard_map`` (it reads ``lax.axis_index``)."""
+        if self.mesh is None or not fused.sharded:
+            return None, None
+        sizes = dict(self.mesh.shape)
+        refresh = make_ghost_refresh(
+            self.decomp, sizes, self.bcs, fused.halo, fused.interior_shape,
+            core_offsets=getattr(fused, "core_offsets", None),
+        )
+
+        def offsets_fn():
+            return jnp.stack(
+                [
+                    jnp.asarray(o, jnp.int32)
+                    for o in axis_offsets(self.decomp, fused.interior_shape)
+                ]
+            )
+
+        return refresh, offsets_fn
+
     def run(self, state: SolverState, num_iters: int) -> SolverState:
         """Fixed-count loop (the CUDA drivers' ``max_iters`` mode,
         ``MultiGPU/Diffusion3d_Baseline/main.c:189``)."""
         fused = self._fused_stepper()
         if fused is not None:
-            if self.mesh is None:
-                f = self._compiled(
-                    ("fused_run", num_iters),
-                    lambda: jax.jit(lambda u, t: fused.run(u, t, num_iters)),
-                )
-            else:
-                # The tuned fused kernel shard-local inside shard_map:
-                # ghosts ppermute-refreshed after every RK stage, global
-                # wall masks fed this shard's offsets (the reference runs
-                # its tuned kernel under MPI the same way, main.c:189-303).
-                sizes = dict(self.mesh.shape)
-                refresh = (
-                    make_ghost_refresh(
-                        self.decomp, sizes, self.bcs, fused.halo,
-                        fused.interior_shape,
-                        core_offsets=getattr(fused, "core_offsets", None),
-                    )
-                    if fused.sharded
-                    else None
-                )
+            refresh, offsets_fn = self._fused_sharded_ctx(fused)
 
-                def block(u, t):
-                    offs = None
-                    if fused.sharded:
-                        offs = jnp.stack(
-                            [
-                                jnp.asarray(o, jnp.int32)
-                                for o in axis_offsets(
-                                    self.decomp, fused.interior_shape
-                                )
-                            ]
-                        )
-                    return fused.run(
-                        u, t, num_iters, refresh=refresh, offsets=offs
-                    )
+            def block(u, t):
+                # kwargs only when sharded — the 2-D whole-run steppers
+                # are single-chip and take neither
+                kw = {}
+                if refresh is not None:
+                    kw["refresh"] = refresh
+                if offsets_fn is not None:
+                    kw["offsets"] = offsets_fn()
+                return fused.run(u, t, num_iters, **kw)
 
-                f = self._compiled(
-                    ("fused_run", num_iters), lambda: self._wrap(block)
-                )
+            f = self._compiled(
+                ("fused_run", num_iters), lambda: self._wrap(block)
+            )
             u, t = f(state.u, state.t)
             return SolverState(u=u, t=t, it=state.it + num_iters)
 
@@ -284,7 +286,26 @@ class SolverBase:
         (the corrected version of the MATLAB drivers' loop, heat3d.m:48-77).
 
         ``t_end`` is a traced operand: one compilation serves every end
-        time, so parameter sweeps do not recompile per value."""
+        time, so parameter sweeps do not recompile per value.
+
+        When the config is fused-eligible and the stepper has a
+        ``run_to`` (the 3-D fused Burgers), this mode runs at the fused
+        stepper's speed — the reference Burgers drivers' *only* execution
+        mode is ``while (t < tEnd)`` over the tuned kernels
+        (``MultiGPU/Burgers3d_Baseline/main.c:190-317``)."""
+        fused = self._fused_stepper()
+        if fused is not None and hasattr(fused, "run_to"):
+            refresh, offsets_fn = self._fused_sharded_ctx(fused)
+
+            def fblock(u, t, te):
+                offs = offsets_fn() if offsets_fn is not None else None
+                return fused.run_to(u, t, te, refresh=refresh, offsets=offs)
+
+            f = self._compiled("fused_adv", lambda: self._wrap(fblock, 2, 2))
+            u, t, steps = f(
+                state.u, state.t, jnp.asarray(t_end, state.t.dtype)
+            )
+            return SolverState(u=u, t=t, it=state.it + steps)
 
         def block(u, t, te):
             eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))

@@ -117,58 +117,65 @@ def _weno5_plus(q0, q1, q2, q3, q4, variant):
     return num / (6.0 * (a0 + a1 + a2))
 
 
-def _weno5_betas_from_e(e0, e1, e2, e3):
-    """The three smoothness indicators expressed in forward differences
-    ``e_j = q_{j+1} - q_j`` of the 5-cell window ``q0..q4``.
+_C13 = 13.0 / 12.0  # curvature coefficient of the smoothness indicators
 
-    Mathematically identical to :func:`_weno5_betas` — the curvature
-    terms are differences of adjacent ``e`` and the linear terms 2-term
-    ``e`` combinations — but cheaper: the ``e`` array is shared between
-    all three indicators and (in stencil sweeps) between neighboring
-    interfaces, replacing 5-point combinations with 2-point ones.
+
+def _curv(dd):
+    """Shared curvature array ``13/12 dd^2`` of a second-difference
+    array ``dd_j = e_{j+1} - e_j``: the three betas of one
+    reconstruction and the betas of neighboring interfaces are all
+    windows of this one array. Defined HERE, next to
+    :func:`_weno5_side_nd`, so the ``(c * dd) * dd`` association has a
+    single definition — the fused kernels' bit-identity contract with
+    the generic path depends on it."""
+    return _C13 * dd * dd
+
+
+def _weno5_side_nd(q2, e0, e1, e2, e3, cd0, cd1, cd2, variant, side):
+    """One WENO5 reconstruction in forward-difference form, returned as
+    unnormalized ``(numerator, denominator)``.
+
+    ``q2`` is the window's center cell, ``e_j = q_{j+1} - q_j``, and
+    ``cd_k`` are the betas' *curvature* terms ``13/12 (e_{k+1}-e_k)^2``
+    — windows of ONE shared second-difference array: the three betas of
+    one reconstruction and the betas of *neighboring* interfaces all
+    draw on the same array, so sweep kernels compute it once and pass
+    shifted windows instead of re-deriving ``13/12 d^2`` per beta
+    (3 multiplies + a subtract per beta, the largest shared
+    subexpression in the op mix). ``side`` is ``"minus"`` (reconstruct
+    u^- at the interface right of ``q2``) or ``"plus"`` (u^+ at the
+    interface left of ``q2``).
+
+    Returning num/den separately leaves the division strategy to the
+    caller — the fused TPU kernels spend a Newton-refined reciprocal
+    estimate on it rather than Mosaic's exact-divide chain.
     """
-    c = 13.0 / 12.0
-    d0, d1, d2 = e1 - e0, e2 - e1, e3 - e2
     l0 = 3.0 * e1 - e0
     l1 = e1 + e2  # -(q1 - q3); sign irrelevant, it is squared
     l2 = e3 - 3.0 * e2
-    return (
-        c * d0 * d0 + 0.25 * l0 * l0,
-        c * d1 * d1 + 0.25 * l1 * l1,
-        c * d2 * d2 + 0.25 * l2 * l2,
+    betas = (
+        cd0 + 0.25 * l0 * l0,
+        cd1 + 0.25 * l1 * l1,
+        cd2 + 0.25 * l2 * l2,
     )
-
-
-def _weno5_minus_e(q2, e0, e1, e2, e3, variant):
-    """:func:`_weno5_minus` in forward-difference form: ``q2`` is the
-    window's center cell and ``e_j = q_{j+1} - q_j``. The candidate
-    polynomials become ``(6 q2 + <2-term e combo>)/6``."""
-    a0, a1, a2 = _weno5_alphas_unnormalized(
-        _weno5_betas_from_e(e0, e1, e2, e3), _D5, variant
-    )
+    d = _D5 if side == "minus" else tuple(reversed(_D5))
+    a0, a1, a2 = _weno5_alphas_unnormalized(betas, d, variant)
     t6 = 6.0 * q2
-    num = (
-        a0 * (t6 + 5.0 * e1 - 2.0 * e0)
-        + a1 * (t6 + e1 + 2.0 * e2)
-        + a2 * (t6 + 4.0 * e2 - e3)
-    )
-    return num / (6.0 * (a0 + a1 + a2))
+    if side == "minus":
+        num = (
+            a0 * (t6 + 5.0 * e1 - 2.0 * e0)
+            + a1 * (t6 + e1 + 2.0 * e2)
+            + a2 * (t6 + 4.0 * e2 - e3)
+        )
+    else:
+        num = (
+            a0 * (t6 - 4.0 * e1 + e0)
+            + a1 * (t6 - 2.0 * e1 - e2)
+            + a2 * (t6 - 5.0 * e2 + 2.0 * e3)
+        )
+    return num, 6.0 * (a0 + a1 + a2)
 
 
-def _weno5_plus_e(q2, e0, e1, e2, e3, variant):
-    """:func:`_weno5_plus` in forward-difference form (same window
-    convention: ``q2`` the center cell, ``e_j = q_{j+1} - q_j``)."""
-    d = tuple(reversed(_D5))
-    a0, a1, a2 = _weno5_alphas_unnormalized(
-        _weno5_betas_from_e(e0, e1, e2, e3), d, variant
-    )
-    t6 = 6.0 * q2
-    num = (
-        a0 * (t6 - 4.0 * e1 + e0)
-        + a1 * (t6 - 2.0 * e1 - e2)
-        + a2 * (t6 - 5.0 * e2 + 2.0 * e3)
-    )
-    return num / (6.0 * (a0 + a1 + a2))
 
 
 def _weno7_betas(q):

@@ -394,6 +394,60 @@ def test_fused_burgers_ineligible_configs_fall_back():
     assert BurgersSolver(cfg)._fused_stepper() is not None
 
 
+@pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
+def test_fused_burgers_advance_to_matches_xla(adaptive):
+    """advance_to (the reference Burgers drivers' *native* `while
+    (t < tEnd)` mode, MultiGPU/Burgers3d_Baseline/main.c:190-317) must
+    engage the fused stepper's run_to and reproduce the generic path's
+    trajectory, landing time, and step count."""
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    # ~4.5 generic steps at this CFL: exercises the trimmed last step
+    t_end = 0.05
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, cfl=0.3, adaptive_dt=adaptive,
+                            nu=1e-5, dtype="float32", ic="gaussian",
+                            impl=impl)
+        solver = BurgersSolver(cfg)
+        st = solver.advance_to(solver.initial_state(), t_end)
+        if impl == "pallas":
+            assert "fused_adv" in solver._cache, "fused t_end path not taken"
+        outs[impl] = (np.asarray(st.u), float(st.t), int(st.it))
+    scale = float(np.max(np.abs(outs["xla"][0])))
+    np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
+                               rtol=2e-5, atol=2e-6 * scale)
+    np.testing.assert_allclose(outs["pallas"][1], t_end, rtol=1e-6)
+    assert outs["pallas"][2] == outs["xla"][2] > 0
+
+
+@pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
+def test_fused_burgers_advance_to_sharded_bit_identical(devices, adaptive):
+    """Fused run_to shard-local under shard_map (ppermute ghost refresh,
+    pmax dt) must reproduce the single-device fused advance_to
+    bit-for-bit, with the same step count."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(24, 16, 16, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                        adaptive_dt=adaptive, impl="pallas")
+    t_end = 0.01
+    ref_solver = BurgersSolver(cfg)
+    ref = ref_solver.advance_to(ref_solver.initial_state(), t_end)
+    assert "fused_adv" in ref_solver._cache
+    solver = BurgersSolver(
+        cfg, mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz")
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded
+    out = solver.advance_to(solver.initial_state(), t_end)
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+    assert float(out.t) == float(ref.t)
+    assert int(out.it) == int(ref.it) > 0
+
+
 def test_fused_burgers_ghost_maintenance_long_run():
     """Many fused steps: the persistent padded state's edge ghosts must
     track the evolving boundary cells (a stale-ghost bug shows up as
