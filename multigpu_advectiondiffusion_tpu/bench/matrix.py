@@ -27,6 +27,11 @@ BASELINES_MLUPS = {
     "diffusion2d": (2681.0, "SingleGPU/Diffusion2d_PitchedMem/Run.m:3-12"),
     "diffusion3d": (2782.0, "SingleGPU/Diffusion3d_Blocking/Run.m:3-12"),
     "diffusion3d_multigpu": (731.0, "MultiGPU/Diffusion3d_Baseline/Run.m:4-13"),
+    # the reference number IS f64 (USE_FLOAT false) — this row is the
+    # apples-to-apples precision comparison
+    "diffusion3d_multigpu_f64": (
+        731.0, "MultiGPU/Diffusion3d_Baseline/Run.m:4-13"
+    ),
     "burgers3d_512": (879.8, "SingleGPU/Burgers3d_WENO5/Run.m:15-25"),
     "burgers3d_512_axis": (879.8, "SingleGPU/Burgers3d_WENO5/Run.m:15-25"),
     "burgers3d_512_xla": (879.8, "SingleGPU/Burgers3d_WENO5/Run.m:15-25"),
@@ -54,6 +59,10 @@ class BenchCase:
     # kernels, "xla" the shifted-slice stencils — the ladder axis that
     # replaces the reference's pitched/texture/shared variants.
     impl: str = "pallas"
+    # per-case precision (the --dtype flag overrides it for every case);
+    # "float64" rows quantify the TPU's emulated-f64 cost against the
+    # reference's only precision (USE_FLOAT false, DiffusionMPICUDA.h:66)
+    dtype: str = "float32"
 
 
 CASES = [
@@ -61,6 +70,10 @@ CASES = [
     BenchCase("diffusion2d", "diffusion", (1024, 1024), 1000),
     BenchCase("diffusion3d", "diffusion", (208, 200, 200), 605),
     BenchCase("diffusion3d_multigpu", "diffusion", (400, 200, 208), 101),
+    # the reference's only precision, on the same literal grid: measures
+    # the emulated-f64 cost ratio on TPU (no native f64 VPU path)
+    BenchCase("diffusion3d_multigpu_f64", "diffusion", (400, 200, 208), 31,
+              dtype="float64"),
     BenchCase("burgers3d_512", "burgers", (512, 512, 512), 86, nu=1e-5),
     # explicit slower rungs of the same flagship config (the reference
     # benches its non-winning variants too, RunAll.m)
@@ -124,11 +137,12 @@ def build_solver(case: BenchCase, dtype: str, grid_xyz, mesh_spec: Optional[str]
 
 def run_case(
     case: BenchCase,
-    dtype: str = "float32",
+    dtype: Optional[str] = None,
     quick: bool = False,
     mesh_spec: Optional[str] = None,
     repeats: int = 3,
 ) -> dict:
+    dtype = dtype or case.dtype
     from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
     from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
 
@@ -182,7 +196,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser(prog="multigpu_advectiondiffusion_tpu.bench")
     ap.add_argument("--name", default=None,
                     help="run one case (default: all)")
-    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--dtype", default=None,
+                    help="override every case's precision (default: "
+                         "per-case, float32 unless the row says f64)")
     ap.add_argument("--quick", action="store_true",
                     help="shrunken grids for smoke-benching")
     ap.add_argument("--mesh", default=None, help="e.g. dz=4")
@@ -195,10 +211,16 @@ def main(argv=None):
         raise SystemExit(
             f"no case {args.name!r}; have {[c.name for c in CASES]}"
         )
+    import jax
+
     lines = []
     for case in cases:
-        res = run_case(case, dtype=args.dtype, quick=args.quick,
-                       mesh_spec=args.mesh, repeats=args.repeats)
+        # x64 scoped per case: a process-wide flip would poison the f32
+        # Pallas rows' Mosaic lowering with i64 constants
+        dtype = args.dtype or case.dtype
+        with jax.enable_x64(dtype == "float64"):
+            res = run_case(case, dtype=args.dtype, quick=args.quick,
+                           mesh_spec=args.mesh, repeats=args.repeats)
         line = json.dumps(res)
         print(line, flush=True)
         lines.append(line)

@@ -33,13 +33,19 @@ from multigpu_advectiondiffusion_tpu.core.dtypes import canonicalize
 from multigpu_advectiondiffusion_tpu.core.grid import Grid
 from multigpu_advectiondiffusion_tpu.models.state import SolverState
 from multigpu_advectiondiffusion_tpu.ops.stencils import Padder
+from multigpu_advectiondiffusion_tpu.ops.stencils import slice_axis
 from multigpu_advectiondiffusion_tpu.parallel.halo import (
     axis_offsets,
+    exchange_ghosts,
     make_ghost_fn,
     make_ghost_refresh,
     make_padder,
 )
-from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition, shard_map
+from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+    Decomposition,
+    axis_extent,
+    shard_map,
+)
 from multigpu_advectiondiffusion_tpu.timestepping.integrators import INTEGRATORS
 from multigpu_advectiondiffusion_tpu.utils.ic import initial_condition
 
@@ -224,20 +230,23 @@ class SolverBase:
         return None
 
     def _fused_sharded_ctx(self, fused):
-        """``(refresh, offsets_fn)`` for running a fused stepper
+        """``(refresh, offsets_fn, exch)`` for running a fused stepper
         shard-local inside ``shard_map``: ghosts ppermute-refreshed after
         every RK stage, global wall masks fed this shard's offsets (the
         reference runs its tuned kernel under MPI the same way,
-        ``MultiGPU/Diffusion3d_Baseline/main.c:189-303``). Both are
-        ``None`` when unsharded. ``offsets_fn`` must be called inside
-        ``shard_map`` (it reads ``lax.axis_index``)."""
+        ``MultiGPU/Diffusion3d_Baseline/main.c:189-303``). All ``None``
+        when unsharded. ``offsets_fn``/``exch`` must be called inside
+        ``shard_map`` (they read ``lax.axis_index``/``ppermute``).
+
+        When the stepper runs the split-overlap schedule
+        (``fused.overlap_split``), ``exch`` replaces ``refresh``: it
+        returns the ``(lo, hi)`` exchanged z-slabs of the padded
+        buffer's core, which the stage's edge calls consume as separate
+        operands — so XLA schedules the interior call concurrently with
+        the ppermute instead of serializing on a buffer rewrite."""
         if self.mesh is None or not fused.sharded:
-            return None, None
+            return None, None, None
         sizes = dict(self.mesh.shape)
-        refresh = make_ghost_refresh(
-            self.decomp, sizes, self.bcs, fused.halo, fused.interior_shape,
-            core_offsets=getattr(fused, "core_offsets", None),
-        )
 
         def offsets_fn():
             return jnp.stack(
@@ -247,21 +256,41 @@ class SolverBase:
                 ]
             )
 
-        return refresh, offsets_fn
+        if getattr(fused, "overlap_split", False):
+            name = self.decomp.mesh_axis(0)
+            nsh = axis_extent(sizes, name)
+            off = fused.core_offsets[0]
+            lz = fused.interior_shape[0]
+
+            def exch(P):
+                core = slice_axis(P, 0, off, off + lz)
+                return exchange_ghosts(
+                    core, 0, fused.halo, name, nsh, self.bcs[0]
+                )
+
+            return None, offsets_fn, exch
+
+        refresh = make_ghost_refresh(
+            self.decomp, sizes, self.bcs, fused.halo, fused.interior_shape,
+            core_offsets=getattr(fused, "core_offsets", None),
+        )
+        return refresh, offsets_fn, None
 
     def run(self, state: SolverState, num_iters: int) -> SolverState:
         """Fixed-count loop (the CUDA drivers' ``max_iters`` mode,
         ``MultiGPU/Diffusion3d_Baseline/main.c:189``)."""
         fused = self._fused_stepper()
         if fused is not None:
-            refresh, offsets_fn = self._fused_sharded_ctx(fused)
+            refresh, offsets_fn, exch = self._fused_sharded_ctx(fused)
 
             def block(u, t):
                 # kwargs only when sharded — the 2-D whole-run steppers
-                # are single-chip and take neither
+                # are single-chip and take none of these
                 kw = {}
                 if refresh is not None:
                     kw["refresh"] = refresh
+                if exch is not None:
+                    kw["exch"] = exch
                 if offsets_fn is not None:
                     kw["offsets"] = offsets_fn()
                 return fused.run(u, t, num_iters, **kw)
@@ -295,11 +324,12 @@ class SolverBase:
         (``MultiGPU/Burgers3d_Baseline/main.c:190-317``)."""
         fused = self._fused_stepper()
         if fused is not None and hasattr(fused, "run_to"):
-            refresh, offsets_fn = self._fused_sharded_ctx(fused)
+            refresh, offsets_fn, exch = self._fused_sharded_ctx(fused)
 
             def fblock(u, t, te):
                 offs = offsets_fn() if offsets_fn is not None else None
-                return fused.run_to(u, t, te, refresh=refresh, offsets=offs)
+                return fused.run_to(u, t, te, refresh=refresh, offsets=offs,
+                                    exch=exch)
 
             f = self._compiled("fused_adv", lambda: self._wrap(fblock, 2, 2))
             u, t, steps = f(

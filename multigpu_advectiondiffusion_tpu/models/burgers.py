@@ -131,8 +131,10 @@ class BurgersSolver(SolverBase):
         scalar (global ``max|f'(u)|`` reduction between steps), and under
         a mesh the kernel runs shard-local with ppermute ghost refresh
         between stages (the tuned kernel under MPI,
-        ``MultiGPU/Burgers3d_Baseline/main.c:189-317``). The 2-D
-        whole-run VMEM stepper stays single-chip, fixed-dt."""
+        ``MultiGPU/Burgers3d_Baseline/main.c:189-317``; x must be
+        unsharded — the lane-aligned layout stores no x ghosts). The
+        2-D whole-run VMEM stepper stays single-chip but serves both dt
+        modes (adaptive via an in-core reduction per step)."""
         import jax.numpy as jnp
 
         from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
@@ -148,7 +150,7 @@ class BurgersSolver(SolverBase):
             and self.dtype == jnp.float32
             and all(b.kind == "edge" for b in self.bcs)
         )
-        if self.grid.ndim != 3 and (self.mesh is not None or cfg.adaptive_dt):
+        if self.grid.ndim != 3 and self.mesh is not None:
             eligible = False
         if not eligible:
             return None
@@ -168,6 +170,11 @@ class BurgersSolver(SolverBase):
                 lshape[ax] < R for ax, _ in self.decomp.axes
             ):
                 return None
+            # the lane-aligned x layout stores no x ghosts, so an
+            # x-sharded mesh has nothing for the ppermute refresh to
+            # rewrite — such configs run the generic path
+            if self.mesh is not None and 2 in dict(self.decomp.axes):
+                return None
             # y-rounding is incompatible only with a y-sharded axis
             # (dead columns would be exchanged as neighbor ghosts)
             y_sharded = self.mesh is not None and 1 in dict(self.decomp.axes)
@@ -186,6 +193,17 @@ class BurgersSolver(SolverBase):
                 if self.mesh is not None:
                     kwargs["global_shape"] = self.grid.shape
                     kwargs["y_sharded"] = y_sharded
+                    # overlap="split" + pure z-slab decomposition: the
+                    # three-call overlapped schedule (interior blocks
+                    # concurrent with the z-halo ppermute)
+                    sizes = dict(self.mesh.shape)
+                    sharded_axes = [
+                        ax for ax, name in self.decomp.axes
+                        if sizes.get(name, 1) > 1
+                    ]
+                    kwargs["overlap_split"] = (
+                        cfg.overlap == "split" and sharded_axes == [0]
+                    )
                 if cfg.adaptive_dt:
                     reduce = self.mesh_reduce_max()
                     kwargs["dt_fn"] = lambda u: advective_dt(
@@ -198,8 +216,17 @@ class BurgersSolver(SolverBase):
                     cfg.weno_variant, cfg.nu, **kwargs,
                 )
             else:
+                if cfg.adaptive_dt:
+                    # in-core reduction on the padded state: ghost/slack
+                    # cells are edge replicas, so the full-array max
+                    # equals the interior max (whole_run_adaptive)
+                    kwargs["dt_fn"] = lambda u: advective_dt(
+                        u, self.flux.df, spacing, cfg.cfl
+                    )
+                else:
+                    kwargs["dt"] = cfg.cfl * min(spacing)
                 self._cache["fused"] = cls(
                     lshape, self.dtype, spacing, self.flux,
-                    cfg.weno_variant, cfg.nu, cfg.cfl * min(spacing),
+                    cfg.weno_variant, cfg.nu, **kwargs,
                 )
         return self._cache["fused"]

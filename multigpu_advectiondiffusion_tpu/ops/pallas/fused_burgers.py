@@ -32,18 +32,29 @@ so the design minimizes *arithmetic*, not just traffic:
 Layout and ghost discipline:
 
 * The state lives in a *padded, tile-aligned* layout for the whole run:
-  ``(nz+6, 8+ny+8, round128(nx+6))`` — z carries exactly the 3-row halo
+  ``(nz+6, 8+ny+8, round128(nx))`` — z carries exactly the 3-row halo
   (the leading axis is untiled, any slice is legal), y carries an
   8-column margin on each side (ghosts in its inner 3 columns) because
   Mosaic requires sublane-axis DMA offsets to be 8-aligned, and x is
-  lane-padded. All non-interior cells hold edge-replicated values (the
-  reference's non-periodic ghost rule, ``WENO5resAdv_X.m:53``).
+  **lane-aligned at 0 with NO stored ghosts**: x ghost columns are
+  synthesized in VMEM at block-load time (edge replicas,
+  ``WENO5resAdv_X.m:53``) into the buffer's slack lanes — or into a
+  128-lane working tail when the interior fills its lane tiles — so
+  every non-x operation and every HBM transfer runs at
+  ``round128(nx)`` lanes instead of ``round128(nx+6)`` (at 512^3 that
+  one tile is 20% of all traffic and VPU work). The x sweep's circular
+  rolls read the ghosts at the wrap positions (last ``R`` lanes of the
+  working width = left ghosts), exactly like the old inline layout.
+  Consequence: the x axis must not be sharded for this stepper (there
+  are no stored x ghosts for a ppermute refresh to rewrite; such
+  configs use the generic path).
 * Block (kz, ky) reads box ``[kz*bz, kz*bz+bz+6) x [ky*by, ky*by+by+16)``
   (both starts/extents 8-aligned in y) and writes only its disjoint core
   box; edge blocks additionally write the adjacent ghost boxes with
   edge-replicated values. Disjoint writes keep the 2-slot DMA pipeline
   race-free. The (z-ghost x y-margin) corner boxes are never rewritten
-  after the initial embed; no core output ever reads them.
+  after the initial embed; no core output ever reads them. Lanes beyond
+  ``nx`` hold garbage between stages (patched on every load).
 * dt enters as a runtime SMEM scalar, so the same compiled stages serve
   fixed *and* adaptive dt — the adaptive mode computes the global
   ``max|f'(u)|`` reduction (``lax.pmax`` across a mesh) between steps,
@@ -82,7 +93,11 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     interpret_mode,
     round_up,
 )
-from multigpu_advectiondiffusion_tpu.ops.weno import _curv, _weno5_side_nd
+from multigpu_advectiondiffusion_tpu.ops.weno import (
+    _curv,
+    _weno5_side_nd,
+    _weno5_side_nd_e,
+)
 
 R = 3  # WENO5 stencil radius == persistent ghost width
 MARGIN = 8  # y-side margin: >= R, multiple of the (8) sublane tile
@@ -107,16 +122,27 @@ def _recip(x):
 _VMEM_BUDGET = 72 * 1024 * 1024
 
 
-def _live_bytes(bz: int, by: int, x_pad: int, itemsize: int) -> int:
-    col = x_pad * itemsize
-    slab = (bz + 2 * R) * (by + 2 * MARGIN) * col  # one (z,y) box
-    core = bz * by * col
-    # v double-buffered (2) + vp + vm (2 slabs) + u/res double-buffered
-    # (4 cores) + ~14 live core-sized sweep intermediates
-    return 4 * slab + 18 * core
+def _x_widths(lx: int):
+    """``(px, W)``: stored lane width (interior only, lane-aligned at 0)
+    and the x-sweep working width. The working buffer needs the ``R``
+    right-ghost lanes after ``lx`` and ``R`` left-ghost lanes at its very
+    end (read via circular wrap), disjoint — when the stored slack can't
+    hold both, the sweep works on a 128-lane-extended value instead."""
+    px = round_up(lx, LANE)
+    return px, (px if px - lx >= 2 * R else px + LANE)
 
 
-def _pick_blocks(nz, ny, x_pad, itemsize):
+def _live_bytes(bz: int, by: int, lx: int, itemsize: int) -> int:
+    px, w = _x_widths(lx)
+    core = bz * by * px * itemsize
+    slab = (bz + 2 * R) * (by + 2 * MARGIN) * w * itemsize  # one box @W
+    # v double-buffered (2 slabs @W) + ghost-patched w + vp + vm (3
+    # slabs @W) + u/res double-buffered (4 cores) + ~14 live core-sized
+    # sweep intermediates
+    return 5 * slab + 18 * core
+
+
+def _pick_blocks(nz, ny, lx, itemsize):
     """First viable block in measured-preference order.
 
     v5e, 512^3: (8,64) 6045 MLUPS > (4,64) 5903 > (8,128) 5580 >
@@ -129,14 +155,19 @@ def _pick_blocks(nz, ny, x_pad, itemsize):
         for bz in (8, 7, 6, 5, 4, 3, 2, 1):
             if nz % bz:
                 continue
-            if _live_bytes(bz, by, x_pad, itemsize) <= _VMEM_BUDGET:
+            if _live_bytes(bz, by, lx, itemsize) <= _VMEM_BUDGET:
                 return (bz, by)
     return None
 
 
 def _split(flux: Flux, v):
     """Local Lax–Friedrichs splitting ``f± = (f(v) ± |f'(v)| v)/2``
-    (``WENO5resAdv_X.m:58-60``)."""
+    (``WENO5resAdv_X.m:58-60``). For the Burgers flux the identity
+    ``f± = t (t ± |v|)`` with ``t = v/2`` saves two full-box ops."""
+    if flux.name == "burgers":
+        t = 0.5 * v
+        a = jnp.abs(v)
+        return t * (t + a), t * (t - a)
     a = jnp.abs(flux.df(v))
     fu = flux.f(v)
     return 0.5 * (fu + a * v), 0.5 * (fu - a * v)
@@ -159,18 +190,18 @@ def _div_z(vp, vm, bz, by, inv_dx, variant):
     cp = _curv(ep[1:] - ep[:-1])
     cm = _curv(em[1:] - em[:-1])
     nm, dm = _weno5_side_nd(
-        p[2 : 3 + bz],
         *(ep[j : j + bz + 1] for j in range(4)),
         *(cp[j : j + bz + 1] for j in range(3)),
         variant, "minus",
     )
     np_, dp = _weno5_side_nd(
-        m[3 : 4 + bz],
         *(em[j + 1 : j + 2 + bz] for j in range(4)),
         *(cm[j + 1 : j + 2 + bz] for j in range(3)),
         variant, "plus",
     )
-    h = nm * _recip(dm) + np_ * _recip(dp)
+    h = (p[2 : 3 + bz] + m[3 : 4 + bz]) + (
+        nm * _recip(dm) + np_ * _recip(dp)
+    )
     return (h[1:] - h[:-1]) * inv_dx
 
 
@@ -185,22 +216,21 @@ def _div_y(vp, vm, bz, by, inv_dx, variant):
     m = vm[R : R + bz]
     ep = p[:, 1:] - p[:, :-1]
     em = m[:, 1:] - m[:, :-1]
-    cp = _curv(ep[:, 1:] - ep[:, :-1])
-    cm = _curv(em[:, 1:] - em[:, :-1])
     n = by + 1
-    nm, dm = _weno5_side_nd(
-        p[:, MARGIN - 1 : MARGIN + by],
+    # curvature per-window (_weno5_side_nd_e): a shared cd array would
+    # cost 3 extra sublane realignments per side — the binding resource
+    # — while recomputing from the already-realigned windows is ALU-only
+    nm, dm = _weno5_side_nd_e(
         *(ep[:, MARGIN - 3 + j : MARGIN - 3 + j + n] for j in range(4)),
-        *(cp[:, MARGIN - 3 + j : MARGIN - 3 + j + n] for j in range(3)),
         variant, "minus",
     )
-    np_, dp = _weno5_side_nd(
-        m[:, MARGIN : MARGIN + by + 1],
+    np_, dp = _weno5_side_nd_e(
         *(em[:, MARGIN - 2 + j : MARGIN - 2 + j + n] for j in range(4)),
-        *(cm[:, MARGIN - 2 + j : MARGIN - 2 + j + n] for j in range(3)),
         variant, "plus",
     )
-    h = nm * _recip(dm) + np_ * _recip(dp)
+    h = (p[:, MARGIN - 1 : MARGIN + by] + m[:, MARGIN : MARGIN + by + 1]) + (
+        nm * _recip(dm) + np_ * _recip(dp)
+    )
     return (h[:, 1:] - h[:, :-1]) * inv_dx
 
 
@@ -211,21 +241,18 @@ def _div_roll(vp, vm, axis, inv_dx, variant):
     axes of the 2-D whole-run stepper (:mod:`fused_burgers2d`)."""
     ep = _shift(vp, 1, axis) - vp
     em = _shift(vm, 1, axis) - vm
-    cp = _curv(_shift(ep, 1, axis) - ep)
-    cm = _curv(_shift(em, 1, axis) - em)
-    nm, dm = _weno5_side_nd(
-        vp,
+    # curvature per-window (_weno5_side_nd_e): a shared cd array would
+    # cost 4 extra rolls — the binding resource — while recomputing from
+    # the already-rolled windows is ALU-only
+    nm, dm = _weno5_side_nd_e(
         *(_shift(ep, j - 2, axis) for j in range(4)),
-        *(_shift(cp, j - 2, axis) for j in range(3)),
         variant, "minus",
     )
-    np_, dp = _weno5_side_nd(
-        _shift(vm, 1, axis),
+    np_, dp = _weno5_side_nd_e(
         *(_shift(em, j - 1, axis) for j in range(4)),
-        *(_shift(cm, j - 1, axis) for j in range(3)),
         variant, "plus",
     )
-    h = nm * _recip(dm) + np_ * _recip(dp)
+    h = (vp + _shift(vm, 1, axis)) + (nm * _recip(dm) + np_ * _recip(dp))
     return (h - _shift(h, -1, axis)) * inv_dx
 
 
@@ -234,8 +261,12 @@ def _div_x(vp, vm, inv_dx, variant):
     return _div_roll(vp, vm, 2, inv_dx, variant)
 
 
-def _laplacian(v, vc, bz, by, scales):
-    """O4 Laplacian of the core box (radius 2 < R, fits the same halo)."""
+def _laplacian(v, vc_w, bz, by, px, scales):
+    """O4 Laplacian of the core box (radius 2 < R, fits the same halo).
+
+    ``v`` is the px-wide box (z/y terms need no x ghosts); ``vc_w`` the
+    W-wide core whose circular x shifts read the synthesized ghost lanes
+    at the wrap positions, sliced back to ``px``."""
     yc = slice(MARGIN, MARGIN + by)
     acc = None
     for axis in range(3):
@@ -246,7 +277,7 @@ def _laplacian(v, vc, bz, by, scales):
             elif axis == 1:
                 term = v[R : R + bz, MARGIN - 2 + j : MARGIN - 2 + j + by] * coef
             else:
-                term = _shift(vc, j - 2, 2) * coef
+                term = _shift(vc_w, j - 2, 2)[:, :, :px] * coef
             acc = term if acc is None else acc + term
     return acc
 
@@ -255,6 +286,7 @@ def _stage_kernel(
     dt_ref,
     v_hbm,
     u_hbm,
+    g_hbm,
     out_hbm,
     vs,
     us,
@@ -265,6 +297,7 @@ def _stage_kernel(
     sem_u,
     sem_w,
     sem_g,
+    sem_gv,
     *,
     bz: int,
     by: int,
@@ -278,6 +311,10 @@ def _stage_kernel(
     variant: str,
     a: float,
     b: float,
+    kz_base: int = 0,
+    n_bz_grid: int | None = None,
+    ghost_src: str | None = None,
+    z_edge_writes: bool = True,
 ):
     """One (z, y) block of one RK stage, 2-slot double-buffered.
 
@@ -287,29 +324,77 @@ def _stage_kernel(
     disjoint (and disjoint from the edge-ghost boxes), so in-flight
     writes never alias prefetched reads; the in-place final stage reads
     its ``u`` box strictly before the overwriting DMA of the same block.
+
+    Roles (the overlapped z-slab schedule splits one stage into three
+    calls so XLA can run interior compute concurrently with the halo
+    ppermute): ``kz_base`` offsets this call's z-blocks inside the slab,
+    ``n_bz_grid`` is this call's z-grid extent (default: all blocks),
+    ``ghost_src`` = ``"lo"``/``"hi"`` DMAs the R z-ghost rows of the box
+    from the separate exchanged-slab operand ``g_hbm`` instead of the
+    padded buffer (whose z-ghost rows are stale in split mode), and
+    ``z_edge_writes=False`` skips the z edge-replica maintenance (split
+    mode never reads buffer z-ghosts).
     """
     lz, ly, lx = local_shape
-    kz = pl.program_id(0)
+    px, w = _x_widths(lx)
+    if n_bz_grid is None:
+        n_bz_grid = n_bz
+    kz = pl.program_id(0) + kz_base  # absolute z-block index
     ky = pl.program_id(1)
-    k = kz * n_by + ky
+    k = pl.program_id(0) * n_by + ky  # this call's linear block index
+    n_blocks = n_bz_grid * n_by
     slot = lax.rem(k, jnp.asarray(2, k.dtype))
     nslot = lax.rem(k + 1, jnp.asarray(2, k.dtype))
 
     def boxes(j):
         nb = jnp.asarray(n_by, jnp.int32)
         j = jnp.asarray(j, jnp.int32)
-        return lax.div(j, nb) * bz, lax.rem(j, nb) * by
+        return (kz_base + lax.div(j, nb)) * bz, lax.rem(j, nb) * by
+
+    def _xsl(dst):
+        # the VMEM slot carries a working tail beyond the stored px
+        # lanes when the interior fills its tiles (ghost synthesis
+        # space) — DMAs fill only the stored lanes
+        return dst if w == px else dst.at[:, :, pl.ds(0, px)]
 
     def copy_v(j, s):
         z0, y0 = boxes(j)
-        return pltpu.make_async_copy(
-            v_hbm.at[
-                pl.ds(z0, bz + 2 * R),
-                pl.ds(pl.multiple_of(y0, SUBLANE), by + 2 * MARGIN),
-            ],
-            vs.at[s],
-            sem_v.at[s],
-        )
+        ysl = pl.ds(pl.multiple_of(y0, SUBLANE), by + 2 * MARGIN)
+        if ghost_src is None:
+            return [
+                pltpu.make_async_copy(
+                    v_hbm.at[pl.ds(z0, bz + 2 * R), ysl],
+                    _xsl(vs.at[s]),
+                    sem_v.at[s],
+                )
+            ]
+        if ghost_src == "lo":
+            # bottom shard edge: z-ghost rows from the exchanged slab
+            return [
+                pltpu.make_async_copy(
+                    g_hbm.at[:, ysl],
+                    _xsl(vs.at[s, pl.ds(0, R)]),
+                    sem_gv.at[s],
+                ),
+                pltpu.make_async_copy(
+                    v_hbm.at[pl.ds(R, bz + R), ysl],
+                    _xsl(vs.at[s, pl.ds(R, bz + R)]),
+                    sem_v.at[s],
+                ),
+            ]
+        # top shard edge
+        return [
+            pltpu.make_async_copy(
+                v_hbm.at[pl.ds(z0, bz + R), ysl],
+                _xsl(vs.at[s, pl.ds(0, bz + R)]),
+                sem_v.at[s],
+            ),
+            pltpu.make_async_copy(
+                g_hbm.at[:, ysl],
+                _xsl(vs.at[s, pl.ds(bz + R, R)]),
+                sem_gv.at[s],
+            ),
+        ]
 
     def copy_u(j, s):
         z0, y0 = boxes(j)
@@ -336,53 +421,62 @@ def _stage_kernel(
 
     @pl.when(k == 0)
     def _():
-        copy_v(0, 0).start()
+        for cp in copy_v(0, 0):
+            cp.start()
         if us is not None:
             copy_u(0, 0).start()
 
-    @pl.when(k + 1 < n_bz * n_by)
+    @pl.when(k + 1 < n_blocks)
     def _():
-        copy_v(k + 1, nslot).start()
+        for cp in copy_v(k + 1, nslot):
+            cp.start()
         if us is not None:
             copy_u(k + 1, nslot).start()
 
     if us is not None:
         copy_u(k, slot).wait()
-    copy_v(k, slot).wait()
+    for cp in copy_v(k, slot):
+        cp.wait()
 
+    # x ghost synthesis on the freshly-loaded box: the stored layout
+    # carries no x ghosts, so patch the slack/tail lanes with edge
+    # replicas (WENO5resAdv_X.m:53) — right ghosts right after the
+    # interior at lanes lx..lx+R-1, left ghosts at the wrap positions
+    # W-R..W-1 the circular x sweep reads. Replaces the old layout's
+    # per-stage x edge rewrite on the store side; x is never sharded
+    # here, so local replication is correct in every world.
     v = vs[slot]
-    vc = v[R : R + bz, MARGIN : MARGIN + by]
+    gxw = lax.broadcasted_iota(jnp.int32, v.shape, 2)
+    v = jnp.where(gxw >= lx, v[:, :, lx - 1 : lx], v)
+    v = jnp.where(gxw >= w - R, v[:, :, 0:1], v)
+
+    vc = v[R : R + bz, MARGIN : MARGIN + by, :px]
     dtype = v.dtype
     dt = dt_ref[0].astype(dtype)
 
     # Split fluxes once over the whole box; each sweep slices what it
-    # needs (z: rows, y: columns, x: lane shifts of the core).
+    # needs (z: rows, y: columns, x: lane shifts of the W-wide core —
+    # only the x sweep sees the ghost tail, everything else runs at the
+    # stored px lanes).
     vp, vm = _split(flux, v)
     rhs = -(
-        _div_z(vp, vm, bz, by, inv_dx[0], variant)
-        + _div_y(vp, vm, bz, by, inv_dx[1], variant)
+        _div_z(vp[:, :, :px], vm[:, :, :px], bz, by, inv_dx[0], variant)
+        + _div_y(vp[:, :, :px], vm[:, :, :px], bz, by, inv_dx[1], variant)
         + _div_x(
             vp[R : R + bz, MARGIN : MARGIN + by],
             vm[R : R + bz, MARGIN : MARGIN + by],
             inv_dx[2],
             variant,
-        )
+        )[:, :, :px]
     )
     if nu_scales is not None:
-        rhs = rhs + _laplacian(v, vc, bz, by, nu_scales)
+        rhs = rhs + _laplacian(
+            v[:, :, :px], v[R : R + bz, MARGIN : MARGIN + by], bz, by, px,
+            nu_scales,
+        )
 
     rk = b * (vc + dt * rhs) if a == 0.0 else a * us[slot] + b * (vc + dt * rhs)
     rk = rk.astype(dtype)
-
-    # x edge synthesis on every block (all blocks span the full lane
-    # width): replicate the local edge interior column into ghost and
-    # slack lanes (WENO5resAdv_X.m:53). At global edges the local edge
-    # IS the global edge; at internal shard edges the between-stage
-    # ghost refresh overwrites these lanes, so the fill value there is
-    # irrelevant — local replication is correct in every world.
-    gx = lax.broadcasted_iota(jnp.int32, rk.shape, 2) - R
-    rk = jnp.where(gx < 0, rk[:, :, R : R + 1], rk)
-    rk = jnp.where(gx >= lx, rk[:, :, R + lx - 1 : R + lx], rk)
 
     if ly_eff != ly:
         # y-rounding margin: core columns >= ly are dead — refill them
@@ -430,56 +524,76 @@ def _stage_kernel(
         cp.wait()
 
     # z ghost rows: replicate the new boundary interior row (edge BC).
-    @pl.when(kz == 0)
-    def _():
-        gzres[:] = jnp.broadcast_to(res[slot][0:1], gzres.shape)
-        cp = pltpu.make_async_copy(
-            gzres,
-            out_hbm.at[
-                pl.ds(0, R),
-                pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
-            ],
-            sem_g,
-        )
-        cp.start()
-        cp.wait()
+    # Skipped in the split-overlap schedule, which never reads buffer
+    # z-ghosts (they ride the exchanged-slab operands instead).
+    if z_edge_writes:
+        @pl.when(kz == 0)
+        def _():
+            gzres[:] = jnp.broadcast_to(res[slot][0:1], gzres.shape)
+            cp = pltpu.make_async_copy(
+                gzres,
+                out_hbm.at[
+                    pl.ds(0, R),
+                    pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
+                ],
+                sem_g,
+            )
+            cp.start()
+            cp.wait()
 
-    @pl.when(kz == n_bz - 1)
-    def _():
-        gzres[:] = jnp.broadcast_to(res[slot][bz - 1 : bz], gzres.shape)
-        cp = pltpu.make_async_copy(
-            gzres,
-            out_hbm.at[
-                pl.ds(R + lz, R),
-                pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
-            ],
-            sem_g,
-        )
-        cp.start()
-        cp.wait()
+        @pl.when(kz == n_bz - 1)
+        def _():
+            gzres[:] = jnp.broadcast_to(res[slot][bz - 1 : bz], gzres.shape)
+            cp = pltpu.make_async_copy(
+                gzres,
+                out_hbm.at[
+                    pl.ds(R + lz, R),
+                    pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
+                ],
+                sem_g,
+            )
+            cp.start()
+            cp.wait()
 
-    @pl.when(k == n_bz * n_by - 1)
+    @pl.when(k == n_blocks - 1)
     def _():
         copy_w(k, slot).wait()
-        if n_bz * n_by >= 2:
+        if n_blocks >= 2:
             copy_w(k - 1, nslot).wait()
 
 
 def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
-                nu_scales, flux, variant, a, b, u_source):
+                nu_scales, flux, variant, a, b, u_source, role=None):
     """One fused RK-stage call; output aliased onto the last operand.
 
     ``u_source``: ``"none"`` / ``"operand"`` / ``"target"`` (in-place
     final stage), as in ``fused_diffusion._make_stage``. Operands:
-    ``dt (SMEM (1,))`` + arrays. The same stage serves sharded mode
-    unchanged — edge synthesis is local replication, and the caller's
-    between-stage refresh fixes non-global shard edges.
+    ``dt (SMEM (1,))`` [+ ``u``] [+ exchanged ghost slab for
+    ``bottom``/``top`` roles] + target. The default ``"full"`` role
+    serves sharded mode with the serialized between-stage refresh;
+    ``"interior"``/``"bottom"``/``"top"`` are the three calls of the
+    overlapped z-slab schedule (see :func:`_stage_kernel`).
     """
     lz = local_shape[0]
     ly_eff = padded_shape[1] - 2 * MARGIN  # ly rounded up to by multiple
     trailing = padded_shape[2:]
+    px, w = _x_widths(local_shape[2])
+    assert trailing == (px,), (trailing, px)
     use_u = u_source != "none"
     n_bz, n_by = lz // bz, ly_eff // by
+
+    role = role or "full"
+    if role == "full":
+        kz_base, n_bz_grid, ghost_src, z_edge = 0, n_bz, None, True
+    elif role == "interior":
+        kz_base, n_bz_grid, ghost_src, z_edge = 1, n_bz - 2, None, False
+    elif role == "bottom":
+        kz_base, n_bz_grid, ghost_src, z_edge = 0, 1, "lo", False
+    elif role == "top":
+        kz_base, n_bz_grid, ghost_src, z_edge = n_bz - 1, 1, "hi", False
+    else:
+        raise ValueError(f"unknown stage role {role!r}")
+    use_g = ghost_src is not None
 
     kern = functools.partial(
         _stage_kernel,
@@ -495,27 +609,42 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
         variant=variant,
         a=a,
         b=b,
+        kz_base=kz_base,
+        n_bz_grid=n_bz_grid,
+        ghost_src=ghost_src,
+        z_edge_writes=z_edge,
     )
 
     def kernel(*refs):
         dt_ref, *refs = refs
+        g_hbm, sem_gv = None, None
         if u_source == "operand":
-            (v_hbm, u_hbm, _tgt, out_hbm, vs, us, res, gyres, gzres,
-             sem_v, sem_u, sem_w, sem_g) = refs
-        elif u_source == "target":
-            (v_hbm, _tgt, out_hbm, vs, us, res, gyres, gzres,
-             sem_v, sem_u, sem_w, sem_g) = refs
-            u_hbm = None  # read from out_hbm (in place)
+            v_hbm, u_hbm, *refs = refs
         else:
-            (v_hbm, _tgt, out_hbm, vs, res, gyres, gzres,
-             sem_v, sem_w, sem_g) = refs
-            u_hbm, us, sem_u = None, None, None
-        kern(dt_ref, v_hbm, u_hbm, out_hbm, vs, us, res,
-             gyres, gzres, sem_v, sem_u, sem_w, sem_g)
+            v_hbm, *refs = refs
+            u_hbm = None  # "target": read from out_hbm (in place)
+        if use_g:
+            g_hbm, *refs = refs
+        _tgt, out_hbm, vs, *refs = refs
+        if use_u:
+            us, *refs = refs
+        else:
+            us = None
+        res, gyres, gzres, sem_v, *refs = refs
+        if use_u:
+            sem_u, *refs = refs
+        else:
+            sem_u = None
+        sem_w, sem_g, *refs = refs
+        if use_g:
+            (sem_gv,) = refs
+        kern(dt_ref, v_hbm, u_hbm, g_hbm, out_hbm, vs, us, res,
+             gyres, gzres, sem_v, sem_u, sem_w, sem_g, sem_gv)
 
-    n_in = (3 if u_source == "operand" else 2) + 1
+    n_in = 1 + (2 if u_source == "operand" else 1) + (1 if use_g else 0) + 1
     yb = by + 2 * MARGIN
-    scratch = [pltpu.VMEM((2, bz + 2 * R, yb) + trailing, dtype)]
+    # the v slot is W-wide (ghost-synthesis tail); cores/ghost boxes px
+    scratch = [pltpu.VMEM((2, bz + 2 * R, yb, w), dtype)]
     if use_u:
         scratch.append(pltpu.VMEM((2, bz, by) + trailing, dtype))
     scratch.append(pltpu.VMEM((2, bz, by) + trailing, dtype))
@@ -526,13 +655,15 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
         scratch.append(pltpu.SemaphoreType.DMA((2,)))
     scratch.append(pltpu.SemaphoreType.DMA((2,)))
     scratch.append(pltpu.SemaphoreType.DMA)
+    if use_g:
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
 
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
     in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 1)
 
     return pl.pallas_call(
         kernel,
-        grid=(n_bz, n_by),
+        grid=(n_bz_grid, n_by),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
@@ -554,12 +685,14 @@ class FusedBurgersStepper:
     """
 
     halo = R
-    core_offsets = (R, MARGIN, R)  # interior origin in the padded layout
+    # interior origin in the padded layout; x is lane-aligned at 0 (no
+    # stored x ghosts — x must not be sharded for this stepper)
+    core_offsets = (R, MARGIN, 0)
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
                  dt_fn=None, block=None, global_shape=None,
-                 y_sharded: bool = False):
+                 y_sharded: bool = False, overlap_split: bool = False):
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
         lz, ly, lx = interior_shape
@@ -578,11 +711,11 @@ class FusedBurgersStepper:
         self.padded_shape = (
             lz + 2 * R,
             ly_eff + 2 * MARGIN,
-            round_up(lx + 2 * R, LANE),
+            _x_widths(lx)[0],
         )
         self.dtype = jnp.dtype(dtype)
         blk = block if block is not None else _pick_blocks(
-            lz, ly_eff, self.padded_shape[2], self.dtype.itemsize
+            lz, ly_eff, lx, self.dtype.itemsize
         )
         if blk is None or lz % blk[0] or ly_eff % blk[1] or blk[1] % 8:
             raise ValueError(
@@ -596,24 +729,64 @@ class FusedBurgersStepper:
                 float(nu) / (12.0 * spacing[i] * spacing[i]) for i in range(3)
             ]
         sources = ("none", "operand", "target")
-        s1, s2, s3 = (
-            _make_stage(
-                self.padded_shape, self.interior_shape, self.dtype,
-                bz=bz, by=by, inv_dx=inv_dx, nu_scales=nu_scales,
-                flux=flux, variant=variant, a=a, b=b, u_source=src,
-            )
-            for (a, b), src in zip(_STAGES, sources)
+        # The split-overlap z-slab schedule needs a strict interior band
+        # (n_bz >= 3) AND bz >= R: with a thinner block, the first
+        # interior-role block's box (padded rows [bz, ...)) would reach
+        # into the z-ghost rows [0, R) that split mode never refreshes.
+        # Otherwise fall back to the serialized refresh.
+        self.overlap_split = bool(
+            overlap_split and self.sharded and lz // bz >= 3 and bz >= R
         )
+
+        def mk(role):
+            return tuple(
+                _make_stage(
+                    self.padded_shape, self.interior_shape, self.dtype,
+                    bz=bz, by=by, inv_dx=inv_dx, nu_scales=nu_scales,
+                    flux=flux, variant=variant, a=a, b=b, u_source=src,
+                    role=role,
+                )
+                for (a, b), src in zip(_STAGES, sources)
+            )
+
         self.dt = None if dt is None else float(dt)
         self._dt_fn = dt_fn
         self.block = (bz, by)
 
-        def step(S, T1, T2, dt_arr, refresh=None):
-            fix = refresh if refresh is not None else (lambda P: P)
-            T1 = fix(s1(dt_arr, S, T1))
-            T2 = fix(s2(dt_arr, T1, S, T2))
-            S = fix(s3(dt_arr, T2, S))
-            return S, T1, T2
+        if self.overlap_split:
+            (s1i, s2i, s3i) = mk("interior")
+            (s1b, s2b, s3b) = mk("bottom")
+            (s1t, s2t, s3t) = mk("top")
+
+            def step(S, T1, T2, dt_arr, refresh=None, exch=None):
+                # Each stage: start the z-halo ppermute of its input,
+                # run the ghost-independent interior blocks concurrently
+                # (XLA schedules them between collective-permute-start/
+                # -done — only the two edge calls consume the exchanged
+                # slabs), then finish the shard-edge blocks. The
+                # reference overlaps its tuned kernel with MPI halo
+                # traffic the same way, by z-partitioned streams
+                # (MultiGPU/Diffusion3d_Baseline/main.c:203-260).
+                del refresh
+                lo, hi = exch(S)
+                T1 = s1t(dt_arr, S, hi, s1b(dt_arr, S, lo, s1i(dt_arr, S, T1)))
+                lo, hi = exch(T1)
+                T2 = s2t(dt_arr, T1, S, hi,
+                         s2b(dt_arr, T1, S, lo, s2i(dt_arr, T1, S, T2)))
+                lo, hi = exch(T2)
+                S = s3t(dt_arr, T2, hi, s3b(dt_arr, T2, lo, s3i(dt_arr, T2, S)))
+                return S, T1, T2
+
+        else:
+            s1, s2, s3 = mk("full")
+
+            def step(S, T1, T2, dt_arr, refresh=None, exch=None):
+                del exch
+                fix = refresh if refresh is not None else (lambda P: P)
+                T1 = fix(s1(dt_arr, S, T1))
+                T2 = fix(s2(dt_arr, T1, S, T2))
+                S = fix(s3(dt_arr, T2, S))
+                return S, T1, T2
 
         self._step = step
 
@@ -623,9 +796,8 @@ class FusedBurgersStepper:
         if y_sharded and ly % SUBLANE:
             return False
         ly_eff = round_up(ly, SUBLANE)
-        x_pad = round_up(lx + 2 * R, LANE)
         return (
-            _pick_blocks(lz, ly_eff, x_pad, jnp.dtype(dtype).itemsize)
+            _pick_blocks(lz, ly_eff, lx, jnp.dtype(dtype).itemsize)
             is not None
         )
 
@@ -634,14 +806,14 @@ class FusedBurgersStepper:
         pz, py, px = self.padded_shape
         return jnp.pad(
             u.astype(self.dtype),
-            ((R, pz - lz - R), (MARGIN, py - ly - MARGIN), (R, px - lx - R)),
+            ((R, pz - lz - R), (MARGIN, py - ly - MARGIN), (0, px - lx)),
             mode="edge",
         )
 
     def extract(self, S):
         lz, ly, lx = self.interior_shape
         return lax.slice(
-            S, (R, MARGIN, R), (R + lz, MARGIN + ly, R + lx)
+            S, (R, MARGIN, 0), (R + lz, MARGIN + ly, lx)
         )
 
     def _dt_value(self, S):
@@ -650,20 +822,30 @@ class FusedBurgersStepper:
         # no-copy interior view: XLA fuses the slice into the reduction
         return self._dt_fn(self.extract(S)).astype(jnp.float32)
 
-    def run(self, u, t, num_iters: int, refresh=None, offsets=None):
+    def _check_sharded_args(self, refresh, exch):
+        if not self.sharded:
+            return
+        if self.overlap_split and exch is None:
+            raise ValueError("split-overlap fused stepper needs exch")
+        if not self.overlap_split and refresh is None:
+            raise ValueError("sharded fused stepper needs a ghost refresh")
+
+    def run(self, u, t, num_iters: int, refresh=None, offsets=None,
+            exch=None):
         """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``.
 
         Sharded mode (must run inside ``shard_map``): ``refresh`` rewrites
-        the padded buffers' sharded-axis ghosts after every stage.
+        the padded buffers' sharded-axis ghosts after every stage —
+        or, in split-overlap mode, ``exch`` produces the ``(lo, hi)``
+        exchanged z-slabs each stage consumes as separate operands.
         ``offsets`` is accepted for interface parity with the diffusion
         stepper and unused — edge synthesis here needs no global
         coordinates (local replication + refresh cover every world).
         """
         del offsets
-        if self.sharded and refresh is None:
-            raise ValueError("sharded fused stepper needs a ghost refresh")
+        self._check_sharded_args(refresh, exch)
         S = self.embed(u)
-        if refresh is not None:
+        if refresh is not None and not self.overlap_split:
             S = refresh(S)
         T1 = S
         T2 = S
@@ -671,13 +853,14 @@ class FusedBurgersStepper:
         def body(i, carry):
             S, T1, T2, t = carry
             dt = self._dt_value(S)
-            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1), refresh=refresh)
+            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
+                                   refresh=refresh, exch=exch)
             return S, T1, T2, t + dt.astype(t.dtype)
 
         S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, T1, T2, t))
         return self.extract(S), t
 
-    def run_to(self, u, t, t_end, refresh=None, offsets=None):
+    def run_to(self, u, t, t_end, refresh=None, offsets=None, exch=None):
         """March fused steps until ``t_end``; returns ``(u, t, steps)``.
 
         The reference Burgers drivers' *native* execution mode — ``while
@@ -690,10 +873,9 @@ class FusedBurgersStepper:
         guard), so step counts and trajectories match the generic path.
         """
         del offsets
-        if self.sharded and refresh is None:
-            raise ValueError("sharded fused stepper needs a ghost refresh")
+        self._check_sharded_args(refresh, exch)
         S = self.embed(u)
-        if refresh is not None:
+        if refresh is not None and not self.overlap_split:
             S = refresh(S)
         te = jnp.asarray(t_end, t.dtype)
         eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
@@ -706,7 +888,8 @@ class FusedBurgersStepper:
             dt = jnp.minimum(
                 self._dt_value(S), (te - t).astype(jnp.float32)
             )
-            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1), refresh=refresh)
+            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
+                                   refresh=refresh, exch=exch)
             return S, T1, T2, t + dt.astype(t.dtype), it + 1
 
         S, T1, T2, t, steps = lax.while_loop(

@@ -12,8 +12,14 @@ HBM transfers total.
 Ghost discipline follows :mod:`fused_burgers`: all non-interior cells
 hold edge-replicated values (``WENO5resAdv_X.m:53``), re-synthesized
 from the freshly computed interior after every stage; stencil reads are
-masked circular shifts. Fixed dt only (adaptive dt needs a global
-``max|f'(u)|`` before stage 1).
+masked circular shifts.
+
+dt modes: fixed (CUDA-parity, ``main.c:193``) or adaptive — the global
+``max|f'(u)|`` reduction runs *in-core* before every step
+(``whole_run_adaptive``): because every ghost/slack cell is an edge
+replica of an interior value, the reduction over the full padded array
+equals the interior reduction, so no masking is needed
+(``LFWENO5FDM2d.m:71``).
 """
 
 from __future__ import annotations
@@ -64,7 +70,9 @@ def _laplacian_2d(v, scales):
 
 
 def _stage(u, v, *, interior_shape, inv_dx, nu_scales, flux, variant, a, b, dt):
-    """One RK stage over the full padded array, ghosts re-synthesized."""
+    """One RK stage over the full padded array, ghosts re-synthesized.
+    ``dt`` is a trace-time float (fixed mode) or a traced in-core scalar
+    (adaptive mode, bound per-iteration by ``whole_run_adaptive``)."""
     ny, nx = interior_shape
     vp, vm = _split(flux, v)
     rhs = -(
@@ -73,15 +81,23 @@ def _stage(u, v, *, interior_shape, inv_dx, nu_scales, flux, variant, a, b, dt):
     )
     if nu_scales is not None:
         rhs = rhs + _laplacian_2d(v, nu_scales)
+    dt = jnp.asarray(dt, v.dtype)
     rk = b * (v + dt * rhs) if a == 0.0 else a * u + b * (v + dt * rhs)
     return _edge_fill_2d(rk.astype(v.dtype), ny, nx)
 
 
 class FusedBurgers2DStepper:
-    """Jit-cached whole-run VMEM stepper for one (grid, flux, dt)."""
+    """Jit-cached whole-run VMEM stepper for one (grid, flux) config.
+
+    Exactly one of ``dt`` (fixed, CUDA-parity) / ``dt_fn`` (adaptive —
+    called on the padded in-core state before every step) must be given,
+    mirroring :class:`fused_burgers.FusedBurgersStepper`."""
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
-                 variant: str, nu: float, dt: float):
+                 variant: str, nu: float, dt: float | None = None,
+                 dt_fn=None):
+        if (dt is None) == (dt_fn is None):
+            raise ValueError("provide exactly one of dt/dt_fn")
         ny, nx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.padded_shape = (
@@ -101,9 +117,9 @@ class FusedBurgers2DStepper:
             nu_scales=nu_scales,
             flux=flux,
             variant=variant,
-            dt=float(dt),
         )
-        self.dt = float(dt)
+        self.dt = None if dt is None else float(dt)
+        self._dt_fn = dt_fn
 
     @staticmethod
     def supported(interior_shape, dtype) -> bool:
@@ -133,9 +149,18 @@ class FusedBurgers2DStepper:
         from multigpu_advectiondiffusion_tpu.ops.pallas.whole_run import (
             accumulate_t,
             whole_run,
+            whole_run_adaptive,
         )
 
         if num_iters == 0:
             return u, t
-        out = whole_run(self._stage, self.embed(u), num_iters)
-        return self.extract(out), accumulate_t(t, self.dt, num_iters)
+        if self.dt is not None:
+            out = whole_run(
+                functools.partial(self._stage, dt=self.dt),
+                self.embed(u), num_iters,
+            )
+            return self.extract(out), accumulate_t(t, self.dt, num_iters)
+        out, t_sum = whole_run_adaptive(
+            self._stage, self.embed(u), num_iters, self._dt_fn
+        )
+        return self.extract(out), t + t_sum.astype(t.dtype)

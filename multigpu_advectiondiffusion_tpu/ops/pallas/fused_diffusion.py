@@ -67,9 +67,13 @@ def _shift(x, off: int, axis: int):
     """Full-width circular shift: result[i] = x[i + off] along ``axis``.
 
     Wraparound rows/columns land only in ghost/slack positions, whose
-    outputs are masked back to the stage input.
+    outputs are masked back to the stage input. A zero shift returns
+    ``x`` unchanged — Mosaic's roll lowering builds a zero-width slice
+    for amount 0, which some toolchain versions reject.
     """
     n = x.shape[axis]
+    if off % n == 0:
+        return x
     if interpret_mode():
         return jnp.roll(x, -off, axis)
     return pltpu.roll(x, (-off) % n, axis)

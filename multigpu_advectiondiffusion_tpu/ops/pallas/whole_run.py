@@ -72,6 +72,72 @@ def whole_run(stage, S0: jnp.ndarray, num_iters: int) -> jnp.ndarray:
     )(S0)
 
 
+def _kernel_adaptive(s_hbm, out_hbm, t_out, S, T1, T2, tacc, sem, *,
+                     n_iters, stage, dt_fn):
+    """Like :func:`_kernel` but dt is recomputed from the in-VMEM state
+    before every step (``dt_fn`` — a whole-array reduction; the padded
+    state's ghost/slack cells are edge replicas of interior values, so
+    the reduction over the full array equals the interior reduction) and
+    the accumulated time advance is emitted as an SMEM scalar output."""
+    k = pl.program_id(0)
+
+    @pl.when(k == 0)
+    def _():
+        cp = pltpu.make_async_copy(s_hbm, S, sem)
+        cp.start()
+        cp.wait()
+        tacc[0] = jnp.float32(0.0)
+
+    u = S[:]
+    dt = dt_fn(u)
+    (a1, b1), (a2, b2), (a3, b3) = _STAGES
+    T1[:] = stage(u, u, a=a1, b=b1, dt=dt)
+    T2[:] = stage(u, T1[:], a=a2, b=b2, dt=dt)
+    S[:] = stage(u, T2[:], a=a3, b=b3, dt=dt)
+    tacc[0] = tacc[0] + dt.astype(jnp.float32)
+
+    @pl.when(k == n_iters - 1)
+    def _():
+        t_out[0] = tacc[0]
+        cp = pltpu.make_async_copy(S, out_hbm, sem)
+        cp.start()
+        cp.wait()
+
+
+def whole_run_adaptive(stage, S0: jnp.ndarray, num_iters: int, dt_fn):
+    """Adaptive-dt variant of :func:`whole_run`: returns ``(final padded
+    state, accumulated time advance)``. ``stage`` additionally takes the
+    per-iteration ``dt``; ``dt_fn(padded_state) -> scalar`` runs in-core
+    between steps (the restored CFL rule the CUDA drivers hard-coded
+    away, ``LFWENO5FDM2d.m:71`` vs ``main.c:193``)."""
+    kern = functools.partial(
+        _kernel_adaptive, n_iters=num_iters, stage=stage, dt_fn=dt_fn
+    )
+    S, t_sum = pl.pallas_call(
+        kern,
+        grid=(num_iters,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(S0.shape, S0.dtype),
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM(S0.shape, S0.dtype),
+            pltpu.VMEM(S0.shape, S0.dtype),
+            pltpu.VMEM(S0.shape, S0.dtype),
+            pltpu.SMEM((1,), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=None if interpret_mode() else compiler_params(),
+        interpret=interpret_mode(),
+    )(S0)
+    return S, t_sum[0]
+
+
 def accumulate_t(t, dt: float, num_iters: int):
     """Iterative t accumulation, matching the generic loop's rounding."""
     return lax.fori_loop(0, num_iters, lambda i, tt: tt + dt, t)
