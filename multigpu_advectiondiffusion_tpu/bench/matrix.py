@@ -216,10 +216,11 @@ def main(argv=None):
     lines = []
     for case in cases:
         # x64 scoped per case: a process-wide flip would poison the f32
-        # Pallas rows' Mosaic lowering with i64 constants
+        # Pallas rows' Mosaic lowering with i64 constants. The resolved
+        # dtype is passed down so the scope and the solver can't diverge.
         dtype = args.dtype or case.dtype
         with jax.enable_x64(dtype == "float64"):
-            res = run_case(case, dtype=args.dtype, quick=args.quick,
+            res = run_case(case, dtype=dtype, quick=args.quick,
                            mesh_spec=args.mesh, repeats=args.repeats)
         line = json.dumps(res)
         print(line, flush=True)

@@ -145,9 +145,10 @@ def _live_bytes(bz: int, by: int, lx: int, itemsize: int) -> int:
 def _pick_blocks(nz, ny, lx, itemsize):
     """First viable block in measured-preference order.
 
-    v5e, 512^3: (8,64) 6045 MLUPS > (4,64) 5903 > (8,128) 5580 >
-    (16,64) 5292 — beyond (8,64) the larger working set costs more in
-    Mosaic scheduling than the halo amortization returns.
+    v5e, 512^3 (lane-aligned layout, roll-based y sweep): (8,64) 9491
+    MLUPS > (16,32) 9378 > (8,16)/(16,16) ~8877 > (16,64) 8289 — beyond
+    (8,64) the larger working set costs more in Mosaic scheduling than
+    the halo amortization returns.
     """
     for by in (64, 128, 32, 16, 8):
         if ny % by:
@@ -206,32 +207,18 @@ def _div_z(vp, vm, bz, by, inv_dx, variant):
 
 
 def _div_y(vp, vm, bz, by, inv_dx, variant):
-    """Flux divergence along y of the core box via sublane slices.
+    """Flux divergence along y of the core box via sublane *rolls* over
+    the full margin-carrying width.
 
-    Interface ``i`` (0..by) sits right of core column ``i-1`` (slab
-    column ``MARGIN+i-1``); minus window columns ``MARGIN+i-3 ..
-    MARGIN+i+1`` (center ``MARGIN+i-1``), plus window shifted by one.
+    Measured on v5e (512^3): whole-array sublane rolls beat
+    sublane-offset window slices by ~25% of the sweep — every slice at a
+    non-tile offset lowers to a per-operand realignment through the same
+    shift unit a roll uses once, and the extra margin-width ALU is free
+    (the kernel is shift-bound, not FLOP-bound). Wrapped rows land only
+    in margin columns, which the core output slice discards.
     """
-    p = vp[R : R + bz]
-    m = vm[R : R + bz]
-    ep = p[:, 1:] - p[:, :-1]
-    em = m[:, 1:] - m[:, :-1]
-    n = by + 1
-    # curvature per-window (_weno5_side_nd_e): a shared cd array would
-    # cost 3 extra sublane realignments per side — the binding resource
-    # — while recomputing from the already-realigned windows is ALU-only
-    nm, dm = _weno5_side_nd_e(
-        *(ep[:, MARGIN - 3 + j : MARGIN - 3 + j + n] for j in range(4)),
-        variant, "minus",
-    )
-    np_, dp = _weno5_side_nd_e(
-        *(em[:, MARGIN - 2 + j : MARGIN - 2 + j + n] for j in range(4)),
-        variant, "plus",
-    )
-    h = (p[:, MARGIN - 1 : MARGIN + by] + m[:, MARGIN : MARGIN + by + 1]) + (
-        nm * _recip(dm) + np_ * _recip(dp)
-    )
-    return (h[:, 1:] - h[:, :-1]) * inv_dx
+    h = _div_roll(vp[R : R + bz], vm[R : R + bz], 1, inv_dx, variant)
+    return h[:, MARGIN : MARGIN + by]
 
 
 def _div_roll(vp, vm, axis, inv_dx, variant):
@@ -266,8 +253,11 @@ def _laplacian(v, vc_w, bz, by, px, scales):
 
     ``v`` is the px-wide box (z/y terms need no x ghosts); ``vc_w`` the
     W-wide core whose circular x shifts read the synthesized ghost lanes
-    at the wrap positions, sliced back to ``px``."""
+    at the wrap positions, sliced back to ``px``. y terms roll the full
+    margin-carrying rows and slice the (tile-aligned, free) core columns
+    — same rolls-beat-realignments measurement as :func:`_div_y`."""
     yc = slice(MARGIN, MARGIN + by)
+    vrows = v[R : R + bz]
     acc = None
     for axis in range(3):
         for j, c in enumerate(O4_COEFFS):
@@ -275,7 +265,7 @@ def _laplacian(v, vc_w, bz, by, px, scales):
             if axis == 0:
                 term = v[j + 1 : j + 1 + bz, yc] * coef
             elif axis == 1:
-                term = v[R : R + bz, MARGIN - 2 + j : MARGIN - 2 + j + by] * coef
+                term = _shift(vrows, j - 2, 1)[:, yc] * coef
             else:
                 term = _shift(vc_w, j - 2, 2)[:, :, :px] * coef
             acc = term if acc is None else acc + term

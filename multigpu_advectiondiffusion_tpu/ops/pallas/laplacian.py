@@ -59,12 +59,7 @@ VMEM_BLOCK_BUDGET_3D = 134 * 1024 * 1024
 
 
 def compiler_params():
-    # bounds checks off: every DMA box and slice in these kernels is
-    # statically in-bounds by construction (grids divide the padded
-    # extents exactly); the checks cost scalar-core work per block
-    return pltpu.CompilerParams(
-        vmem_limit_bytes=VMEM_LIMIT, disable_bounds_checks=True
-    )
+    return pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT)
 
 
 def _interpret() -> bool:

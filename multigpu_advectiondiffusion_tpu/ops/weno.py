@@ -121,13 +121,14 @@ _C13 = 13.0 / 12.0  # curvature coefficient of the smoothness indicators
 
 
 def _curv(dd):
-    """Shared curvature array ``13/12 dd^2`` of a second-difference
-    array ``dd_j = e_{j+1} - e_j``: the three betas of one
-    reconstruction and the betas of neighboring interfaces are all
-    windows of this one array. Defined HERE, next to
-    :func:`_weno5_side_nd`, so the ``(c * dd) * dd`` association has a
-    single definition — the fused kernels' bit-identity contract with
-    the generic path depends on it."""
+    """Curvature term ``13/12 dd^2`` of a second difference
+    ``dd_j = e_{j+1} - e_j``. In slice-cheap sweeps (the fused z sweep)
+    the caller computes one shared array and passes windows; in
+    shift-bound sweeps :func:`_weno5_side_nd_e` recomputes it per
+    window. One definition keeps the ``(c * dd) * dd`` association
+    uniform across sweeps (the sharded-vs-unsharded fused equality
+    tests hold to a documented few-ulp bound, not bitwise — XLA's
+    interpret-mode contraction freedom already rules that out)."""
     return _C13 * dd * dd
 
 

@@ -303,7 +303,7 @@ def _assert_fused_close(actual, desired):
 
 
 @pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
-def test_fused_burgers_sharded_bit_identical_to_unsharded_fused(
+def test_fused_burgers_sharded_matches_unsharded_fused(
     devices, adaptive
 ):
     """The fused Burgers stepper shard-local under shard_map (ppermute
@@ -438,7 +438,7 @@ def test_fused_burgers_advance_to_matches_xla(adaptive):
 
 
 @pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
-def test_fused_burgers_advance_to_sharded_bit_identical(devices, adaptive):
+def test_fused_burgers_advance_to_sharded_matches_unsharded(devices, adaptive):
     """Fused run_to shard-local under shard_map (ppermute ghost refresh,
     pmax dt) must reproduce the single-device fused advance_to
     bit-for-bit, with the same step count."""
