@@ -229,6 +229,18 @@ class SolverBase:
         Overridden by solvers that have a fused Pallas stepper."""
         return None
 
+    def _split_overlap_requested(self) -> bool:
+        """``overlap='split'`` with a pure z-slab decomposition — the
+        only topology the fused steppers' three-call overlapped schedule
+        serves. Single definition for every solver's eligibility."""
+        if self.mesh is None or getattr(self.cfg, "overlap", None) != "split":
+            return False
+        sizes = dict(self.mesh.shape)
+        sharded = [
+            ax for ax, name in self.decomp.axes if sizes.get(name, 1) > 1
+        ]
+        return sharded == [0]
+
     def _fused_sharded_ctx(self, fused):
         """``(refresh, offsets_fn, exch)`` for running a fused stepper
         shard-local inside ``shard_map``: ghosts ppermute-refreshed after
@@ -259,7 +271,11 @@ class SolverBase:
         if getattr(fused, "overlap_split", False):
             name = self.decomp.mesh_axis(0)
             nsh = axis_extent(sizes, name)
-            off = fused.core_offsets[0]
+            offs = getattr(
+                fused, "core_offsets",
+                (fused.halo,) * len(fused.interior_shape),
+            )
+            off = offs[0]
             lz = fused.interior_shape[0]
 
             def exch(P):

@@ -193,17 +193,7 @@ class BurgersSolver(SolverBase):
                 if self.mesh is not None:
                     kwargs["global_shape"] = self.grid.shape
                     kwargs["y_sharded"] = y_sharded
-                    # overlap="split" + pure z-slab decomposition: the
-                    # three-call overlapped schedule (interior blocks
-                    # concurrent with the z-halo ppermute)
-                    sizes = dict(self.mesh.shape)
-                    sharded_axes = [
-                        ax for ax, name in self.decomp.axes
-                        if sizes.get(name, 1) > 1
-                    ]
-                    kwargs["overlap_split"] = (
-                        cfg.overlap == "split" and sharded_axes == [0]
-                    )
+                    kwargs["overlap_split"] = self._split_overlap_requested()
                 if cfg.adaptive_dt:
                     reduce = self.mesh_reduce_max()
                     kwargs["dt_fn"] = lambda u: advective_dt(

@@ -255,6 +255,8 @@ class DiffusionSolver(SolverBase):
             kwargs = {}
             if self.mesh is not None:
                 kwargs["global_shape"] = self.grid.shape
+                if self.grid.ndim == 3 and cfg.impl != "pallas_step":
+                    kwargs["overlap_split"] = self._split_overlap_requested()
             self._cache["fused"] = cls(
                 lshape,
                 self.dtype,

@@ -93,6 +93,9 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     interpret_mode,
     round_up,
 )
+from multigpu_advectiondiffusion_tpu.ops.pallas.stepper_base import (
+    FusedStepperBase,
+)
 from multigpu_advectiondiffusion_tpu.ops.weno import (
     _curv,
     _weno5_side_nd,
@@ -367,7 +370,7 @@ def _stage_kernel(
                     sem_gv.at[s],
                 ),
                 pltpu.make_async_copy(
-                    v_hbm.at[pl.ds(R, bz + R), ysl],
+                    v_hbm.at[pl.ds(z0 + R, bz + R), ysl],
                     _xsl(vs.at[s, pl.ds(R, bz + R)]),
                     sem_v.at[s],
                 ),
@@ -664,7 +667,7 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     )
 
 
-class FusedBurgersStepper:
+class FusedBurgersStepper(FusedStepperBase):
     """Jit-cached fused runner for one (grid, flux, dtype) config.
 
     ``dt`` fixes the step (CUDA-parity mode); ``dt_fn`` (a callable
@@ -748,7 +751,8 @@ class FusedBurgersStepper:
             (s1b, s2b, s3b) = mk("bottom")
             (s1t, s2t, s3t) = mk("top")
 
-            def step(S, T1, T2, dt_arr, refresh=None, exch=None):
+            def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                     exch=None):
                 # Each stage: start the z-halo ppermute of its input,
                 # run the ghost-independent interior blocks concurrently
                 # (XLA schedules them between collective-permute-start/
@@ -757,7 +761,7 @@ class FusedBurgersStepper:
                 # reference overlaps its tuned kernel with MPI halo
                 # traffic the same way, by z-partitioned streams
                 # (MultiGPU/Diffusion3d_Baseline/main.c:203-260).
-                del refresh
+                del offsets, refresh  # no global wall masks here
                 lo, hi = exch(S)
                 T1 = s1t(dt_arr, S, hi, s1b(dt_arr, S, lo, s1i(dt_arr, S, T1)))
                 lo, hi = exch(T1)
@@ -770,8 +774,9 @@ class FusedBurgersStepper:
         else:
             s1, s2, s3 = mk("full")
 
-            def step(S, T1, T2, dt_arr, refresh=None, exch=None):
-                del exch
+            def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                     exch=None):
+                del offsets, exch  # no global wall masks here
                 fix = refresh if refresh is not None else (lambda P: P)
                 T1 = fix(s1(dt_arr, S, T1))
                 T2 = fix(s2(dt_arr, T1, S, T2))
@@ -812,77 +817,8 @@ class FusedBurgersStepper:
         # no-copy interior view: XLA fuses the slice into the reduction
         return self._dt_fn(self.extract(S)).astype(jnp.float32)
 
-    def _check_sharded_args(self, refresh, exch):
-        if not self.sharded:
-            return
-        if self.overlap_split and exch is None:
-            raise ValueError("split-overlap fused stepper needs exch")
-        if not self.overlap_split and refresh is None:
-            raise ValueError("sharded fused stepper needs a ghost refresh")
-
-    def run(self, u, t, num_iters: int, refresh=None, offsets=None,
-            exch=None):
-        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``.
-
-        Sharded mode (must run inside ``shard_map``): ``refresh`` rewrites
-        the padded buffers' sharded-axis ghosts after every stage —
-        or, in split-overlap mode, ``exch`` produces the ``(lo, hi)``
-        exchanged z-slabs each stage consumes as separate operands.
-        ``offsets`` is accepted for interface parity with the diffusion
-        stepper and unused — edge synthesis here needs no global
-        coordinates (local replication + refresh cover every world).
-        """
-        del offsets
-        self._check_sharded_args(refresh, exch)
-        S = self.embed(u)
-        if refresh is not None and not self.overlap_split:
-            S = refresh(S)
-        T1 = S
-        T2 = S
-
-        def body(i, carry):
-            S, T1, T2, t = carry
-            dt = self._dt_value(S)
-            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
-                                   refresh=refresh, exch=exch)
-            return S, T1, T2, t + dt.astype(t.dtype)
-
-        S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, T1, T2, t))
-        return self.extract(S), t
-
-    def run_to(self, u, t, t_end, refresh=None, offsets=None, exch=None):
-        """March fused steps until ``t_end``; returns ``(u, t, steps)``.
-
-        The reference Burgers drivers' *native* execution mode — ``while
-        (t < tEnd)`` over the tuned kernels with the final step trimmed
-        (``MultiGPU/Burgers3d_Baseline/main.c:190-317``,
-        ``SingleGPU/Burgers3d_WENO5/main.cpp:127-150``) — at the fused
-        stepper's speed: dt is already a runtime SMEM scalar, so the same
-        compiled stages serve the trimmed last step. Termination and
-        trimming mirror :meth:`SolverBase.advance_to` exactly (same eps
-        guard), so step counts and trajectories match the generic path.
-        """
-        del offsets
-        self._check_sharded_args(refresh, exch)
-        S = self.embed(u)
-        if refresh is not None and not self.overlap_split:
-            S = refresh(S)
-        te = jnp.asarray(t_end, t.dtype)
-        eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
-
-        def cond(carry):
-            return carry[3] < te - eps
-
-        def body(carry):
-            S, T1, T2, t, it = carry
-            dt = jnp.minimum(
-                self._dt_value(S), (te - t).astype(jnp.float32)
-            )
-            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
-                                   refresh=refresh, exch=exch)
-            return S, T1, T2, t + dt.astype(t.dtype), it + 1
-
-        S, T1, T2, t, steps = lax.while_loop(
-            cond, body, (S, S, S, t, jnp.zeros((), jnp.int32))
-        )
-        return self.extract(S), t, steps
+    # run()/run_to() come from FusedStepperBase (the reference Burgers
+    # drivers' native mode is run_to's `while (t < tEnd)`,
+    # MultiGPU/Burgers3d_Baseline/main.c:190-317). ``offsets`` is
+    # accepted there for interface parity and ignored by _step — edge
+    # synthesis here needs no global coordinates.

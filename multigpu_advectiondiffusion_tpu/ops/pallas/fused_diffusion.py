@@ -57,6 +57,9 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     pick_block,
     round_up,
 )
+from multigpu_advectiondiffusion_tpu.ops.pallas.stepper_base import (
+    FusedStepperBase,
+)
 
 # SSP-RK3 stage combinations u_next = a*u + b*(v + dt*L(v))
 # (Compute_RK, MultiGPU/Diffusion3d_Baseline/Kernels.cu:266-300)
@@ -80,8 +83,10 @@ def _shift(x, off: int, axis: int):
 
 
 def _stage_kernel(
+    dt_ref,
     v_hbm,
     u_hbm,
+    g_hbm,
     out_hbm,
     vs,
     us,
@@ -89,6 +94,7 @@ def _stage_kernel(
     sem_v,
     sem_u,
     sem_w,
+    sem_gv,
     *,
     bz: int,
     n_blocks: int,
@@ -97,9 +103,11 @@ def _stage_kernel(
     scales: Sequence[float],
     a: float,
     b: float,
-    dt: float,
     band: int,
     bc_value: float,
+    kz_base: int = 0,
+    n_blocks_grid: int | None = None,
+    ghost_src: str | None = None,
 ):
     """One z-block of one RK stage, 2-slot double-buffered.
 
@@ -110,16 +118,53 @@ def _stage_kernel(
     ranges of distinct blocks are disjoint, so the in-flight writes
     never alias the prefetched reads (the in-place final stage reads its
     ``u`` rows strictly before the overwriting DMA of the same block).
+
+    ``dt`` is a runtime SMEM scalar, so the same compiled stages serve
+    fixed-count runs AND the trimmed last step of ``run_to``. Roles for
+    the overlapped z-slab schedule (as in :mod:`fused_burgers`):
+    ``kz_base`` offsets this call's blocks, ``n_blocks_grid`` is this
+    call's grid extent, and ``ghost_src`` = ``"lo"``/``"hi"`` DMAs the R
+    z-ghost rows from the separately exchanged slab operand ``g_hbm``
+    instead of the padded buffer (whose z-ghost rows are stale in split
+    mode — frozen Dirichlet values are only correct at global edges).
     """
     nz, ny, nx = global_shape
-    k = pl.program_id(0)
+    if n_blocks_grid is None:
+        n_blocks_grid = n_blocks
+    k = pl.program_id(0)  # this call's linear block index
+    kz = k + kz_base  # absolute z-block index
     slot = lax.rem(k, jnp.asarray(2, k.dtype))
     nslot = lax.rem(k + 1, jnp.asarray(2, k.dtype))
 
     def copy_v(j, s):
-        return pltpu.make_async_copy(
-            v_hbm.at[pl.ds(j * bz, bz + 2 * R)], vs.at[s], sem_v.at[s]
-        )
+        z0 = (j + kz_base) * bz
+        if ghost_src is None:
+            return [
+                pltpu.make_async_copy(
+                    v_hbm.at[pl.ds(z0, bz + 2 * R)], vs.at[s], sem_v.at[s]
+                )
+            ]
+        if ghost_src == "lo":
+            return [
+                pltpu.make_async_copy(
+                    g_hbm, vs.at[s, pl.ds(0, R)], sem_gv.at[s]
+                ),
+                pltpu.make_async_copy(
+                    v_hbm.at[pl.ds(z0 + R, bz + R)],
+                    vs.at[s, pl.ds(R, bz + R)],
+                    sem_v.at[s],
+                ),
+            ]
+        return [
+            pltpu.make_async_copy(
+                v_hbm.at[pl.ds(z0, bz + R)],
+                vs.at[s, pl.ds(0, bz + R)],
+                sem_v.at[s],
+            ),
+            pltpu.make_async_copy(
+                g_hbm, vs.at[s, pl.ds(bz + R, R)], sem_gv.at[s]
+            ),
+        ]
 
     def copy_u(j, s):
         # u rows come from u_hbm — which for the in-place final stage is
@@ -127,33 +172,39 @@ def _stage_kernel(
         # other blocks' reads are row-disjoint from any in-flight write).
         src = u_hbm if u_hbm is not None else out_hbm
         return pltpu.make_async_copy(
-            src.at[pl.ds(R + j * bz, bz)], us.at[s], sem_u.at[s]
+            src.at[pl.ds(R + (j + kz_base) * bz, bz)], us.at[s], sem_u.at[s]
         )
 
     def copy_w(j, s):
         return pltpu.make_async_copy(
-            res.at[s], out_hbm.at[pl.ds(R + j * bz, bz)], sem_w.at[s]
+            res.at[s],
+            out_hbm.at[pl.ds(R + (j + kz_base) * bz, bz)],
+            sem_w.at[s],
         )
 
     @pl.when(k == 0)
     def _():
-        copy_v(0, 0).start()
+        for cp in copy_v(0, 0):
+            cp.start()
         if us is not None:
             copy_u(0, 0).start()
 
-    @pl.when(k + 1 < n_blocks)
+    @pl.when(k + 1 < n_blocks_grid)
     def _():
-        copy_v(k + 1, nslot).start()
+        for cp in copy_v(k + 1, nslot):
+            cp.start()
         if us is not None:
             copy_u(k + 1, nslot).start()
 
     if us is not None:
         copy_u(k, slot).wait()
-    copy_v(k, slot).wait()
+    for cp in copy_v(k, slot):
+        cp.wait()
 
     v = vs[slot]
     vc = v[R : R + bz]  # stage input, core z-rows, full y/x width
     dtype = v.dtype
+    dt = dt_ref[0].astype(dtype)
 
     # 13-point O4 Laplacian (z-term via slab rows, y/x via masked
     # circular shifts). Diffusivity is folded into each term's
@@ -183,7 +234,7 @@ def _stage_kernel(
         if offs_ref is not None
         else (0, 0, 0)
     )
-    gz = lax.broadcasted_iota(jnp.int32, shp, 0) + k * bz + oz
+    gz = lax.broadcasted_iota(jnp.int32, shp, 0) + kz * bz + oz
     gy = lax.broadcasted_iota(jnp.int32, shp, 1) - R + oy
     gx = lax.broadcasted_iota(jnp.int32, shp, 2) - R + ox
 
@@ -208,15 +259,16 @@ def _stage_kernel(
     res[slot] = jnp.where(interior, rk, frozen)
     copy_w(k, slot).start()
 
-    @pl.when(k == n_blocks - 1)
+    @pl.when(k == n_blocks_grid - 1)
     def _():
         copy_w(k, slot).wait()
-        if n_blocks >= 2:
+        if n_blocks_grid >= 2:
             copy_w(k - 1, nslot).wait()
 
 
-def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
-                band, bc_value, u_source, global_shape=None, sharded=False):
+def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b,
+                band, bc_value, u_source, global_shape=None, sharded=False,
+                role=None):
     """Build one fused RK-stage call; output aliased onto the last operand.
 
     ``u_source``: where the step-input ``u`` (the ``a*u`` term) is read
@@ -224,6 +276,9 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     buffer), or ``"target"`` (the aliased output buffer itself, for the
     in-place final stage — avoids passing one buffer as two operands,
     which would force XLA to insert a defensive copy).
+
+    ``role``: ``"full"`` (default) or the overlapped z-slab schedule's
+    ``"interior"``/``"bottom"``/``"top"`` (see :func:`_stage_kernel`).
 
     ``sharded``: prepend an int32 ``(3,)`` SMEM operand carrying this
     shard's global offsets (the stage then runs shard-local inside
@@ -236,6 +291,19 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     # dead tail rows beyond the real interior stay frozen via the masks
     n_blocks = (padded_shape[0] - 2 * R) // bz
 
+    role = role or "full"
+    if role == "full":
+        kz_base, n_grid, ghost_src = 0, n_blocks, None
+    elif role == "interior":
+        kz_base, n_grid, ghost_src = 1, n_blocks - 2, None
+    elif role == "bottom":
+        kz_base, n_grid, ghost_src = 0, 1, "lo"
+    elif role == "top":
+        kz_base, n_grid, ghost_src = n_blocks - 1, 1, "hi"
+    else:
+        raise ValueError(f"unknown stage role {role!r}")
+    use_g = ghost_src is not None
+
     kern = functools.partial(
         _stage_kernel,
         bz=bz,
@@ -244,27 +312,48 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
         scales=tuple(scales),
         a=a,
         b=b,
-        dt=dt,
         band=band,
         bc_value=bc_value,
+        kz_base=kz_base,
+        n_blocks_grid=n_grid,
+        ghost_src=ghost_src,
     )
 
     def kernel(*refs):
-        offs_ref = None
+        dt_ref, *refs = refs
+        offs_ref, g_hbm, sem_gv = None, None, None
         if sharded:
             offs_ref, *refs = refs
         if u_source == "operand":
-            v_hbm, u_hbm, _tgt, out_hbm, vs, us, res, sem_v, sem_u, sem_w = refs
-        elif u_source == "target":
-            v_hbm, _tgt, out_hbm, vs, us, res, sem_v, sem_u, sem_w = refs
-            u_hbm = None  # read from out_hbm
+            v_hbm, u_hbm, *refs = refs
         else:
-            v_hbm, _tgt, out_hbm, vs, res, sem_v, sem_w = refs
-            u_hbm, us, sem_u = None, None, None
-        kern(v_hbm, u_hbm, out_hbm, vs, us, res, sem_v, sem_u, sem_w,
-             offs_ref=offs_ref)
+            v_hbm, *refs = refs
+            u_hbm = None  # "target": read from out_hbm
+        if use_g:
+            g_hbm, *refs = refs
+        _tgt, out_hbm, vs, *refs = refs
+        if use_u:
+            us, *refs = refs
+        else:
+            us = None
+        res, sem_v, *refs = refs
+        if use_u:
+            sem_u, *refs = refs
+        else:
+            sem_u = None
+        sem_w, *refs = refs
+        if use_g:
+            (sem_gv,) = refs
+        kern(dt_ref, v_hbm, u_hbm, g_hbm, out_hbm, vs, us, res,
+             sem_v, sem_u, sem_w, sem_gv, offs_ref=offs_ref)
 
-    n_in = (3 if u_source == "operand" else 2) + (1 if sharded else 0)
+    n_in = (
+        1  # dt
+        + (1 if sharded else 0)
+        + (2 if u_source == "operand" else 1)
+        + (1 if use_g else 0)
+        + 1  # aliased target
+    )
     scratch = [pltpu.VMEM((2, bz + 2 * R) + trailing, dtype)]
     if use_u:
         scratch.append(pltpu.VMEM((2, bz) + trailing, dtype))
@@ -273,14 +362,17 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     if use_u:
         scratch.append(pltpu.SemaphoreType.DMA((2,)))
     scratch.append(pltpu.SemaphoreType.DMA((2,)))
+    if use_g:
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
 
-    in_specs = [pl.BlockSpec(memory_space=pl.ANY)] * n_in
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]  # dt
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 1)
     if sharded:
-        in_specs[0] = pl.BlockSpec(memory_space=pltpu.SMEM)
+        in_specs[1] = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     return pl.pallas_call(
         kernel,
-        grid=(n_blocks,),
+        grid=(n_grid,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
@@ -291,7 +383,7 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b, dt,
     )
 
 
-class FusedDiffusionStepper:
+class FusedDiffusionStepper(FusedStepperBase):
     """Jit-cached fused runner for one (grid, dtype, dt) configuration.
 
     ``global_shape`` (when it differs from ``interior_shape``) switches
@@ -306,9 +398,11 @@ class FusedDiffusionStepper:
     """
 
     halo = R
+    needs_offsets = True  # global wall masks take an offsets operand
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
-                 band, bc_value, block_z=None, global_shape=None):
+                 band, bc_value, block_z=None, global_shape=None,
+                 overlap_split: bool = False):
         nz, ny, nx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
@@ -359,25 +453,63 @@ class FusedDiffusionStepper:
             float(diffusivity[i]) / (12.0 * spacing[i] * spacing[i])
             for i in range(3)
         ]
-        sources = ("none", "operand", "target")
-        s1, s2, s3 = (
-            _make_stage(
-                self.padded_shape, self.interior_shape, self.dtype,
-                bz=bz, scales=scales, a=a, b=b, dt=float(dt),
-                band=band, bc_value=float(bc_value), u_source=src,
-                global_shape=self.global_shape, sharded=self.sharded,
-            )
-            for (a, b), src in zip(_STAGES, sources)
+        # split-overlap needs a strict interior band (>= 3 blocks) and
+        # bz >= R so interior boxes never reach the stale ghost rows
+        self.overlap_split = bool(
+            overlap_split and self.sharded and nz // bz >= 3 and bz >= R
         )
+        sources = ("none", "operand", "target")
+
+        def mk(role):
+            return tuple(
+                _make_stage(
+                    self.padded_shape, self.interior_shape, self.dtype,
+                    bz=bz, scales=scales, a=a, b=b,
+                    band=band, bc_value=float(bc_value), u_source=src,
+                    global_shape=self.global_shape, sharded=self.sharded,
+                    role=role,
+                )
+                for (a, b), src in zip(_STAGES, sources)
+            )
+
         self.dt = float(dt)
 
-        def step(S, T1, T2, offsets=None, refresh=None):
-            pre = () if offsets is None else (offsets,)
-            fix = refresh if refresh is not None else (lambda P: P)
-            T1 = fix(s1(*pre, S, T1))      # u1 = u + dt L(u)
-            T2 = fix(s2(*pre, T1, S, T2))  # u2 = 3/4 u + 1/4 (u1 + dt L(u1))
-            S = fix(s3(*pre, T2, S))       # u  = 1/3 u + 2/3 (u2 + dt L(u2)),
-            return S, T1, T2               # in place
+        if self.overlap_split:
+            (s1i, s2i, s3i) = mk("interior")
+            (s1b, s2b, s3b) = mk("bottom")
+            (s1t, s2t, s3t) = mk("top")
+
+            def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                     exch=None):
+                # Interior blocks run concurrently with the z-halo
+                # ppermute; only the two edge calls consume the
+                # exchanged slabs — the reference's five-stream
+                # boundary/interior split (main.c:203-260) as dataflow.
+                del refresh
+                pre = (dt_arr, offsets)
+                lo, hi = exch(S)
+                T1 = s1t(*pre, S, hi, s1b(*pre, S, lo, s1i(*pre, S, T1)))
+                lo, hi = exch(T1)
+                T2 = s2t(*pre, T1, S, hi,
+                         s2b(*pre, T1, S, lo, s2i(*pre, T1, S, T2)))
+                lo, hi = exch(T2)
+                S = s3t(*pre, T2, hi, s3b(*pre, T2, lo, s3i(*pre, T2, S)))
+                return S, T1, T2
+
+        else:
+            s1, s2, s3 = mk("full")
+
+            def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                     exch=None):
+                del exch
+                pre = (
+                    (dt_arr,) if offsets is None else (dt_arr, offsets)
+                )
+                fix = refresh if refresh is not None else (lambda P: P)
+                T1 = fix(s1(*pre, S, T1))     # u1 = u + dt L(u)
+                T2 = fix(s2(*pre, T1, S, T2))  # 3/4 u + 1/4 (u1 + dt L(u1))
+                S = fix(s3(*pre, T2, S))      # 1/3 u + 2/3 (u2 + dt L(u2))
+                return S, T1, T2              # in place
 
         self._step = step
 
@@ -389,26 +521,10 @@ class FusedDiffusionStepper:
         nz, ny, nx = self.interior_shape
         return lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
 
-    def run(self, u, t, num_iters: int, refresh=None, offsets=None):
-        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``.
+    def _dt_value(self, S):
+        return jnp.asarray(self.dt, jnp.float32)
 
-        Sharded mode (must run inside ``shard_map``): ``refresh`` rewrites
-        the padded buffers' sharded-axis ghosts after every stage and
-        ``offsets`` is this shard's int32 ``(3,)`` global-offset vector.
-        """
-        if self.sharded and (refresh is None or offsets is None):
-            raise ValueError("sharded fused stepper needs refresh+offsets")
-        S = self.embed(u)
-        if refresh is not None:
-            S = refresh(S)
-        T1 = S
-        T2 = S
-
-        def body(i, carry):
-            S, T1, T2, t = carry
-            S, T1, T2 = self._step(S, T1, T2, offsets=offsets,
-                                   refresh=refresh)
-            return S, T1, T2, t + self.dt
-
-        S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, T1, T2, t))
-        return self.extract(S), t
+    # run()/run_to() come from FusedStepperBase (the MATLAB heat
+    # drivers' native mode is run_to's `while t < t_end`,
+    # heat3d.m:48-77); the kernels' global wall masks make ``offsets``
+    # mandatory when sharded (needs_offsets).

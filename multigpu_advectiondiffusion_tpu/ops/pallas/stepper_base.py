@@ -1,0 +1,104 @@
+"""Shared execution driver for the fused per-stage steppers.
+
+Both 3-D fused steppers (:mod:`fused_diffusion`, :mod:`fused_burgers`)
+expose the same two execution modes over their per-stage kernels:
+
+* :meth:`run` — fixed-count `lax.fori_loop` (the CUDA drivers'
+  ``max_iters`` mode, ``MultiGPU/Diffusion3d_Baseline/main.c:189``);
+* :meth:`run_to` — ``while t < t_end`` with the last step trimmed (the
+  Burgers drivers' and MATLAB heat drivers' *native* mode,
+  ``MultiGPU/Burgers3d_Baseline/main.c:190-317``, ``heat3d.m:48-77``),
+  at full fused speed because dt enters the stage kernels as a runtime
+  SMEM scalar.
+
+Termination and trimming mirror ``SolverBase.advance_to`` exactly (same
+eps guard) — defined ONCE here so step counts and trajectories cannot
+desynchronize between the generic and fused paths or between solvers.
+
+Subclasses provide ``embed``/``extract``, ``_step(S, T1, T2, dt_arr,
+offsets=, refresh=, exch=)``, ``_dt_value(S)`` (a traced f32 scalar —
+constant for diffusion, the CFL reduction for adaptive Burgers), and the
+``sharded``/``overlap_split`` flags; ``needs_offsets`` marks steppers
+whose kernels take a global-offset SMEM operand.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class FusedStepperBase:
+    needs_offsets = False
+
+    def _dt_value(self, S):
+        raise NotImplementedError
+
+    def _check_sharded_args(self, refresh, offsets, exch):
+        if not self.sharded:
+            return
+        if self.needs_offsets and offsets is None:
+            raise ValueError("sharded fused stepper needs offsets")
+        if self.overlap_split and exch is None:
+            raise ValueError("split-overlap fused stepper needs exch")
+        if not self.overlap_split and refresh is None:
+            raise ValueError("sharded fused stepper needs a ghost refresh")
+
+    def run(self, u, t, num_iters: int, refresh=None, offsets=None,
+            exch=None):
+        """``num_iters`` fused SSP-RK3 steps; returns ``(u, t)``.
+
+        Sharded mode (must run inside ``shard_map``): ``refresh``
+        rewrites the padded buffers' sharded-axis ghosts after every RK
+        stage — or, in split-overlap mode, ``exch`` produces the
+        ``(lo, hi)`` exchanged z-slabs the stages consume as separate
+        operands. ``offsets`` is this shard's int32 global-offset vector
+        (consumed only by steppers with global wall masks).
+        """
+        self._check_sharded_args(refresh, offsets, exch)
+        S = self.embed(u)
+        if refresh is not None and not self.overlap_split:
+            S = refresh(S)
+
+        def body(i, carry):
+            S, T1, T2, t = carry
+            dt = self._dt_value(S)
+            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
+                                   offsets=offsets, refresh=refresh,
+                                   exch=exch)
+            return S, T1, T2, t + dt.astype(t.dtype)
+
+        S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, S, S, t))
+        return self.extract(S), t
+
+    def run_to(self, u, t, t_end, refresh=None, offsets=None, exch=None):
+        """March fused steps until ``t_end``; returns ``(u, t, steps)``.
+
+        The reference drivers' native ``while (t < tEnd)`` mode at the
+        fused stepper's speed, with the final step trimmed through the
+        runtime SMEM dt scalar.
+        """
+        self._check_sharded_args(refresh, offsets, exch)
+        S = self.embed(u)
+        if refresh is not None and not self.overlap_split:
+            S = refresh(S)
+        te = jnp.asarray(t_end, t.dtype)
+        eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+
+        def cond(carry):
+            return carry[3] < te - eps
+
+        def body(carry):
+            S, T1, T2, t, it = carry
+            dt = jnp.minimum(
+                self._dt_value(S), (te - t).astype(jnp.float32)
+            )
+            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
+                                   offsets=offsets, refresh=refresh,
+                                   exch=exch)
+            return S, T1, T2, t + dt.astype(t.dtype), it + 1
+
+        S, T1, T2, t, steps = lax.while_loop(
+            cond, body, (S, S, S, t, jnp.zeros((), jnp.int32))
+        )
+        return self.extract(S), t, steps

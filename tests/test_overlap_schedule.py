@@ -134,14 +134,16 @@ def test_split_overlap_tpu_schedule_hides_collectives():
     )
 
 
-def test_fused_split_overlap_tpu_schedule_hides_collectives(monkeypatch):
-    """The fused Burgers split-overlap schedule, AOT-compiled for a
-    4-chip v5e topology with the real Mosaic kernels (interpret mode
-    forced off): the interior stage kernel — a ``tpu_custom_call`` — or
-    its surrounding fusions must be scheduled between a
-    ``collective-permute-start`` and its ``-done``, i.e. the tuned
-    kernel runs while the z-halo rides the ICI, which is what the
-    reference's five-stream choreography exists for
+@pytest.mark.parametrize("model", ["burgers", "diffusion"])
+def test_fused_split_overlap_tpu_schedule_hides_collectives(
+    monkeypatch, model
+):
+    """The fused split-overlap schedules, AOT-compiled for a 4-chip v5e
+    topology with the real Mosaic kernels (interpret mode forced off):
+    the interior stage kernel — a ``tpu_custom_call`` — must be
+    scheduled between a ``collective-permute-start`` and its ``-done``,
+    i.e. the tuned kernel runs while the z-halo rides the ICI, which is
+    what the reference's five-stream choreography exists for
     (MultiGPU/Diffusion3d_Baseline/main.c:203-260, Kernels.cu:207-261).
     """
     try:
@@ -156,35 +158,50 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(monkeypatch):
     from multigpu_advectiondiffusion_tpu import BurgersConfig, BurgersSolver
     from multigpu_advectiondiffusion_tpu.ops.pallas import (
         fused_burgers as fb,
+        fused_diffusion as fd,
         laplacian as lap,
     )
 
     # force real Mosaic lowering (the CPU-pinned test env defaults to
     # interpret mode, which would compile plain fusions instead)
     monkeypatch.setattr(fb, "interpret_mode", lambda: False)
+    monkeypatch.setattr(fd, "interpret_mode", lambda: False)
     monkeypatch.setattr(lap, "interpret_mode", lambda: False)
 
     devs = np.asarray(topo.devices[:4])
     mesh = Mesh(devs, ("dz",))
-    # local lz = 32 -> bz=8 -> n_bz=4: a real interior band
-    grid = Grid.make(128, 16, 128, lengths=2.0)
     # x64 (the suite default) poisons Mosaic verification with i64
     # constants — the kernels are f32/i32 by design
     with jax.enable_x64(False):
-        solver = BurgersSolver(
-            BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
-                          adaptive_dt=False, impl="pallas",
-                          overlap="split"),
-            mesh=mesh,
-            decomp=Decomposition.slab("dz"),
-        )
+        if model == "burgers":
+            # local lz = 32 -> bz=8 -> n_bz=4: a real interior band
+            grid = Grid.make(128, 16, 128, lengths=2.0)
+            solver = BurgersSolver(
+                BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                              adaptive_dt=False, impl="pallas",
+                              overlap="split"),
+                mesh=mesh,
+                decomp=Decomposition.slab("dz"),
+            )
+        else:
+            # local lz = 60 -> bz=20 -> n_bz=3
+            grid = Grid.make(128, 16, 240, lengths=2.0)
+            solver = DiffusionSolver(
+                DiffusionConfig(grid=grid, dtype="float32",
+                                impl="pallas", overlap="split"),
+                mesh=mesh,
+                decomp=Decomposition.slab("dz"),
+            )
         fused = solver._fused_stepper()
         assert fused is not None and fused.overlap_split
         refresh, offsets_fn, exch = solver._fused_sharded_ctx(fused)
         assert refresh is None and exch is not None
 
         def block(u, t):
-            return fused.run(u, t, 2, exch=exch)
+            kw = {"exch": exch}
+            if offsets_fn is not None and model == "diffusion":
+                kw["offsets"] = offsets_fn()
+            return fused.run(u, t, 2, **kw)
 
         f = solver._wrap(block)
         u = jax.ShapeDtypeStruct(grid.shape, jnp.float32,
