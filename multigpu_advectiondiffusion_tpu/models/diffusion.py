@@ -254,9 +254,10 @@ class DiffusionSolver(SolverBase):
                     return None
             kwargs = {}
             if self.mesh is not None:
+                # mesh_ok already restricts sharded configs to the 3-D
+                # per-stage stepper, the only class taking this kwarg
                 kwargs["global_shape"] = self.grid.shape
-                if self.grid.ndim == 3 and cfg.impl != "pallas_step":
-                    kwargs["overlap_split"] = self._split_overlap_requested()
+                kwargs["overlap_split"] = self._split_overlap_requested()
             self._cache["fused"] = cls(
                 lshape,
                 self.dtype,

@@ -63,7 +63,11 @@ def _interpret() -> bool:
 
 
 def _face_flux(window, axis, n_faces, flux, variant):
-    """All ``n_faces`` interface fluxes along ``axis`` of a padded slab."""
+    """All ``n_faces`` interface fluxes along ``axis`` of a padded slab.
+
+    Used only for the *leading* (untiled) axis, whose slices are free
+    row selections; tiled-axis sweeps go through :func:`_div_windowed`
+    instead."""
     from multigpu_advectiondiffusion_tpu.ops.weno import (
         _weno5_minus,
         _weno5_plus,
@@ -85,6 +89,27 @@ def _face_flux(window, axis, n_faces, flux, variant):
     return _weno5_minus(*shifts(vp, 0), variant) + _weno5_plus(
         *shifts(vm, 1), variant
     )
+
+
+def _div_windowed(window, axis, n, flux, variant, inv_dx):
+    """Divergence over a slab padded by ``R`` on a *tiled* sweep axis,
+    via whole-array circular rolls (:func:`fused_burgers._div_roll`).
+
+    On the VPU a tiled-axis window slice lowers to a per-operand
+    realignment through the same shift unit a roll uses once — the
+    rolls-beat-slices measurement behind the fused kernels' y sweep.
+    Wrapped positions land only in the R-deep pad band, outside the
+    ``[R, R+n)`` output slice."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+        _div_roll,
+        _split,
+    )
+
+    vp, vm = _split(flux, window)
+    div = _div_roll(vp, vm, axis, inv_dx, variant)
+    idx = [slice(None)] * window.ndim
+    idx[axis] = slice(R, R + n)
+    return div[tuple(idx)]
 
 
 def flux_divergence_pallas(
@@ -127,12 +152,18 @@ def flux_divergence_pallas(
         cp.start()
         cp.wait()
         window = slab[:]
-        h = _face_flux(window, axis, (b if axis == lead_axis else n) + 1,
-                       flux, variant)
+        if axis != lead_axis:
+            div = _div_windowed(window, axis, n, flux, variant, 1.0 / dx)
+            # crop the align_trailing tile padding (div is already
+            # sweep-sliced to n on `axis`)
+            idx = [slice(0, e) for e in (b,) + tuple(shape[1:])]
+            out_ref[:] = div[tuple(idx)]
+            return
+        h = _face_flux(window, axis, b + 1, flux, variant)
         idx_lo = [slice(0, e) for e in (b,) + tuple(shape[1:])]
         idx_hi = list(idx_lo)
-        idx_lo[axis] = slice(0, b if axis == lead_axis else n)
-        idx_hi[axis] = slice(1, (b if axis == lead_axis else n) + 1)
+        idx_lo[axis] = slice(0, b)
+        idx_hi[axis] = slice(1, b + 1)
         out_ref[:] = (h[tuple(idx_hi)] - h[tuple(idx_lo)]) * (1.0 / dx)
 
     slab_shape = (b + halo_lead,) + up.shape[1:]
@@ -168,12 +199,11 @@ def _flux_divergence_2d(
 
     def kernel(up_ref, out_ref):
         window = up_ref[:]
-        h = _face_flux(window, axis, n + 1, flux, variant)
-        idx_lo = [slice(0, e) for e in shape]
-        idx_hi = list(idx_lo)
-        idx_lo[axis] = slice(0, n)
-        idx_hi[axis] = slice(1, n + 1)
-        out_ref[:] = (h[tuple(idx_hi)] - h[tuple(idx_lo)]) * (1.0 / dx)
+        # both 2-D axes are tiled (sublane/lane) -> roll-based sweep
+        div = _div_windowed(window, axis, n, flux, variant, 1.0 / dx)
+        idx = [slice(0, e) for e in shape]
+        idx[axis] = slice(None)
+        out_ref[:] = div[tuple(idx)]
 
     return pl.pallas_call(
         kernel,
