@@ -77,6 +77,14 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "pallas_axis = per-axis slab kernels without the "
                         "fused stepper; pallas_step = whole-step temporal "
                         "blocking)")
+    p.add_argument("--overlap", default="padded",
+                   choices=["padded", "split"],
+                   help="sharded halo schedule: 'padded' exchanges before "
+                        "each stencil, 'split' overlaps interior compute "
+                        "with the in-flight exchange (on z-slab meshes the "
+                        "fused steppers run the three-call interior/edge "
+                        "schedule — the reference's five-stream "
+                        "choreography, main.c:203-260)")
 
 
 def _grid(args, ndim):
@@ -118,6 +126,7 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
         t0=args.t0,
         geometry=geometry,
         impl=args.impl,
+        overlap=args.overlap,
     )
     mesh, decomp = _mesh_decomp(args, grid)
     solver = DiffusionSolver(cfg, mesh=mesh, decomp=decomp)
@@ -154,6 +163,7 @@ def _run_burgers(args, ndim):
         ic=args.ic or "gaussian",
         bc=_bc(args, "edge"),
         impl=args.impl,
+        overlap=args.overlap,
     )
     mesh, decomp = _mesh_decomp(args, grid)
     solver = BurgersSolver(cfg, mesh=mesh, decomp=decomp)
