@@ -2,6 +2,10 @@
 # MultiGPU/Burgers3d_Baseline/run.sh: tEnd=0.4 CFL=0.3, 2x2x4 domain, 200^3, 2 ranks.
 # --fixed-dt reproduces the CUDA drivers' hard-coded unit wave speed;
 # drop it to restore the correct adaptive dt (real global max reduction).
+# --impl pallas --overlap split = the tuned fused kernel with the overlapped
+# halo schedule, in the drivers' native while-t<tEnd mode.
+# Without TPU hardware append --impl xla (CPU runs Pallas interpreted).
 python -m multigpu_advectiondiffusion_tpu.cli burgers3d \
     --t-end 0.4 --cfl 0.3 --lengths 2 2 4 --n 200 200 200 \
+    --impl pallas --overlap split \
     --fixed-dt --mesh dz=2 --save out/multigpu_burgers3d "$@"

@@ -1,5 +1,9 @@
 #!/bin/sh
-# MultiGPU/Diffusion3d_Baseline/run.sh: K=1, L=W=2 H=2, 400x200x200, 1000 iters, 2 ranks
+# MultiGPU/Diffusion3d_Baseline/run.sh: K=1, L=W=2 H=2, 400x200x200, 1000 iters, 2 ranks.
+# --impl pallas --overlap split = the tuned fused kernel with the overlapped
+# halo schedule (the reference's five-stream choreography is always on).
+# Without TPU hardware append --impl xla (CPU runs Pallas interpreted).
 python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
     --K 1.0 --lengths 2 2 2 --n 400 200 200 --iters 1000 \
+    --impl pallas --overlap split \
     --mesh dz=2 --save out/multigpu_diffusion3d "$@"
