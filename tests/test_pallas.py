@@ -268,6 +268,37 @@ def test_fused_diffusion_split_overlap_matches_serialized(devices):
     )
 
 
+def test_fused_diffusion_advance_to_sharded_pencil(devices):
+    """Diffusion run_to on a (dz, dy) pencil mesh exercises the
+    serialized-refresh sharded path (pencils can't use split-overlap,
+    which is z-slab-only) with the offsets operand — bit-identical to
+    the unsharded fused advance_to with the same step count."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(24, 16, 48, lengths=2.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas",
+                          overlap="split")  # split requested, pencil denies
+    ref_solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    )
+    st0 = ref_solver.initial_state()
+    t_end = float(st0.t) + 3.4 * ref_solver.dt
+    ref = ref_solver.advance_to(st0, t_end)
+    solver = DiffusionSolver(
+        cfg, mesh=make_mesh({"dz": 2, "dy": 2}),
+        decomp=Decomposition.of({0: "dz", 1: "dy"}),
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded and not fused.overlap_split
+    out = solver.advance_to(solver.initial_state(), t_end)
+    assert "fused_adv" in solver._cache
+    assert int(out.it) == int(ref.it) == 4
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+
+
 def test_fused_diffusion_ineligible_configs_fall_back():
     """Configs outside the fused kernel's assumptions must quietly use
     the generic path (and still run)."""
