@@ -183,8 +183,12 @@ def main() -> None:
         solver = make_solver()
         state = solver.initial_state()
         if mode == "t_end":
-            # fixed-dt equivalent of `work` steps, landing exactly
-            dt = solver.cfg.cfl * min(solver.grid.spacing)
+            # fixed-dt equivalent of `work` steps, landing exactly —
+            # the solver's own fixed dt, not a re-derivation of its
+            # formula (which would silently diverge for solvers whose
+            # fixed dt is not cfl*min(spacing), e.g. diffusion)
+            dt = solver.dt
+            assert dt is not None, f"{metric}: t_end rows need a fixed dt"
             adv = timed_advance(solver, state, work * dt, reps=5)
             timing, iters = adv.timing, adv.steps
         else:

@@ -256,6 +256,9 @@ def run_solver(
         devices=1 if solver.mesh is None else solver.mesh.devices.size,
         dtype=str(solver.cfg.dtype),
         io_seconds=io_s,
+        engaged=solver.engaged_path(
+            mode="iters" if iters is not None else "t_end"
+        ),
     )
 
     if check_error and hasattr(solver, "error_norms"):

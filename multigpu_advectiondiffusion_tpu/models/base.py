@@ -229,6 +229,77 @@ class SolverBase:
         Overridden by solvers that have a fused Pallas stepper."""
         return None
 
+    def _decline(self, reason: str):
+        """Record why the fused fast path was declined (read by
+        :meth:`engaged_path`) and return ``None`` for the caller to
+        propagate. Solvers call this at every eligibility exit."""
+        self._fused_fallback = reason
+        return None
+
+    def engaged_path(self, mode: str = "iters") -> dict:
+        """Which kernel strategy actually executes for this config.
+
+        The reference's ``PrintSummary`` tells the user what ran
+        (``MultiGPU/Diffusion3d_Baseline/Tools.c:255-269``); without this
+        a ``--impl pallas`` config that fails fused eligibility would
+        silently benchmark the generic path. Keys: ``impl`` (requested),
+        ``stepper`` (what executes: ``fused-stage`` / ``fused-whole-run``
+        / ``fused-step`` / ``per-axis-pallas`` / ``generic-xla``),
+        ``overlap`` (sharded halo schedule actually in effect), and
+        ``fallback`` (reason the fused stepper was declined, or None).
+
+        ``mode`` mirrors the execution dispatch: ``"t_end"`` engages the
+        fused stepper only when it has ``run_to`` (``advance_to``'s extra
+        requirement) — the whole-run/whole-step classes don't, and their
+        t_end runs use the generic loop.
+        """
+        from multigpu_advectiondiffusion_tpu.ops import (
+            is_fused_impl,
+            is_pallas_impl,
+        )
+
+        impl = getattr(self.cfg, "impl", "xla")
+        fused = self._fused_stepper()
+        if fused is not None and mode == "t_end" and not hasattr(
+            fused, "run_to"
+        ):
+            self._fused_fallback = (
+                f"{fused.engaged_label} stepper has no run_to; "
+                "t_end mode runs the generic loop"
+            )
+            fused = None
+        if fused is not None:
+            overlap = None
+            if getattr(fused, "sharded", False):
+                overlap = (
+                    "split"
+                    if getattr(fused, "overlap_split", False)
+                    else "serialized-refresh"
+                )
+            return {
+                "impl": impl,
+                "stepper": fused.engaged_label,
+                "overlap": overlap,
+                "fallback": None,
+            }
+        stepper = "per-axis-pallas" if is_pallas_impl(impl) else "generic-xla"
+        fallback = None
+        if is_fused_impl(impl):
+            fallback = getattr(
+                self, "_fused_fallback", None
+            ) or "config not fused-eligible"
+        overlap = (
+            getattr(self.cfg, "overlap", None)
+            if self.mesh is not None
+            else None
+        )
+        return {
+            "impl": impl,
+            "stepper": stepper,
+            "overlap": overlap,
+            "fallback": fallback,
+        }
+
     def _split_overlap_requested(self) -> bool:
         """``overlap='split'`` with a pure z-slab decomposition — the
         only topology the fused steppers' three-call overlapped schedule
@@ -236,8 +307,12 @@ class SolverBase:
         if self.mesh is None or getattr(self.cfg, "overlap", None) != "split":
             return False
         sizes = dict(self.mesh.shape)
+        # axis_extent, not sizes.get: compound (tuple) mesh-axis entries —
+        # the multihost z layout ('dz_dcn', 'dz_ici') — are never keys of
+        # mesh.shape and would silently read as extent 1
         sharded = [
-            ax for ax, name in self.decomp.axes if sizes.get(name, 1) > 1
+            ax for ax, name in self.decomp.axes
+            if axis_extent(sizes, name) > 1
         ]
         return sharded == [0]
 

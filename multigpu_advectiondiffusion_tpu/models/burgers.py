@@ -71,6 +71,10 @@ class BurgersSolver(SolverBase):
     def __init__(self, cfg: BurgersConfig, mesh=None, decomp=None):
         super().__init__(cfg, mesh=mesh, decomp=decomp)
         self.flux = flux_lib.get(cfg.flux, **dict(cfg.flux_params))
+        # the CUDA-parity fixed step (Burgers3d_Baseline/main.c:193), or
+        # None in adaptive mode — the single definition every consumer
+        # (generic path, fused stepper, bench t_end rows) reads
+        self.dt = None if cfg.adaptive_dt else cfg.cfl * min(cfg.grid.spacing)
 
     def build_local(self, ctx: StepContext) -> LocalPhysics:
         cfg = self.cfg
@@ -118,7 +122,7 @@ class BurgersSolver(SolverBase):
             )
             return LocalPhysics(rhs=rhs, dt_fn=dt_fn)
         # CUDA-parity fixed dt: CFL * dx / 1.0 (Burgers3d_Baseline/main.c:193)
-        return LocalPhysics(rhs=rhs, static_dt=cfg.cfl * min(spacing))
+        return LocalPhysics(rhs=rhs, static_dt=self.dt)
 
     # ------------------------------------------------------------------ #
     # Fully-fused Pallas fast path (single chip, fixed dt, edge BCs)
@@ -140,20 +144,25 @@ class BurgersSolver(SolverBase):
         from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
 
         cfg = self.cfg
-        eligible = (
-            is_fused_impl(cfg.impl)
-            and self.grid.ndim in (2, 3)
-            and cfg.weno_order == 5
-            and cfg.weno_variant in ("js", "z")
-            and cfg.integrator == "ssp_rk3"
-            and (cfg.nu == 0.0 or cfg.laplacian_order == 4)
-            and self.dtype == jnp.float32
-            and all(b.kind == "edge" for b in self.bcs)
-        )
+        self._fused_fallback = None
+        if not is_fused_impl(cfg.impl):
+            return self._decline(f"impl={cfg.impl!r} does not request fusion")
+        if self.grid.ndim not in (2, 3):
+            return self._decline("fused WENO kernels are 2-D/3-D only")
+        if cfg.weno_order != 5 or cfg.weno_variant not in ("js", "z"):
+            return self._decline("fused kernels implement WENO5-JS/Z only")
+        if cfg.integrator != "ssp_rk3":
+            return self._decline("fused kernels bake in SSP-RK3")
+        if cfg.nu != 0.0 and cfg.laplacian_order != 4:
+            return self._decline("fused viscous term is the O4 Laplacian")
+        if self.dtype != jnp.float32:
+            return self._decline("fused kernels are float32-only")
+        if not all(b.kind == "edge" for b in self.bcs):
+            return self._decline("fused ghost discipline needs edge BCs")
         if self.grid.ndim != 3 and self.mesh is not None:
-            eligible = False
-        if not eligible:
-            return None
+            return self._decline(
+                "2-D fused steppers are single-chip (whole-run VMEM)"
+            )
         lshape = (
             self.grid.shape
             if self.mesh is None
@@ -169,23 +178,32 @@ class BurgersSolver(SolverBase):
             if self.mesh is not None and any(
                 lshape[ax] < R for ax, _ in self.decomp.axes
             ):
-                return None
+                return self._decline(
+                    f"a sharded axis is thinner than the WENO5 halo ({R})"
+                )
             # the lane-aligned x layout stores no x ghosts, so an
             # x-sharded mesh has nothing for the ppermute refresh to
             # rewrite — such configs run the generic path
             if self.mesh is not None and 2 in dict(self.decomp.axes):
-                return None
+                return self._decline(
+                    "x-sharded mesh: the lane-aligned layout stores no x "
+                    "ghosts to refresh"
+                )
             # y-rounding is incompatible only with a y-sharded axis
             # (dead columns would be exchanged as neighbor ghosts)
             y_sharded = self.mesh is not None and 1 in dict(self.decomp.axes)
             if not cls.supported(lshape, self.dtype, y_sharded=y_sharded):
-                return None
+                return self._decline(
+                    "no viable VMEM block tiling for this local shape"
+                )
         else:
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (  # noqa: E501
                 FusedBurgers2DStepper as cls,
             )
             if not cls.supported(lshape, self.dtype):
-                return None
+                return self._decline(
+                    "2-D grid exceeds the whole-run VMEM budget"
+                )
         if "fused" not in self._cache:
             spacing = self.grid.spacing
             kwargs = {}
@@ -200,7 +218,7 @@ class BurgersSolver(SolverBase):
                         u, self.flux.df, spacing, cfg.cfl, reduce_max=reduce
                     )
                 else:
-                    kwargs["dt"] = cfg.cfl * min(spacing)
+                    kwargs["dt"] = self.dt
                 self._cache["fused"] = cls(
                     lshape, self.dtype, spacing, self.flux,
                     cfg.weno_variant, cfg.nu, **kwargs,
@@ -214,7 +232,7 @@ class BurgersSolver(SolverBase):
                         u, self.flux.df, spacing, cfg.cfl
                     )
                 else:
-                    kwargs["dt"] = cfg.cfl * min(spacing)
+                    kwargs["dt"] = self.dt
                 self._cache["fused"] = cls(
                     lshape, self.dtype, spacing, self.flux,
                     cfg.weno_variant, cfg.nu, **kwargs,

@@ -213,29 +213,48 @@ class DiffusionSolver(SolverBase):
         )
         from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R
 
-        mesh_ok = self.mesh is None or (
-            self.grid.ndim == 3
-            and cfg.impl != "pallas_step"
+        self._fused_fallback = None
+        if not is_fused_impl(cfg.impl):
+            return self._decline(f"impl={cfg.impl!r} does not request fusion")
+        if cfg.geometry != "cartesian":
+            return self._decline("fused kernels are cartesian-only")
+        if cfg.order != 4:
+            return self._decline("fused kernels bake in the O4 Laplacian")
+        if cfg.integrator != "ssp_rk3":
+            return self._decline("fused kernels bake in SSP-RK3")
+        if cfg.source is not None:
+            return self._decline("source-term hook needs the generic path")
+        if not cfg.reference_parity or cfg.boundary_band < 1:
+            # kernel's face clamp lives inside the non-interior branch;
+            # band 0 would let faces evolve
+            return self._decline(
+                "fused walls need reference_parity with boundary_band >= 1"
+            )
+        if self.grid.ndim not in (2, 3):
+            return self._decline("fused diffusion kernels are 2-D/3-D only")
+        if self.dtype != jnp.float32:
+            return self._decline("fused kernels are float32-only")
+        if not all(b.kind == "dirichlet" for b in bcs) or not all(
+            b.value == bcs[0].value for b in bcs
+        ):
+            return self._decline(
+                "fused walls need uniform Dirichlet BCs on every axis"
+            )
+        if self.mesh is not None:
+            if self.grid.ndim != 3:
+                return self._decline(
+                    "2-D fused steppers are single-chip (whole-run VMEM)"
+                )
+            if cfg.impl == "pallas_step":
+                return self._decline(
+                    "whole-step temporal blocking crosses ghost-refresh "
+                    "points; single-chip only"
+                )
             # every sharded axis must serve the stencil halo from its core
-            and all(lshape[ax] >= R for ax, _ in self.decomp.axes)
-        )
-        eligible = (
-            is_fused_impl(cfg.impl)
-            and mesh_ok
-            and cfg.geometry == "cartesian"
-            and cfg.order == 4
-            and cfg.integrator == "ssp_rk3"
-            and cfg.source is None
-            and cfg.reference_parity
-            and cfg.boundary_band >= 1  # kernel's face clamp lives inside
-            # the non-interior branch; band 0 would let faces evolve
-            and self.grid.ndim in (2, 3)
-            and self.dtype == jnp.float32
-            and all(b.kind == "dirichlet" for b in bcs)
-            and all(b.value == bcs[0].value for b in bcs)
-        )
-        if not eligible:
-            return None
+            if any(lshape[ax] < R for ax, _ in self.decomp.axes):
+                return self._decline(
+                    f"a sharded axis is thinner than the O4 halo ({R})"
+                )
         if "fused" not in self._cache:
             if self.grid.ndim == 3 and cfg.impl == "pallas_step":
                 from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion_step import (  # noqa: E501
@@ -251,7 +270,9 @@ class DiffusionSolver(SolverBase):
                 )
 
                 if not cls.supported(self.grid.shape, self.dtype):
-                    return None
+                    return self._decline(
+                        "2-D grid exceeds the whole-run VMEM budget"
+                    )
             kwargs = {}
             if self.mesh is not None:
                 # mesh_ok already restricts sharded configs to the 3-D

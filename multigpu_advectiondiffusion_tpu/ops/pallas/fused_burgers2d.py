@@ -93,6 +93,8 @@ class FusedBurgers2DStepper:
     called on the padded in-core state before every step) must be given,
     mirroring :class:`fused_burgers.FusedBurgersStepper`."""
 
+    engaged_label = "fused-whole-run"
+
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
                  dt_fn=None):

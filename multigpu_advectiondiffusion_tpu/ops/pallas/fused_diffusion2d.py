@@ -78,6 +78,8 @@ def _masks(padded_shape, interior_shape, band):
 class FusedDiffusion2DStepper:
     """Jit-cached whole-run VMEM stepper for one (grid, dtype, dt)."""
 
+    engaged_label = "fused-whole-run"
+
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value):
         ny, nx = interior_shape

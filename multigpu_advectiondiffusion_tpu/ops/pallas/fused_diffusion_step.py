@@ -153,6 +153,8 @@ class StepFusedDiffusionStepper:
     """Three RK stages per HBM pass; interface mirrors
     ``FusedDiffusionStepper`` (``embed``/``extract``/``run``)."""
 
+    engaged_label = "fused-step"
+
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value, block_z=None):
         nz, ny, nx = interior_shape

@@ -30,6 +30,7 @@ from jax import lax
 
 class FusedStepperBase:
     needs_offsets = False
+    engaged_label = "fused-stage"  # what engaged_path()/PrintSummary report
 
     def _dt_value(self, S):
         raise NotImplementedError

@@ -40,6 +40,11 @@ class RunSummary:
     # host I/O (snapshots/checkpoints) excluded from `seconds`; periodic-
     # output runs would otherwise fold disk time into the solve rate
     io_seconds: Optional[float] = None
+    # which kernel strategy actually executed (SolverBase.engaged_path):
+    # impl requested, stepper engaged, overlap schedule, fallback reason —
+    # the what-ran contract of the reference's PrintSummary
+    # (MultiGPU/Diffusion3d_Baseline/Tools.c:255-269)
+    engaged: Optional[dict] = None
 
     @property
     def num_cells(self) -> int:
@@ -75,6 +80,15 @@ class RunSummary:
         print(f" grid               : {g} ({self.num_cells:,} cells)")
         print(f" devices            : {self.devices} [{jax.default_backend()}]")
         print(f" dtype              : {self.dtype}")
+        if self.engaged is not None:
+            e = self.engaged
+            line = f"{e['stepper']} (impl={e['impl']}"
+            if e.get("overlap"):
+                line += f", overlap={e['overlap']}"
+            line += ")"
+            print(f" kernel path        : {line}")
+            if e.get("fallback"):
+                print(f" fused fallback     : {e['fallback']}")
         print(f" iterations         : {self.iters} x {self.stages} RK stages")
         print(f" dt (last)          : {self.dt:.6e}")
         print(f" simulated time     : {self.t_final:.6f}")
