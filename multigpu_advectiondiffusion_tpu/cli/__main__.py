@@ -63,6 +63,11 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "iters (atomic, CRC-verified)")
     p.add_argument("--checkpoint-keep", type=int, default=0, metavar="N",
                    help="keep only the newest N checkpoints (0 = keep all)")
+    p.add_argument("--checkpoint-sharded", action="store_true",
+                   help="write per-shard checkpoint directories (.ckptd: "
+                        "each process saves only its addressable shards + "
+                        "a layout manifest — no gather to one host; resume "
+                        "reassembles onto any mesh)")
     p.add_argument("--resume", default=None, metavar="CKPT",
                    help="resume from a .ckpt/.npz checkpoint instead of "
                         "the initial condition")
@@ -140,6 +145,7 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       snapshot_every=args.snapshot_every,
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_keep=args.checkpoint_keep,
+                      checkpoint_sharded=args.checkpoint_sharded,
                       resume=args.resume, profile_dir=args.profile)
 
 
@@ -176,6 +182,7 @@ def _run_burgers(args, ndim):
                       snapshot_every=args.snapshot_every,
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_keep=args.checkpoint_keep,
+                      checkpoint_sharded=args.checkpoint_sharded,
                       resume=args.resume, profile_dir=args.profile)
 
 

@@ -96,6 +96,7 @@ def run_solver(
     snapshot_every: int = 0,
     checkpoint_every: int = 0,
     checkpoint_keep: int = 0,
+    checkpoint_sharded: bool = False,
     resume: Optional[str] = None,
     profile_dir: Optional[str] = None,
 ) -> RunSummary:
@@ -115,7 +116,13 @@ def run_solver(
         import jax
         import jax.numpy as jnp
 
-        state = io_utils.load_checkpoint(resume)
+        # sharded checkpoint directories reassemble straight onto this
+        # run's mesh (which may differ from the saving run's) — each
+        # process reads only the regions its shards need
+        state = io_utils.load_checkpoint(
+            resume,
+            sharding=None if solver.mesh is None else solver.sharding(),
+        )
         if tuple(state.u.shape) != tuple(solver.grid.shape):
             raise ValueError(
                 f"checkpoint grid {tuple(state.u.shape)} != configured "
@@ -216,14 +223,26 @@ def run_solver(
                             os.path.join(save_dir, f"snap_{glob_it:06d}.bin"),
                         )
                     if checkpoint_every and done % checkpoint_every == 0:
-                        io_utils.save_checkpoint(
-                            os.path.join(
-                                save_dir, f"checkpoint_{glob_it:06d}.ckpt"
-                            ),
-                            out,
-                            grid=solver.grid,
-                            physics=physics_meta(solver),
-                        )
+                        if checkpoint_sharded:
+                            # per-shard directory: no gather to one host
+                            io_utils.save_checkpoint_sharded(
+                                os.path.join(
+                                    save_dir,
+                                    f"checkpoint_{glob_it:06d}.ckptd",
+                                ),
+                                out,
+                                grid=solver.grid,
+                                physics=physics_meta(solver),
+                            )
+                        else:
+                            io_utils.save_checkpoint(
+                                os.path.join(
+                                    save_dir, f"checkpoint_{glob_it:06d}.ckpt"
+                                ),
+                                out,
+                                grid=solver.grid,
+                                physics=physics_meta(solver),
+                            )
                         io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
                     io_s += time.perf_counter() - io_t0
                 sync(out.u)

@@ -136,9 +136,11 @@ class BurgersSolver(SolverBase):
         a mesh the kernel runs shard-local with ppermute ghost refresh
         between stages (the tuned kernel under MPI,
         ``MultiGPU/Burgers3d_Baseline/main.c:189-317``; x must be
-        unsharded — the lane-aligned layout stores no x ghosts). The
-        2-D whole-run VMEM stepper stays single-chip but serves both dt
-        modes (adaptive via an in-core reduction per step)."""
+        unsharded — the lane-aligned layout stores no x ghosts). In 2-D
+        the single-chip path is the whole-run VMEM stepper (adaptive dt
+        via an in-core reduction per step); under a mesh the per-stage
+        whole-shard kernels take over with the same ghost-refresh
+        choreography (``MultiGPU/Burgers2d_Baseline/main.c:186+``)."""
         import jax.numpy as jnp
 
         from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
@@ -159,10 +161,6 @@ class BurgersSolver(SolverBase):
             return self._decline("fused kernels are float32-only")
         if not all(b.kind == "edge" for b in self.bcs):
             return self._decline("fused ghost discipline needs edge BCs")
-        if self.grid.ndim != 3 and self.mesh is not None:
-            return self._decline(
-                "2-D fused steppers are single-chip (whole-run VMEM)"
-            )
         lshape = (
             self.grid.shape
             if self.mesh is None
@@ -196,13 +194,31 @@ class BurgersSolver(SolverBase):
                 return self._decline(
                     "no viable VMEM block tiling for this local shape"
                 )
-        else:
+        elif self.mesh is None:
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (  # noqa: E501
                 FusedBurgers2DStepper as cls,
             )
             if not cls.supported(lshape, self.dtype):
                 return self._decline(
                     "2-D grid exceeds the whole-run VMEM budget"
+                )
+        else:
+            # the 2-D tuned kernel under the mesh: per-stage whole-shard
+            # kernels with ppermute ghost refresh between stages
+            # (MultiGPU/Burgers2d_Baseline/main.c:186+)
+            from multigpu_advectiondiffusion_tpu.ops.pallas.fused2d_sharded import (  # noqa: E501
+                ShardedFusedBurgers2DStepper as cls,
+            )
+            if any(
+                lshape[ax] < cls.halo for ax, _ in self.decomp.axes
+            ):
+                return self._decline(
+                    f"a sharded axis is thinner than the WENO5 halo "
+                    f"({cls.halo})"
+                )
+            if not cls.supported(lshape, self.dtype):
+                return self._decline(
+                    "2-D shard exceeds the per-stage VMEM budget"
                 )
         if "fused" not in self._cache:
             spacing = self.grid.spacing
@@ -224,13 +240,23 @@ class BurgersSolver(SolverBase):
                     cfg.weno_variant, cfg.nu, **kwargs,
                 )
             else:
+                if self.mesh is not None:
+                    kwargs["global_shape"] = self.grid.shape
                 if cfg.adaptive_dt:
-                    # in-core reduction on the padded state: ghost/slack
-                    # cells are edge replicas, so the full-array max
-                    # equals the interior max (whole_run_adaptive)
-                    kwargs["dt_fn"] = lambda u: advective_dt(
-                        u, self.flux.df, spacing, cfg.cfl
-                    )
+                    if self.mesh is not None:
+                        # interior-view reduction + lax.pmax between steps
+                        reduce = self.mesh_reduce_max()
+                        kwargs["dt_fn"] = lambda u: advective_dt(
+                            u, self.flux.df, spacing, cfg.cfl,
+                            reduce_max=reduce,
+                        )
+                    else:
+                        # in-core reduction on the padded state: ghost/
+                        # slack cells are edge replicas, so the full-array
+                        # max equals the interior max (whole_run_adaptive)
+                        kwargs["dt_fn"] = lambda u: advective_dt(
+                            u, self.flux.df, spacing, cfg.cfl
+                        )
                 else:
                     kwargs["dt"] = self.dt
                 self._cache["fused"] = cls(

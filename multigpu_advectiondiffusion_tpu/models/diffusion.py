@@ -197,11 +197,13 @@ class DiffusionSolver(SolverBase):
         """The fused SSP-RK3 stepper when this config is eligible, else
         ``None`` (generic path). Eligibility mirrors the assumptions the
         kernel bakes in: frozen Dirichlet ghosts/boundary band, static dt,
-        3-D cartesian O4, f32. Under a mesh the 3-D per-stage kernel runs
-        shard-local (ghosts ppermute-refreshed between stages — the tuned
-        kernel under MPI, ``MultiGPU/Diffusion3d_Baseline/main.c:189-303``);
-        the whole-step and whole-run variants stay single-chip (their
-        temporal blocking crosses the points where ghosts must refresh)."""
+        2-D/3-D cartesian O4, f32. Under a mesh the per-stage kernels
+        (3-D z-slab grid; 2-D whole-shard) run shard-local — ghosts
+        ppermute-refreshed between stages, the tuned kernel under MPI
+        (``MultiGPU/Diffusion3d_Baseline/main.c:189-303``,
+        ``Diffusion2d_Baseline/main.c:189-280``); the whole-step and
+        whole-run variants stay single-chip (their temporal blocking
+        crosses the points where ghosts must refresh)."""
         cfg = self.cfg
         bcs = self.bcs
         from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
@@ -232,8 +234,18 @@ class DiffusionSolver(SolverBase):
             )
         if self.grid.ndim not in (2, 3):
             return self._decline("fused diffusion kernels are 2-D/3-D only")
-        if self.dtype != jnp.float32:
-            return self._decline("fused kernels are float32-only")
+        if self.dtype == jnp.bfloat16:
+            # bf16-storage/f32-compute rung: HBM bytes halved (the
+            # ref-grid row is HBM-roof-bound) — 3-D per-stage only.
+            # Measured 1.6x the f32 rate BUT accuracy-rejected for
+            # stability-dt workloads (updates round away; PARITY.md) —
+            # an explicit opt-in, never a silent default.
+            if self.grid.ndim != 3 or cfg.impl == "pallas_step":
+                return self._decline(
+                    "bf16 storage exists only for the 3-D per-stage stepper"
+                )
+        elif self.dtype != jnp.float32:
+            return self._decline("fused kernels are float32/bf16-storage only")
         if not all(b.kind == "dirichlet" for b in bcs) or not all(
             b.value == bcs[0].value for b in bcs
         ):
@@ -241,10 +253,6 @@ class DiffusionSolver(SolverBase):
                 "fused walls need uniform Dirichlet BCs on every axis"
             )
         if self.mesh is not None:
-            if self.grid.ndim != 3:
-                return self._decline(
-                    "2-D fused steppers are single-chip (whole-run VMEM)"
-                )
             if cfg.impl == "pallas_step":
                 return self._decline(
                     "whole-step temporal blocking crosses ghost-refresh "
@@ -264,7 +272,7 @@ class DiffusionSolver(SolverBase):
                 from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (  # noqa: E501
                     FusedDiffusionStepper as cls,
                 )
-            else:
+            elif self.mesh is None:
                 from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion2d import (  # noqa: E501
                     FusedDiffusion2DStepper as cls,
                 )
@@ -273,12 +281,25 @@ class DiffusionSolver(SolverBase):
                     return self._decline(
                         "2-D grid exceeds the whole-run VMEM budget"
                     )
+            else:
+                # the 2-D tuned kernel under the mesh: per-stage
+                # whole-shard kernels with ppermute ghost refresh between
+                # stages (MultiGPU/Diffusion2d_Baseline/main.c:189-280)
+                from multigpu_advectiondiffusion_tpu.ops.pallas.fused2d_sharded import (  # noqa: E501
+                    ShardedFusedDiffusion2DStepper as cls,
+                )
+
+                if not cls.supported(lshape, self.dtype):
+                    return self._decline(
+                        "2-D shard exceeds the per-stage VMEM budget"
+                    )
             kwargs = {}
             if self.mesh is not None:
-                # mesh_ok already restricts sharded configs to the 3-D
-                # per-stage stepper, the only class taking this kwarg
                 kwargs["global_shape"] = self.grid.shape
-                kwargs["overlap_split"] = self._split_overlap_requested()
+                if self.grid.ndim == 3:
+                    # only the 3-D per-stage stepper has the three-call
+                    # split-overlap schedule
+                    kwargs["overlap_split"] = self._split_overlap_requested()
             self._cache["fused"] = cls(
                 lshape,
                 self.dtype,

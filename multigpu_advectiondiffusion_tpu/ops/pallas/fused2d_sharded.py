@@ -1,0 +1,350 @@
+"""Per-stage fused SSP-RK3 kernels for *sharded* 2-D grids.
+
+The reference runs its (only) tuned 2-D kernels under MPI — the 2-D
+MultiGPU baselines are half of its capability-target projects
+(``MultiGPU/Diffusion2d_Baseline/main.c:64,189-280``,
+``MultiGPU/Burgers2d_Baseline/main.c:186+``). The single-chip TPU design
+for these grids is the whole-run VMEM stepper
+(:mod:`fused_diffusion2d`, :mod:`fused_burgers2d`), but its temporal
+blocking crosses the points where sharded-axis ghosts must refresh, so
+it cannot run under a mesh.
+
+This module is the sharded counterpart, on the 3-D per-stage pattern
+(:mod:`fused_diffusion`, :mod:`fused_burgers`): the state lives in a
+persistent padded tile-aligned layout, each RK stage is ONE Pallas
+kernel over the whole local shard (a 2-D shard is far under VMEM), and
+the caller refreshes sharded-axis ghosts by ``ppermute`` between stages
+(``parallel.halo.make_ghost_refresh``). Global wall/edge decisions use
+*global* coordinates from an SMEM offsets operand, exactly like the 3-D
+stage kernels.
+
+Because a 2-D shard fits VMEM whole, there is no block grid and no
+manual DMA pipeline: operands use whole-array VMEM block specs, stages
+are pure calls with the output aliased onto the retiring buffer of the
+three-buffer RK choreography (``T1 = s1(S)``, ``T2 = s2(T1, S)``,
+``S' = s3(T2, S) -> S``).
+
+Ghost discipline:
+
+* Burgers: every non-interior cell at a *global* domain edge is an edge
+  replica of the nearest interior cell (``WENO5resAdv_X.m:53``),
+  re-synthesized after every stage; sharded-axis ghost cells hold
+  neighbor data and are rewritten by the between-stage refresh. Dead
+  rounding slack is never read by interior outputs (stencil reads reach
+  exactly the ``R``-deep ghosts).
+* Diffusion: reference-parity walls — the RHS mask freezes the global
+  boundary band, global faces re-clamp to the Dirichlet value
+  (``Laplace3d.m:21``, ``heat3d.m:65-67``); non-interior cells pass the
+  stage input through, so buffer ghosts stay whatever the refresh wrote.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multigpu_advectiondiffusion_tpu.ops.flux import Flux
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+    _div_roll,
+    _split,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (
+    _LIVE_BUFFERS as _BURGERS_LIVE,
+    _VMEM_BUDGET as _BURGERS_BUDGET,
+    _laplacian_2d,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (
+    R as R_WENO,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+    _STAGES,
+    _shift,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion2d import (
+    _LIVE_BUFFERS as _DIFF_LIVE,
+    _VMEM_BUDGET as _DIFF_BUDGET,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    LANE,
+    O4_COEFFS,
+    SUBLANE,
+    compiler_params,
+    fits_vmem,
+    interpret_mode,
+    round_up,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R as R_LAP
+from multigpu_advectiondiffusion_tpu.ops.pallas.stepper_base import (
+    FusedStepperBase,
+)
+
+
+def _global_coords(shape, offs_ref, halo):
+    """Global interior indices of every padded cell of this shard."""
+    gy = lax.broadcasted_iota(jnp.int32, shape, 0) - halo + offs_ref[0]
+    gx = lax.broadcasted_iota(jnp.int32, shape, 1) - halo + offs_ref[1]
+    return gy, gx
+
+
+def _edge_fill_global(rk, offs_ref, local_shape, global_shape, halo):
+    """Edge-replicate cells outside the *global* domain.
+
+    The replica source sits at a static local index (first/last interior
+    row/column): the mask can only be true on the shard that owns the
+    corresponding global edge, where that index holds the right value —
+    on every other shard the mask is all-false and the source value is
+    discarded. Sharded-axis ghosts with valid global coordinates keep
+    their computed values; the between-stage ppermute refresh overwrites
+    them."""
+    ly, lx = local_shape
+    NY, NX = global_shape
+    gy, gx = _global_coords(rk.shape, offs_ref, halo)
+    t = jnp.where(gx < 0, rk[:, halo : halo + 1], rk)
+    t = jnp.where(gx >= NX, t[:, halo + lx - 1 : halo + lx], t)
+    t = jnp.where(gy < 0, t[halo : halo + 1, :], t)
+    return jnp.where(gy >= NY, t[halo + ly - 1 : halo + ly, :], t)
+
+
+def _burgers_stage(v, u, dt, offs_ref, *, a, b, local_shape, global_shape,
+                   inv_dx, nu_scales, flux, variant):
+    """One RK stage of 2-D Burgers/WENO5 over the whole padded shard.
+
+    Same op sequence as the single-chip whole-run stage
+    (``fused_burgers2d._stage``) so the sharded run reproduces it
+    per-cell; only the ghost synthesis is keyed on global coordinates."""
+    vp, vm = _split(flux, v)
+    rhs = -(
+        _div_roll(vp, vm, 0, inv_dx[0], variant)
+        + _div_roll(vp, vm, 1, inv_dx[1], variant)
+    )
+    if nu_scales is not None:
+        rhs = rhs + _laplacian_2d(v, nu_scales)
+    dt = dt.astype(v.dtype)
+    rk = b * (v + dt * rhs) if a == 0.0 else a * u + b * (v + dt * rhs)
+    return _edge_fill_global(
+        rk.astype(v.dtype), offs_ref, local_shape, global_shape, R_WENO
+    )
+
+
+def _diffusion_stage(v, u, dt, offs_ref, *, a, b, global_shape, scales,
+                     band, bc_value):
+    """One RK stage of 2-D O4 diffusion over the whole padded shard,
+    reference-parity walls in global coordinates (``Laplace3d.m:21``,
+    ``heat3d.m:65-67``)."""
+    dtype = v.dtype
+    acc = None
+    for axis in range(2):
+        for j, c in enumerate(O4_COEFFS):
+            term = _shift(v, j - R_LAP, axis) * jnp.asarray(
+                c * scales[axis], dtype
+            )
+            acc = term if acc is None else acc + term
+    dt = dt.astype(dtype)
+    rk = b * (v + dt * acc) if a == 0.0 else a * u + b * (v + dt * acc)
+    NY, NX = global_shape
+    gy, gx = _global_coords(v.shape, offs_ref, R_LAP)
+
+    def between(g, n):
+        return (g >= band) & (g < n - band)
+
+    interior = between(gy, NY) & between(gx, NX)
+    face = (gy == 0) | (gy == NY - 1) | (gx == 0) | (gx == NX - 1)
+    frozen = jnp.where(face, jnp.asarray(bc_value, dtype), v)
+    return jnp.where(interior, rk, frozen)
+
+
+def _make_stage(padded_shape, dtype, stage_fn, *, a, b, u_source):
+    """One whole-shard RK-stage ``pallas_call``.
+
+    ``u_source``: ``"none"`` (stage 1, ``a == 0`` — the trailing operand
+    is only the donation target), ``"operand"`` (separate ``u`` input
+    plus a donation target), or ``"alias_u"`` (the in-place final stage:
+    ``u`` is the last operand and the output is aliased onto it).
+    Operand order: ``dt (SMEM (1,))``, ``offsets (SMEM (2,))``, ``v``,
+    then per ``u_source``; the output is always aliased onto the last
+    operand.
+    """
+    use_u = u_source != "none"
+    has_tgt = u_source != "alias_u"
+
+    def kernel(*refs):
+        dt_ref, offs_ref, v_ref, *rest = refs
+        out_ref = rest[-1]
+        u = rest[0][...] if use_u else None
+        out_ref[...] = stage_fn(
+            v_ref[...], u, dt_ref[0], offs_ref, a=a, b=b
+        )
+
+    n_in = 3 + (1 if use_u else 0) + (1 if has_tgt else 0)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+    in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)] * (n_in - 2)
+    return pl.pallas_call(
+        kernel,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
+        input_output_aliases={n_in - 1: 0},
+        compiler_params=None if interpret_mode() else compiler_params(),
+        interpret=interpret_mode(),
+    )
+
+
+class _Sharded2DStepperBase(FusedStepperBase):
+    """Shared plumbing: three-buffer step choreography with per-stage
+    ghost refresh, run()/run_to() from :class:`FusedStepperBase`."""
+
+    needs_offsets = True  # global edge/wall decisions
+    overlap_split = False
+
+    def _build_step(self, stage_fn, dtype):
+        sources = ("none", "operand", "alias_u")
+        s1, s2, s3 = (
+            _make_stage(
+                self.padded_shape, dtype, stage_fn, a=a, b=b, u_source=src
+            )
+            for (a, b), src in zip(_STAGES, sources)
+        )
+
+        def step(S, T1, T2, dt_arr, offsets=None, refresh=None, exch=None):
+            del exch
+            # an all-extent-1 mesh builds this stepper unsharded: no
+            # refresh/offsets arrive, and this shard IS the global block
+            offs = (
+                offsets
+                if offsets is not None
+                else jnp.zeros((len(self.interior_shape),), jnp.int32)
+            )
+            fix = refresh if refresh is not None else (lambda P: P)
+            T1 = fix(s1(dt_arr, offs, S, T1))
+            T2 = fix(s2(dt_arr, offs, T1, S, T2))
+            S = fix(s3(dt_arr, offs, T2, S))
+            return S, T1, T2
+
+        self._step = step
+
+    def extract(self, S):
+        h = self.halo
+        ly, lx = self.interior_shape
+        return lax.slice(S, (h, h), (h + ly, h + lx))
+
+
+class ShardedFusedBurgers2DStepper(_Sharded2DStepperBase):
+    """Per-stage fused 2-D Burgers/WENO5 for shard-local execution inside
+    ``shard_map`` — the tuned 2-D kernel under the mesh, matching the
+    reference's MPI deployment of its 2-D kernels
+    (``MultiGPU/Burgers2d_Baseline/main.c:186+``). Serves both dt modes:
+    fixed (CUDA parity) and adaptive (``max|f'(u)|`` + ``lax.pmax``
+    between steps through the runtime SMEM dt scalar)."""
+
+    halo = R_WENO
+    core_offsets = (R_WENO, R_WENO)
+
+    def __init__(self, interior_shape, dtype, spacing, flux: Flux,
+                 variant: str, nu: float, dt: float | None = None,
+                 dt_fn=None, global_shape=None):
+        if (dt is None) == (dt_fn is None):
+            raise ValueError("provide exactly one of dt/dt_fn")
+        ly, lx = interior_shape
+        self.interior_shape = tuple(interior_shape)
+        self.global_shape = tuple(global_shape or interior_shape)
+        self.sharded = self.global_shape != self.interior_shape
+        self.padded_shape = (
+            round_up(ly + 2 * R_WENO, SUBLANE),
+            round_up(lx + 2 * R_WENO, LANE),
+        )
+        self.dtype = jnp.dtype(dtype)
+        nu_scales = None
+        if nu:
+            nu_scales = tuple(
+                float(nu) / (12.0 * spacing[i] * spacing[i]) for i in range(2)
+            )
+        stage_fn = functools.partial(
+            _burgers_stage,
+            local_shape=self.interior_shape,
+            global_shape=self.global_shape,
+            inv_dx=tuple(1.0 / spacing[i] for i in range(2)),
+            nu_scales=nu_scales,
+            flux=flux,
+            variant=variant,
+        )
+        self._build_step(stage_fn, self.dtype)
+        self.dt = None if dt is None else float(dt)
+        self._dt_fn = dt_fn
+
+    @staticmethod
+    def supported(interior_shape, dtype) -> bool:
+        return fits_vmem(
+            interior_shape, R_WENO, _BURGERS_LIVE,
+            jnp.dtype(dtype).itemsize, budget=_BURGERS_BUDGET,
+        )
+
+    def embed(self, u):
+        ly, lx = self.interior_shape
+        py, px = self.padded_shape
+        return jnp.pad(
+            u.astype(self.dtype),
+            ((R_WENO, py - ly - R_WENO), (R_WENO, px - lx - R_WENO)),
+            mode="edge",
+        )
+
+    def _dt_value(self, S):
+        if self.dt is not None:
+            return jnp.asarray(self.dt, jnp.float32)
+        # interior view; the solver's dt_fn carries the lax.pmax
+        return self._dt_fn(self.extract(S)).astype(jnp.float32)
+
+
+class ShardedFusedDiffusion2DStepper(_Sharded2DStepperBase):
+    """Per-stage fused 2-D O4 diffusion for shard-local execution inside
+    ``shard_map`` — the tuned 2-D kernel under the mesh
+    (``MultiGPU/Diffusion2d_Baseline/main.c:189-280``), reference-parity
+    global walls via the offsets operand."""
+
+    halo = R_LAP
+    core_offsets = (R_LAP, R_LAP)
+
+    def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
+                 band, bc_value, global_shape=None):
+        ly, lx = interior_shape
+        self.interior_shape = tuple(interior_shape)
+        self.global_shape = tuple(global_shape or interior_shape)
+        self.sharded = self.global_shape != self.interior_shape
+        self.padded_shape = (
+            round_up(ly + 2 * R_LAP, SUBLANE),
+            round_up(lx + 2 * R_LAP, LANE),
+        )
+        self.dtype = jnp.dtype(dtype)
+        self.bc_value = float(bc_value)
+        stage_fn = functools.partial(
+            _diffusion_stage,
+            global_shape=self.global_shape,
+            scales=tuple(
+                float(diffusivity[i]) / (12.0 * spacing[i] * spacing[i])
+                for i in range(2)
+            ),
+            band=band,
+            bc_value=self.bc_value,
+        )
+        self._build_step(stage_fn, self.dtype)
+        self.dt = float(dt)
+
+    @staticmethod
+    def supported(interior_shape, dtype) -> bool:
+        return fits_vmem(
+            interior_shape, R_LAP, _DIFF_LIVE,
+            jnp.dtype(dtype).itemsize, budget=_DIFF_BUDGET,
+        )
+
+    def embed(self, u):
+        full = jnp.full(self.padded_shape, self.bc_value, self.dtype)
+        return lax.dynamic_update_slice(
+            full, u.astype(self.dtype), (R_LAP, R_LAP)
+        )
+
+    def _dt_value(self, S):
+        return jnp.asarray(self.dt, jnp.float32)

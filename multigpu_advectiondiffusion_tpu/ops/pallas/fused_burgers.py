@@ -247,7 +247,16 @@ def _div_roll(vp, vm, axis, inv_dx, variant):
 
 
 def _div_x(vp, vm, inv_dx, variant):
-    """Flux divergence along x (lanes) of the core box."""
+    """Flux divergence along x (lanes) of the core box.
+
+    Lane rolls, deliberately: routing this sweep through an in-VMEM
+    transpose so the reconstruction runs on (cheaper) sublane rolls was
+    built and measured at 512^3 — both as 3 transposes (vp/vm in,
+    divergence out) and as 2 (v once, fluxes re-split in transposed
+    space) — and ties the lane-roll rate to within 0.3% at the best
+    block for each strategy: the transposes ride the same VPU permute
+    unit and cost exactly the lane-vs-sublane premium they remove.
+    Measured rejection table in PARITY.md."""
     return _div_roll(vp, vm, 2, inv_dx, variant)
 
 

@@ -108,6 +108,7 @@ def _stage_kernel(
     kz_base: int = 0,
     n_blocks_grid: int | None = None,
     ghost_src: str | None = None,
+    compute_dtype=None,
 ):
     """One z-block of one RK stage, 2-slot double-buffered.
 
@@ -201,7 +202,15 @@ def _stage_kernel(
     for cp in copy_v(k, slot):
         cp.wait()
 
-    v = vs[slot]
+    # bf16-storage rung: the state lives (and moves through HBM) at half
+    # the bytes; arithmetic runs in ``compute_dtype`` (f32) so the RK
+    # accumulation doesn't lose the stencil's cancellation digits
+    stored = vs[slot]
+    v = (
+        stored
+        if compute_dtype is None
+        else stored.astype(jnp.dtype(compute_dtype))
+    )
     vc = v[R : R + bz]  # stage input, core z-rows, full y/x width
     dtype = v.dtype
     dt = dt_ref[0].astype(dtype)
@@ -217,10 +226,11 @@ def _stage_kernel(
             term = (v[j : j + bz] if axis == 0 else _shift(vc, j - R, axis)) * coef
             acc = term if acc is None else acc + term
 
+    u_in = None if us is None else us[slot].astype(dtype)
     rk = (
         b * (vc + dt * acc)
         if a == 0.0
-        else a * us[slot] + b * (vc + dt * acc)
+        else a * u_in + b * (vc + dt * acc)
     )
 
     # Global interior-cell indices of this block (ghost offset already
@@ -256,7 +266,7 @@ def _stage_kernel(
     def _():
         copy_w(k - 2, slot).wait()
 
-    res[slot] = jnp.where(interior, rk, frozen)
+    res[slot] = jnp.where(interior, rk, frozen).astype(stored.dtype)
     copy_w(k, slot).start()
 
     @pl.when(k == n_blocks_grid - 1)
@@ -268,7 +278,7 @@ def _stage_kernel(
 
 def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b,
                 band, bc_value, u_source, global_shape=None, sharded=False,
-                role=None):
+                role=None, compute_dtype=None):
     """Build one fused RK-stage call; output aliased onto the last operand.
 
     ``u_source``: where the step-input ``u`` (the ``a*u`` term) is read
@@ -317,6 +327,7 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, scales, a, b,
         kz_base=kz_base,
         n_blocks_grid=n_grid,
         ghost_src=ghost_src,
+        compute_dtype=compute_dtype,
     )
 
     def kernel(*refs):
@@ -408,6 +419,12 @@ class FusedDiffusionStepper(FusedStepperBase):
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
         self.dtype = jnp.dtype(dtype)
+        # bf16-storage rung: state/DMA at 2 B/cell (the ref-grid row is
+        # measured at 85-92% of HBM pin bandwidth — bytes are the only
+        # remaining lever, PARITY.md), arithmetic in f32
+        compute_dtype = (
+            jnp.float32 if self.dtype == jnp.bfloat16 else None
+        )
         self.bc_value = float(bc_value)
         row_bytes = _aligned_row_bytes_3d((nz, ny, nx), self.dtype.itemsize)
         # VMEM model calibrated on v5e at the bench grid (row =
@@ -444,9 +461,12 @@ class FusedDiffusionStepper(FusedStepperBase):
         # nz rounded up to a block multiple (== nz when sharded: both
         # branches above guarantee an exact divisor there)
         nz_eff = -(-nz // bz) * bz
+        # narrow dtypes pack more rows per native (sublane, 128) tile —
+        # bf16's tile is (16, 128) — so the y padding rounds accordingly
+        sub = SUBLANE * max(1, 4 // self.dtype.itemsize)
         self.padded_shape = (
             nz_eff + 2 * R,
-            round_up(ny + 2 * R, SUBLANE),
+            round_up(ny + 2 * R, sub),
             round_up(nx + 2 * R, LANE),
         )
         scales = [
@@ -467,7 +487,7 @@ class FusedDiffusionStepper(FusedStepperBase):
                     bz=bz, scales=scales, a=a, b=b,
                     band=band, bc_value=float(bc_value), u_source=src,
                     global_shape=self.global_shape, sharded=self.sharded,
-                    role=role,
+                    role=role, compute_dtype=compute_dtype,
                 )
                 for (a, b), src in zip(_STAGES, sources)
             )

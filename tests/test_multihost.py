@@ -171,6 +171,76 @@ for name, cfg_cls, solver_cls, kw, tol in (
     )
     assert worst <= tol, (name, worst, tol)
     print(f"proc {pid}: {name} ok (worst {worst:.2e})", flush=True)
+
+# ---- the FUSED steppers across the real process boundary: ppermute
+# ghost refresh, the split-overlap exch, the adaptive-dt pmax, and the
+# 2-D per-stage kernels all riding gloo over the DCN axis — the
+# reference's only deployment mode is its tuned kernels under mpirun
+# (MultiGPU/*/run.sh) ----
+ulp = 32 * np.finfo(np.float32).eps
+fused_cases = (
+    # serialized refresh + global wall offsets (diffusion is bitwise)
+    ("diffusion3d-fused", DiffusionSolver,
+     DiffusionConfig(grid=grid, dtype="float32", impl="pallas"),
+     decomp, 0.0, False),
+    # adaptive dt: the pmax wave-speed reduction crosses processes
+    ("burgers3d-fused-adaptive", BurgersSolver,
+     BurgersConfig(grid=grid, dtype="float32", nu=1e-5, impl="pallas"),
+     decomp, ulp, False),
+    # split overlap: the exchanged z-slab operands cross the DCN axis
+    # while interior stage kernels run (lz=9 -> bz=3, n_bz=3)
+    ("burgers3d-fused-split", BurgersSolver,
+     BurgersConfig(grid=Grid.make(8, 8, 72, lengths=2.0),
+                   dtype="float32", nu=1e-5, adaptive_dt=False,
+                   impl="pallas", overlap="split"),
+     decomp, ulp, True),
+    # 2-D per-stage whole-shard kernels (the 2-D MultiGPU baselines'
+    # tuned-kernel-under-MPI configuration)
+    ("burgers2d-fused", BurgersSolver,
+     BurgersConfig(grid=Grid.make(24, 24, lengths=2.0),
+                   dtype="float32", nu=1e-4, impl="pallas"),
+     Decomposition.of({0: ("dz_dcn", "dz_ici")}), ulp, False),
+)
+for name, solver_cls, cfg, dec, tol, want_split in fused_cases:
+    solver = solver_cls(cfg, mesh=mesh, decomp=dec)
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded, (name, solver._fused_fallback)
+    assert getattr(fused, "overlap_split", False) == want_split, name
+    out = solver.run(solver.initial_state(), 3)
+    assert ("fused_run", 3) in solver._cache, (name, "fused path not engaged")
+    ref_solver = solver_cls(cfg)
+    assert ref_solver._fused_stepper() is not None, name
+    ref = np.asarray(ref_solver.run(ref_solver.initial_state(), 3).u)
+    scale = max(float(np.abs(ref).max()), 1e-30)
+    worst = max(
+        float(np.abs(np.asarray(sh.data) - ref[sh.index]).max())
+        for sh in out.u.addressable_shards
+    )
+    assert worst <= tol * scale or worst <= tol, (name, worst, tol)
+    print(f"proc {pid}: {name} ok (worst {worst:.2e})", flush=True)
+
+# ---- per-shard checkpoint across the process boundary: each process
+# writes ONLY its addressable shards (+ manifest), then the state is
+# reassembled onto the same mesh — no gather to one host at any point ----
+from jax.experimental import multihost_utils
+from multigpu_advectiondiffusion_tpu.utils import io as tio
+
+ckdir = sys.argv[4]
+cksolver = DiffusionSolver(
+    DiffusionConfig(grid=grid, dtype="float32"), mesh=mesh, decomp=decomp)
+ckstate = cksolver.run(cksolver.initial_state(), 2)
+tio.save_checkpoint_sharded(ckdir, ckstate, grid=grid)
+multihost_utils.sync_global_devices("ckpt-written")
+back = tio.load_checkpoint_sharded(ckdir, sharding=cksolver.sharding())
+assert float(back.t) == float(ckstate.t) and int(back.it) == int(ckstate.it)
+want = {tuple(str(s) for s in sh.index): np.asarray(sh.data)
+        for sh in ckstate.u.addressable_shards}
+got = {tuple(str(s) for s in sh.index): np.asarray(sh.data)
+       for sh in back.u.addressable_shards}
+assert want.keys() == got.keys()
+for k in want:
+    assert np.array_equal(want[k], got[k]), k
+print(f"proc {pid}: sharded-checkpoint ok", flush=True)
 print(f"proc {pid}: MULTIPROC-OK", flush=True)
 '''
 
@@ -181,10 +251,12 @@ def test_two_process_distributed_execution(tmp_path):
     devices each, joined by ``multihost.initialize``; ``hybrid_mesh``
     places the DCN axis on process granules; the unchanged sharded
     solvers run with ppermute halo hops (and the adaptive-dt pmax)
-    crossing the process boundary over gloo. Every process's local
-    shards must match a locally-computed unsharded reference —
-    bit-exactly for diffusion, to the documented WENO ulp bound for
-    Burgers."""
+    crossing the process boundary over gloo — including the FUSED
+    steppers (serialized ghost refresh, the split-overlap exch, and the
+    2-D per-stage kernels), the reference's mpirun-plus-tuned-kernels
+    deployment mode. Every process's local shards must match a
+    locally-computed unsharded reference — bit-exactly for diffusion,
+    to the documented WENO ulp bound for Burgers."""
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
@@ -199,7 +271,8 @@ def test_two_process_distributed_execution(tmp_path):
     try:
         procs = [
             subprocess.Popen(
-                [sys.executable, str(script), str(i), str(port), REPO],
+                [sys.executable, str(script), str(i), str(port), REPO,
+                 str(tmp_path / "ckpt.ckptd")],
                 stdout=handles[i],
                 stderr=subprocess.STDOUT,
                 text=True,

@@ -739,6 +739,205 @@ def test_fused_burgers2d_run_matches_xla(kw):
         assert outs["pallas"][1] == outs["xla"][1]
 
 
+# --------------------------------------------------------------------- #
+# Sharded 2-D fused path (fused2d_sharded): the tuned 2-D kernel under a
+# mesh — per-stage whole-shard kernels + ppermute ghost refresh, matching
+# the reference's MPI deployment of its 2-D kernels
+# (MultiGPU/Diffusion2d_Baseline/main.c:189-280, Burgers2d_Baseline/
+# main.c:186+).
+# --------------------------------------------------------------------- #
+
+_DECOMPS_2D = [
+    ({"dy": 4}, {0: "dy"}),  # reference-style slab (outer axis)
+    ({"dx": 4}, {1: "dx"}),  # lane-axis slab
+    ({"dy": 2, "dx": 2}, {0: "dy", 1: "dx"}),  # pencil
+]
+
+
+@pytest.mark.parametrize("mesh_axes,decomp_map", _DECOMPS_2D,
+                         ids=["slab-y", "slab-x", "pencil"])
+def test_fused2d_sharded_diffusion_bit_identical(devices, mesh_axes,
+                                                 decomp_map):
+    """The per-stage 2-D diffusion kernel shard-local under shard_map
+    (global wall masks via the offsets operand, ppermute ghost refresh
+    between stages) must reproduce the single-chip whole-run fused
+    stepper bit-for-bit — identical per-cell op sequence over identical
+    values."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 32, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    ref_solver = DiffusionSolver(cfg)
+    assert type(ref_solver._fused_stepper()).__name__ == (
+        "FusedDiffusion2DStepper"
+    )
+    ref = ref_solver.run(ref_solver.initial_state(), 8)
+    solver = DiffusionSolver(
+        cfg, mesh=make_mesh(mesh_axes), decomp=Decomposition.of(decomp_map)
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded, solver._fused_fallback
+    assert type(fused).__name__ == "ShardedFusedDiffusion2DStepper"
+    out = solver.run(solver.initial_state(), 8)
+    assert float(jnp.max(jnp.abs(ref.u - out.u))) == 0.0
+
+
+@pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
+@pytest.mark.parametrize("mesh_axes,decomp_map", _DECOMPS_2D,
+                         ids=["slab-y", "slab-x", "pencil"])
+def test_fused2d_sharded_burgers_matches_unsharded(devices, mesh_axes,
+                                                   decomp_map, adaptive):
+    """The per-stage 2-D Burgers kernel under the mesh (both dt modes;
+    adaptive rides the pmax reduction between steps) must reproduce the
+    single-chip whole-run fused stepper to the documented interpret-mode
+    ulp bound, with identical accumulated t."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 32, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, nu=1e-4, dtype="float32",
+                        adaptive_dt=adaptive, impl="pallas")
+    ref_solver = BurgersSolver(cfg)
+    assert type(ref_solver._fused_stepper()).__name__ == (
+        "FusedBurgers2DStepper"
+    )
+    ref = ref_solver.run(ref_solver.initial_state(), 6)
+    solver = BurgersSolver(
+        cfg, mesh=make_mesh(mesh_axes), decomp=Decomposition.of(decomp_map)
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded, solver._fused_fallback
+    assert type(fused).__name__ == "ShardedFusedBurgers2DStepper"
+    out = solver.run(solver.initial_state(), 6)
+    _assert_fused_close(out.u, ref.u)
+    np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
+
+
+@pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
+def test_fused2d_sharded_burgers_advance_to(devices, adaptive):
+    """Sharded 2-D t_end mode runs the fused run_to (trimmed last step
+    through the runtime SMEM dt) and reproduces the generic path's
+    trajectory, landing time, and step count — a capability the
+    single-chip whole-run stepper doesn't have (no run_to)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 32, lengths=2.0)
+    mesh_axes, decomp_map = {"dy": 4}, {0: "dy"}
+    t_end = 0.05  # ~4.5 steps at this CFL: exercises the trimmed step
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, cfl=0.3, nu=1e-4, dtype="float32",
+                            adaptive_dt=adaptive, impl=impl)
+        solver = BurgersSolver(
+            cfg, mesh=make_mesh(mesh_axes),
+            decomp=Decomposition.of(decomp_map),
+        )
+        st = solver.advance_to(solver.initial_state(), t_end)
+        if impl == "pallas":
+            assert "fused_adv" in solver._cache, "fused t_end not engaged"
+        outs[impl] = (np.asarray(st.u), float(st.t), int(st.it))
+    scale = float(np.max(np.abs(outs["xla"][0])))
+    np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
+                               rtol=2e-5, atol=2e-6 * scale)
+    np.testing.assert_allclose(outs["pallas"][1], t_end, rtol=1e-6)
+    assert outs["pallas"][2] == outs["xla"][2] > 0
+
+
+def test_fused2d_sharded_diffusion_run_to_matches_run(devices):
+    """Sharded 2-D diffusion run_to landing exactly on n*dt must agree
+    with the fixed-count fused run of the same n."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 32, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    mesh = make_mesh({"dy": 4})
+    a = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.of({0: "dy"}))
+    run = a.run(a.initial_state(), 5)
+    b = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.of({0: "dy"}))
+    adv = b.advance_to(b.initial_state(), float(run.t))
+    assert "fused_adv" in b._cache
+    assert int(adv.it) == 5
+    np.testing.assert_allclose(np.asarray(adv.u), np.asarray(run.u),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_fused2d_sharded_thin_shard_declines_loudly(devices):
+    """A sharded axis thinner than the WENO5 halo declines the fused
+    path with a specific reason — and the generic path then fails with
+    a loud halo error too (no silent wrong answer at any rung)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 8, lengths=2.0)  # ly = 2 < halo 3 over dy=4
+    cfg = BurgersConfig(grid=grid, dtype="float32", impl="pallas")
+    solver = BurgersSolver(
+        cfg, mesh=make_mesh({"dy": 4}), decomp=Decomposition.of({0: "dy"})
+    )
+    assert solver._fused_stepper() is None
+    assert "halo" in solver._fused_fallback
+    with pytest.raises(ValueError, match="halo"):
+        solver.run(solver.initial_state(), 2)
+
+
+def test_fused_diffusion_bf16_storage_rung():
+    """The bf16-storage/f32-compute rung (HBM bytes halved on the
+    roof-bound ref grid): trajectories must stay within bf16 rounding of
+    the f32 fused run — storage is the only thing quantized; the RK
+    arithmetic runs f32."""
+    grid = Grid.make(32, 24, 24, lengths=10.0)
+    outs = {}
+    for dtype in ("float32", "bfloat16"):
+        s = DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype=dtype, impl="pallas")
+        )
+        fused = s._fused_stepper()
+        assert fused is not None, (dtype, s._fused_fallback)
+        assert fused.engaged_label == "fused-stage"
+        st = s.run(s.initial_state(), 5)
+        outs[dtype] = np.asarray(st.u, np.float32)
+    scale = float(np.abs(outs["float32"]).max())
+    diff = float(np.abs(outs["float32"] - outs["bfloat16"]).max())
+    # the IC itself is bf16-quantized (~0.4% relative) and each stage
+    # stores through bf16: a few percent of drift over 5 steps is the
+    # storage price — but the f32 arithmetic must keep it at that level
+    assert diff <= 0.05 * scale, (diff, scale)
+    # ...and strictly better than computing IN bf16 (the XLA path with
+    # the same dtype), which loses the stencil's cancellation digits
+    s_xla = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="bfloat16", impl="xla")
+    )
+    xla_bf16 = np.asarray(s_xla.run(s_xla.initial_state(), 5).u, np.float32)
+    diff_xla = float(np.abs(outs["float32"] - xla_bf16).max())
+    assert diff <= diff_xla * 1.05, (diff, diff_xla)
+
+
+def test_fused_diffusion_bf16_declines_off_design():
+    """bf16 storage exists only where it pays: the 3-D per-stage
+    stepper. 2-D and whole-step configs decline with a reason."""
+    s2 = DiffusionSolver(DiffusionConfig(
+        grid=Grid.make(24, 24, lengths=10.0), dtype="bfloat16",
+        impl="pallas"))
+    assert s2._fused_stepper() is None
+    assert "bf16" in s2._fused_fallback
+    s3 = DiffusionSolver(DiffusionConfig(
+        grid=Grid.make(24, 24, 24, lengths=10.0), dtype="bfloat16",
+        impl="pallas_step"))
+    assert s3._fused_stepper() is None
+
+
 def test_step_fused_diffusion_matches_xla():
     """The whole-step (3-stages-per-HBM-pass) ladder variant must match
     the generic path; it is not the default (measured slower than the
