@@ -2,4 +2,5 @@
 # MultiGPU/Burgers2d_Baseline/run.sh: tEnd=0.4 CFL=0.4, 2x2 domain, 400^2, 2 ranks
 python -m multigpu_advectiondiffusion_tpu.cli burgers2d \
     --t-end 0.4 --cfl 0.4 --lengths 2 2 --n 400 400 \
-    --fixed-dt --mesh dy=2 --save out/multigpu_burgers2d "$@"
+    --fixed-dt --mesh dy=2 --impl pallas \
+    --save out/multigpu_burgers2d "$@"

@@ -2,4 +2,4 @@
 # MultiGPU/Diffusion2d_Baseline/run.sh: K=1, L=W=2, 400x400, 1000 iters, 2 ranks
 python -m multigpu_advectiondiffusion_tpu.cli diffusion2d \
     --K 1.0 --lengths 2 2 --n 400 400 --iters 1000 \
-    --mesh dy=2 --save out/multigpu_diffusion2d "$@"
+    --mesh dy=2 --impl pallas --save out/multigpu_diffusion2d "$@"

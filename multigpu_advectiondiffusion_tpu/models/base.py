@@ -282,12 +282,24 @@ class SolverBase:
                 "overlap": overlap,
                 "fallback": None,
             }
-        stepper = "per-axis-pallas" if is_pallas_impl(impl) else "generic-xla"
+        # honor solver-level per-op dispatch rules (e.g. Burgers keeps
+        # XLA for WENO7 under impl="pallas" — measured faster)
+        op = (
+            self._op_impl()
+            if hasattr(self, "_op_impl")
+            else ("pallas" if is_pallas_impl(impl) else "xla")
+        )
+        stepper = "per-axis-pallas" if op == "pallas" else "generic-xla"
         fallback = None
         if is_fused_impl(impl):
             fallback = getattr(
                 self, "_fused_fallback", None
             ) or "config not fused-eligible"
+            if is_pallas_impl(impl) and op == "xla":
+                fallback += (
+                    "; per-axis rung not engaged (measured slower than "
+                    "XLA here — pin with impl='pallas_axis')"
+                )
         overlap = (
             getattr(self.cfg, "overlap", None)
             if self.mesh is not None

@@ -76,17 +76,34 @@ class BurgersSolver(SolverBase):
         # (generic path, fused stepper, bench t_end rows) reads
         self.dt = None if cfg.adaptive_dt else cfg.cfl * min(cfg.grid.spacing)
 
+    def _op_impl(self) -> str:
+        """Per-op kernel strategy for this config. Pallas flavors map to
+        the per-axis kernels — EXCEPT WENO7 under ``impl="pallas"``:
+        the per-axis WENO7 kernel measures ~2x slower than XLA at 512^3
+        (PARITY.md ladder), and "pallas" promises best-available, so
+        order 7 keeps XLA unless the rung is explicitly pinned with
+        ``impl="pallas_axis"`` (the ladder's slower variants stay
+        selectable, like the reference's own)."""
+        from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
+
+        impl = _norm(self.cfg.impl)
+        if (
+            impl == "pallas"
+            and self.cfg.weno_order == 7
+            and self.cfg.impl != "pallas_axis"
+        ):
+            return "xla"
+        return impl
+
     def build_local(self, ctx: StepContext) -> LocalPhysics:
         cfg = self.cfg
         spacing = cfg.grid.spacing
         fx = self.flux
 
-        from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
-
         ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
         # Burgers has no whole-step variant; any pallas flavor (e.g. the
         # CLI's global --impl pallas_step) maps to the per-axis kernels.
-        impl = _norm(cfg.impl)
+        impl = self._op_impl()
 
         def rhs(u):
             acc = None
@@ -242,6 +259,7 @@ class BurgersSolver(SolverBase):
             else:
                 if self.mesh is not None:
                     kwargs["global_shape"] = self.grid.shape
+                    kwargs["overlap_split"] = self._split_overlap_requested()
                 if cfg.adaptive_dt:
                     if self.mesh is not None:
                         # interior-view reduction + lax.pmax between steps

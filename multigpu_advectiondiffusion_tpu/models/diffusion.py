@@ -295,11 +295,11 @@ class DiffusionSolver(SolverBase):
                     )
             kwargs = {}
             if self.mesh is not None:
+                # both the 3-D z-slab and 2-D whole-shard per-stage
+                # steppers implement the three-call split-overlap
+                # schedule (they decline it themselves off-design)
                 kwargs["global_shape"] = self.grid.shape
-                if self.grid.ndim == 3:
-                    # only the 3-D per-stage stepper has the three-call
-                    # split-overlap schedule
-                    kwargs["overlap_split"] = self._split_overlap_requested()
+                kwargs["overlap_split"] = self._split_overlap_requested()
             self._cache["fused"] = cls(
                 lshape,
                 self.dtype,

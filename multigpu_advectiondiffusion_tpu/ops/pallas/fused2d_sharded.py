@@ -24,6 +24,14 @@ are pure calls with the output aliased onto the retiring buffer of the
 three-buffer RK choreography (``T1 = s1(S)``, ``T2 = s2(T1, S)``,
 ``S' = s3(T2, S) -> S``).
 
+``overlap="split"`` on a y-slab mesh swaps the serialized refresh for a
+three-band schedule per stage: the ghost-independent interior band runs
+concurrently with the in-flight slab ``ppermute`` (AOT-verified: the
+compiled v5e schedule places the band's ``tpu_custom_call`` inside a
+collective-permute window), and two halo-row edge bands consume the
+exchanged slabs as separate operands — the reference's five-stream
+boundary/interior choreography as dataflow, in 2-D.
+
 Ghost discipline:
 
 * Burgers: every non-interior cell at a *global* domain edge is an edge
@@ -194,35 +202,155 @@ def _make_stage(padded_shape, dtype, stage_fn, *, a, b, u_source):
     )
 
 
+def _make_band_stage(in_rows, out_rows, out_row0, trailing, dtype,
+                     stage_fn, *, a, b, use_u):
+    """One band call of the split-overlap schedule: input is a JAX-level
+    row slice of the padded buffer (ghost rows pre-concatenated from the
+    exchanged slabs for the edge bands), the stage evaluates over it,
+    and only the ``out_rows`` rows starting at ``out_row0`` are emitted.
+    Operands: ``dt``, ``offsets`` (pre-adjusted so the stage's global-y
+    formula ``iota - halo + offs[0]`` is exact for this band), ``v``
+    [, ``u`` — same row range as ``v``, stale rows discarded]."""
+
+    def kernel(*refs):
+        dt_ref, offs_ref, v_ref, *rest = refs
+        out_ref = rest[-1]
+        u = rest[0][...] if use_u else None
+        full = stage_fn(v_ref[...], u, dt_ref[0], offs_ref, a=a, b=b)
+        out_ref[...] = lax.slice_in_dim(full, out_row0, out_row0 + out_rows,
+                                        axis=0)
+
+    n_in = 3 + (1 if use_u else 0)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+    in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)] * (n_in - 2)
+    return pl.pallas_call(
+        kernel,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((out_rows,) + tuple(trailing), dtype),
+        compiler_params=None if interpret_mode() else compiler_params(),
+        interpret=interpret_mode(),
+    )
+
+
 class _Sharded2DStepperBase(FusedStepperBase):
     """Shared plumbing: three-buffer step choreography with per-stage
-    ghost refresh, run()/run_to() from :class:`FusedStepperBase`."""
+    ghost refresh (or the split-overlap band schedule),
+    run()/run_to() from :class:`FusedStepperBase`."""
 
     needs_offsets = True  # global edge/wall decisions
     overlap_split = False
 
-    def _build_step(self, stage_fn, dtype):
+    def _build_step(self, stage_fn_for, dtype):
+        """``stage_fn_for(band_shape | None)`` returns the stage
+        callable — ``None`` means the full local interior (the
+        serialized whole-shard calls); a band shape parametrizes the
+        split-overlap band calls (Burgers' edge-fill source indices
+        must stay inside the band array)."""
         sources = ("none", "operand", "alias_u")
-        s1, s2, s3 = (
-            _make_stage(
-                self.padded_shape, dtype, stage_fn, a=a, b=b, u_source=src
+        if not self.overlap_split:
+            s1, s2, s3 = (
+                _make_stage(
+                    self.padded_shape, dtype, stage_fn_for(None),
+                    a=a, b=b, u_source=src,
+                )
+                for (a, b), src in zip(_STAGES, sources)
             )
+
+            def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                     exch=None):
+                del exch
+                # an all-extent-1 mesh builds this stepper unsharded: no
+                # refresh/offsets arrive, and this shard IS the global
+                # block
+                offs = (
+                    offsets
+                    if offsets is not None
+                    else jnp.zeros((len(self.interior_shape),), jnp.int32)
+                )
+                fix = refresh if refresh is not None else (lambda P: P)
+                T1 = fix(s1(dt_arr, offs, S, T1))
+                T2 = fix(s2(dt_arr, offs, T1, S, T2))
+                S = fix(s3(dt_arr, offs, T2, S))
+                return S, T1, T2
+
+            self._step = step
+            return
+
+        # Split-overlap band schedule on the axis-0 slab: per stage, the
+        # interior band (rows that depend on no ghost row) runs
+        # concurrently with the in-flight ppermute of the exchanged
+        # slabs — only the two h-row edge-band calls consume them. The
+        # reference's five-stream boundary/interior choreography as
+        # dataflow (MultiGPU/Diffusion2d_Baseline/main.c:189-280).
+        h = self.halo
+        ly, lx = self.interior_shape
+        trailing = self.padded_shape[1:]
+        mid = ly - 2 * h
+
+        def band_calls(a, b, use_u):
+            edge_fn = stage_fn_for((h, lx))
+            mid_fn = stage_fn_for((mid, lx))
+            return (
+                _make_band_stage(3 * h, h, h, trailing, dtype, edge_fn,
+                                 a=a, b=b, use_u=use_u),
+                _make_band_stage(ly, mid, h, trailing, dtype, mid_fn,
+                                 a=a, b=b, use_u=use_u),
+                _make_band_stage(3 * h, h, h, trailing, dtype, edge_fn,
+                                 a=a, b=b, use_u=use_u),
+            )
+
+        calls = [
+            band_calls(a, b, src != "none")
             for (a, b), src in zip(_STAGES, sources)
-        )
+        ]
 
         def step(S, T1, T2, dt_arr, offsets=None, refresh=None, exch=None):
-            del exch
-            # an all-extent-1 mesh builds this stepper unsharded: no
-            # refresh/offsets arrive, and this shard IS the global block
+            del refresh
             offs = (
                 offsets
                 if offsets is not None
-                else jnp.zeros((len(self.interior_shape),), jnp.int32)
+                else jnp.zeros((2,), jnp.int32)
             )
-            fix = refresh if refresh is not None else (lambda P: P)
-            T1 = fix(s1(dt_arr, offs, S, T1))
-            T2 = fix(s2(dt_arr, offs, T1, S, T2))
-            S = fix(s3(dt_arr, offs, T2, S))
+            # the band stages' global-y formula is `iota - h + offs[0]`;
+            # each band's first input row sits at a different interior
+            # row, so offs[0] is pre-shifted per band (bottom: -h, i.e.
+            # unshifted; interior: 0; top: ly-2h)
+            o_b = offs
+            o_i = offs + jnp.asarray([h, 0], jnp.int32)
+            o_t = offs + jnp.asarray([ly - h, 0], jnp.int32)
+
+            def run_stage(cb, ci, ct, v, u):
+                lo, hi = exch(v)
+                sl = lambda a0, r0, r1: lax.slice_in_dim(a0, r0, r1, axis=0)  # noqa: E731,E501
+                args = lambda o, vin, u_rng: (  # noqa: E731
+                    (dt_arr, o, vin)
+                    + (() if u is None else (sl(u, *u_rng),))
+                )
+                # the interior call consumes no exchanged slab — XLA
+                # schedules it inside the collective-permute window
+                m = ci(*args(o_i, sl(v, h, h + ly), (h, h + ly)))
+                bb = cb(*args(
+                    o_b,
+                    jnp.concatenate([lo, sl(v, h, 3 * h)], axis=0),
+                    (0, 3 * h),
+                ))
+                tt = ct(*args(
+                    o_t,
+                    jnp.concatenate([sl(v, h + ly - 2 * h, h + ly), hi],
+                                    axis=0),
+                    (h + ly - 2 * h, h + ly + h),
+                ))
+                # stale ghost/slack rows ride along unread (split mode
+                # never reads buffer ghosts — they live in the operands)
+                return jnp.concatenate(
+                    [sl(v, 0, h), bb, m, tt, sl(v, h + ly, v.shape[0])],
+                    axis=0,
+                )
+
+            T1 = run_stage(*calls[0], S, None)
+            T2 = run_stage(*calls[1], T1, S)
+            S = run_stage(*calls[2], T2, S)
             return S, T1, T2
 
         self._step = step
@@ -246,13 +374,18 @@ class ShardedFusedBurgers2DStepper(_Sharded2DStepperBase):
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
-                 dt_fn=None, global_shape=None):
+                 dt_fn=None, global_shape=None,
+                 overlap_split: bool = False):
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
         ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
+        # split needs a non-degenerate interior band (>= h rows)
+        self.overlap_split = bool(
+            overlap_split and self.sharded and ly >= 3 * R_WENO
+        )
         self.padded_shape = (
             round_up(ly + 2 * R_WENO, SUBLANE),
             round_up(lx + 2 * R_WENO, LANE),
@@ -263,16 +396,19 @@ class ShardedFusedBurgers2DStepper(_Sharded2DStepperBase):
             nu_scales = tuple(
                 float(nu) / (12.0 * spacing[i] * spacing[i]) for i in range(2)
             )
-        stage_fn = functools.partial(
-            _burgers_stage,
-            local_shape=self.interior_shape,
-            global_shape=self.global_shape,
-            inv_dx=tuple(1.0 / spacing[i] for i in range(2)),
-            nu_scales=nu_scales,
-            flux=flux,
-            variant=variant,
-        )
-        self._build_step(stage_fn, self.dtype)
+
+        def stage_fn_for(band_shape):
+            return functools.partial(
+                _burgers_stage,
+                local_shape=band_shape or self.interior_shape,
+                global_shape=self.global_shape,
+                inv_dx=tuple(1.0 / spacing[i] for i in range(2)),
+                nu_scales=nu_scales,
+                flux=flux,
+                variant=variant,
+            )
+
+        self._build_step(stage_fn_for, self.dtype)
         self.dt = None if dt is None else float(dt)
         self._dt_fn = dt_fn
 
@@ -309,11 +445,15 @@ class ShardedFusedDiffusion2DStepper(_Sharded2DStepperBase):
     core_offsets = (R_LAP, R_LAP)
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
-                 band, bc_value, global_shape=None):
+                 band, bc_value, global_shape=None,
+                 overlap_split: bool = False):
         ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
+        self.overlap_split = bool(
+            overlap_split and self.sharded and ly >= 3 * R_LAP
+        )
         self.padded_shape = (
             round_up(ly + 2 * R_LAP, SUBLANE),
             round_up(lx + 2 * R_LAP, LANE),
@@ -330,7 +470,7 @@ class ShardedFusedDiffusion2DStepper(_Sharded2DStepperBase):
             band=band,
             bc_value=self.bc_value,
         )
-        self._build_step(stage_fn, self.dtype)
+        self._build_step(lambda band_shape: stage_fn, self.dtype)
         self.dt = float(dt)
 
     @staticmethod

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the WENO5 flux divergence.
+"""Pallas TPU kernel for the WENO5/WENO7 flux divergence.
 
 TPU re-design of the reference's tiled face-flux kernels
 (``SingleGPU/Burgers3d_WENO5_SharedMem/kernels.cu:212-400``): each tile
@@ -31,21 +31,35 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     round_up,
 )
 
-R = 3  # WENO5 stencil radius
+R = 3  # WENO5 stencil radius (WENO7: 4 — see _halo)
 
 # Mosaic keeps ~16 live row-sized buffers per (block-row + 1) during the
-# dual reconstruction (measured: 205 MiB at block=8 on a 512^2 trailing
-# extent), so the z-block must be sized against VMEM, not a fixed 8.
+# dual WENO5 reconstruction (measured: 205 MiB at block=8 on a 512^2
+# trailing extent), so the z-block must be sized against VMEM, not a
+# fixed 8. WENO7 carries ~1.5x the live set (7+7 shifted operands, 4
+# betas/weights per side).
 _VMEM_BUDGET = 80 * 1024 * 1024
+_LIVE_ROWS = {5: 16, 7: 24}
 
 
-def _live_bytes(b: int, halo_lead: int, row_bytes: int) -> int:
-    return (16 * (b + 1) + b + halo_lead) * row_bytes
+def _halo(order: int) -> int:
+    from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+    return HALO[order]
 
 
-def _pick_vmem_block(nb: int, halo_lead: int, row_bytes: int) -> int | None:
+def _live_bytes(b: int, halo_lead: int, row_bytes: int, order: int) -> int:
+    return (_LIVE_ROWS[order] * (b + 1) + b + halo_lead) * row_bytes
+
+
+def _pick_vmem_block(
+    nb: int, halo_lead: int, row_bytes: int, order: int = 5
+) -> int | None:
     for b in range(min(8, nb), 0, -1):
-        if nb % b == 0 and _live_bytes(b, halo_lead, row_bytes) <= _VMEM_BUDGET:
+        if (
+            nb % b == 0
+            and _live_bytes(b, halo_lead, row_bytes, order) <= _VMEM_BUDGET
+        ):
             return b
     return None
 
@@ -62,7 +76,7 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _face_flux(window, axis, n_faces, flux, variant):
+def _face_flux(window, axis, n_faces, flux, variant, order):
     """All ``n_faces`` interface fluxes along ``axis`` of a padded slab.
 
     Used only for the *leading* (untiled) axis, whose slices are free
@@ -71,6 +85,8 @@ def _face_flux(window, axis, n_faces, flux, variant):
     from multigpu_advectiondiffusion_tpu.ops.weno import (
         _weno5_minus,
         _weno5_plus,
+        _weno7_minus,
+        _weno7_plus,
     )
 
     a = jnp.abs(flux.df(window))
@@ -80,35 +96,57 @@ def _face_flux(window, axis, n_faces, flux, variant):
 
     def shifts(arr, lo):
         out = []
-        for j in range(5):
+        for j in range(order):
             idx = [slice(None)] * arr.ndim
             idx[axis] = slice(lo + j, lo + j + n_faces)
             out.append(arr[tuple(idx)])
         return out
 
+    if order == 7:
+        return _weno7_minus(shifts(vp, 0)) + _weno7_plus(shifts(vm, 1))
     return _weno5_minus(*shifts(vp, 0), variant) + _weno5_plus(
         *shifts(vm, 1), variant
     )
 
 
-def _div_windowed(window, axis, n, flux, variant, inv_dx):
-    """Divergence over a slab padded by ``R`` on a *tiled* sweep axis,
-    via whole-array circular rolls (:func:`fused_burgers._div_roll`).
+def _div_windowed(window, axis, n, flux, variant, inv_dx, order):
+    """Divergence over a slab padded by the order's halo on a *tiled*
+    sweep axis, via whole-array circular rolls
+    (:func:`fused_burgers._div_roll` for WENO5; the same construction
+    with the 7-point reconstructions for WENO7).
 
     On the VPU a tiled-axis window slice lowers to a per-operand
     realignment through the same shift unit a roll uses once — the
     rolls-beat-slices measurement behind the fused kernels' y sweep.
-    Wrapped positions land only in the R-deep pad band, outside the
-    ``[R, R+n)`` output slice."""
+    Wrapped positions land only in the halo-deep pad band, outside the
+    ``[r, r+n)`` output slice."""
     from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
         _div_roll,
         _split,
     )
 
+    r = _halo(order)
     vp, vm = _split(flux, window)
-    div = _div_roll(vp, vm, axis, inv_dx, variant)
+    if order == 7:
+        from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (  # noqa: E501
+            _shift,
+        )
+        from multigpu_advectiondiffusion_tpu.ops.weno import (
+            _weno7_minus,
+            _weno7_plus,
+        )
+
+        # interface right of cell k: minus side cells k-3..k+3, plus
+        # side cells k-2..k+4 (the roll analog of the padded offsets
+        # 0..6 / 1..7 in interface_flux_from_padded)
+        v = [_shift(vp, j, axis) for j in range(-3, 4)]
+        u = [_shift(vm, j, axis) for j in range(-2, 5)]
+        h = _weno7_minus(v) + _weno7_plus(u)
+        div = (h - _shift(h, -1, axis)) * inv_dx
+    else:
+        div = _div_roll(vp, vm, axis, inv_dx, variant)
     idx = [slice(None)] * window.ndim
-    idx[axis] = slice(R, R + n)
+    idx[axis] = slice(r, r + n)
     return div[tuple(idx)]
 
 
@@ -119,8 +157,10 @@ def flux_divergence_pallas(
     flux: Flux,
     variant: str = "js",
     block: int | None = None,
+    order: int = 5,
 ) -> jnp.ndarray:
-    """``d f(u)/dx`` along ``axis`` of an array padded by 3 on that axis.
+    """``d f(u)/dx`` along ``axis`` of an array padded by the order's
+    halo (3 for WENO5, 4 for WENO7) on that axis.
 
     3-D arrays are processed in z-slabs; the sweep axis may be any axis,
     including the blocked one (the slab then carries the halo in-block).
@@ -128,18 +168,21 @@ def flux_divergence_pallas(
     axes tile-aligned by ``align_trailing``; 2-D grids at reference scale
     fit VMEM whole, so they use a single-block kernel.
     """
+    r = _halo(order)
     if up.ndim == 2:
         # whole-array kernel: `block` has no meaning (supported() gates size)
-        return _flux_divergence_2d(up, axis, dx, flux, variant)
+        return _flux_divergence_2d(up, axis, dx, flux, variant, order)
 
     ndim = up.ndim
     shape = list(up.shape)
-    shape[axis] -= 2 * R
+    shape[axis] -= 2 * r
     n = shape[axis]  # output length along the sweep axis
     lead_axis = 0  # block over the leading axis
     nb = shape[0]
-    halo_lead = 2 * R if axis == lead_axis else 0
-    b = block or _pick_vmem_block(nb, halo_lead, _row_bytes(up.shape, up.dtype))
+    halo_lead = 2 * r if axis == lead_axis else 0
+    b = block or _pick_vmem_block(
+        nb, halo_lead, _row_bytes(up.shape, up.dtype), order
+    )
     if b is None:
         raise ValueError("no VMEM-viable block; gate with supported() first")
     up = align_trailing(up)
@@ -153,13 +196,14 @@ def flux_divergence_pallas(
         cp.wait()
         window = slab[:]
         if axis != lead_axis:
-            div = _div_windowed(window, axis, n, flux, variant, 1.0 / dx)
+            div = _div_windowed(window, axis, n, flux, variant, 1.0 / dx,
+                                order)
             # crop the align_trailing tile padding (div is already
             # sweep-sliced to n on `axis`)
             idx = [slice(0, e) for e in (b,) + tuple(shape[1:])]
             out_ref[:] = div[tuple(idx)]
             return
-        h = _face_flux(window, axis, b + 1, flux, variant)
+        h = _face_flux(window, axis, b + 1, flux, variant, order)
         idx_lo = [slice(0, e) for e in (b,) + tuple(shape[1:])]
         idx_hi = list(idx_lo)
         idx_lo[axis] = slice(0, b)
@@ -190,17 +234,18 @@ def flux_divergence_pallas(
 
 
 def _flux_divergence_2d(
-    up: jnp.ndarray, axis: int, dx: float, flux: Flux, variant: str
+    up: jnp.ndarray, axis: int, dx: float, flux: Flux, variant: str,
+    order: int = 5,
 ) -> jnp.ndarray:
     """Whole-array VMEM kernel for 2-D sweeps (size-gated by ``supported``)."""
     shape = list(up.shape)
-    shape[axis] -= 2 * R
+    shape[axis] -= 2 * _halo(order)
     n = shape[axis]
 
     def kernel(up_ref, out_ref):
         window = up_ref[:]
         # both 2-D axes are tiled (sublane/lane) -> roll-based sweep
-        div = _div_windowed(window, axis, n, flux, variant, 1.0 / dx)
+        div = _div_windowed(window, axis, n, flux, variant, 1.0 / dx, order)
         idx = [slice(0, e) for e in shape]
         idx[axis] = slice(None)
         out_ref[:] = div[tuple(idx)]
@@ -217,16 +262,26 @@ def _flux_divergence_2d(
 
 def supported(ndim: int, order: int, variant: str, shape=None,
               dtype=jnp.float32) -> bool:
-    if order != 5 or variant not in ("js", "z"):
+    if order == 5:
+        if variant not in ("js", "z"):
+            return False
+    elif order == 7:
+        # WENO7 is JS-only, like the XLA path (the reference's WENO7 is
+        # MATLAB-only with no Z variant, WENO7resAdv_X.m)
+        if variant != "js":
+            return False
+    else:
         return False
+    r = _halo(order)
     if ndim == 3:
         if shape is None:
             return True
         # every sweep axis must admit a VMEM-viable z-block (the z sweep
-        # carries the 2R-row lead halo — the binding constraint)
-        padded = (shape[0] + 2 * R, shape[1] + 2 * R, shape[2] + 2 * R)
+        # carries the 2r-row lead halo — the binding constraint)
+        padded = (shape[0] + 2 * r, shape[1] + 2 * r, shape[2] + 2 * r)
         return (
-            _pick_vmem_block(shape[0], 2 * R, _row_bytes(padded, dtype))
+            _pick_vmem_block(shape[0], 2 * r, _row_bytes(padded, dtype),
+                             order)
             is not None
         )
     if ndim == 2:
@@ -235,8 +290,17 @@ def supported(ndim: int, order: int, variant: str, shape=None,
         )
 
         # shape is required to size-gate the whole-array 2-D kernel
-        # (~10 live full-size intermediates: vp/vm shifts, betas, weights).
-        return shape is not None and fits_vmem(
-            shape, R, 10, jnp.dtype(dtype).itemsize
-        )
+        # (live full-size intermediates: vp/vm shifts, betas, weights —
+        # ~10 for WENO5, ~18 for WENO7). WENO5 keeps the conservative
+        # default budget it shipped with; WENO7's larger live set is
+        # gated against this module's measured scope instead, or the
+        # reference 2-D grid (400x406) would be spuriously rejected.
+        if shape is None:
+            return False
+        if order == 7:
+            return fits_vmem(
+                shape, r, _LIVE_ROWS[order] - 6,
+                jnp.dtype(dtype).itemsize, budget=_VMEM_BUDGET,
+            )
+        return fits_vmem(shape, r, 10, jnp.dtype(dtype).itemsize)
     return False

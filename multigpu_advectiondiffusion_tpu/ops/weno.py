@@ -346,7 +346,7 @@ def flux_divergence(
         if pallas_weno.supported(u.ndim, order, variant, shape=u.shape,
                                  dtype=u.dtype):
             return pallas_weno.flux_divergence_pallas(
-                up, axis, dx, flux, variant
+                up, axis, dx, flux, variant, order=order
             )
 
     return div_from_padded(up)

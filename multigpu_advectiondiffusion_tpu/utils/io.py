@@ -487,6 +487,17 @@ def save_checkpoint_sharded(
         json.dump({"process": pid, "shards": entries}, f)
     os.replace(tmp, os.path.join(directory, f"manifest_p{pid}.json"))
 
+    # manifest.json is the checkpoint's commit record: it must appear
+    # only after EVERY process's shards are on disk (else a directory
+    # can look complete while peers are still writing — losing the
+    # complete-or-absent guarantee the single-file format gets from its
+    # atomic rename). Barrier, coordinator writes, barrier again so no
+    # process returns (and possibly loads) before the commit landed.
+    multi = jax.process_count() > 1
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckptd-shards:{directory}")
     if pid == 0:
         meta = {
             "global_shape": list(gshape),
@@ -504,6 +515,8 @@ def save_checkpoint_sharded(
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, os.path.join(directory, "manifest.json"))
+    if multi:
+        multihost_utils.sync_global_devices(f"ckptd-commit:{directory}")
 
 
 def _sharded_manifest(directory: str):

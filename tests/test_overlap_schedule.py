@@ -230,13 +230,17 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
     )
 
 
+@pytest.mark.parametrize("overlap", ["padded", "split"])
 @pytest.mark.parametrize("model", ["burgers", "diffusion"])
-def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model):
+def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
     """The sharded 2-D per-stage steppers (whole-shard VMEM kernels +
-    ppermute ghost refresh) must compile through the real Mosaic
-    pipeline for a 4-chip v5e topology — the interpret-mode suite can't
-    catch Mosaic-only lowering rejections (alignment, memory-space,
-    aliasing constraints)."""
+    ppermute ghost refresh, or the three-band split-overlap schedule)
+    must compile through the real Mosaic pipeline for a 4-chip v5e
+    topology — the interpret-mode suite can't catch Mosaic-only lowering
+    rejections (alignment, memory-space, aliasing constraints). For
+    overlap='split' the compiled schedule must place a stage kernel
+    inside a collective-permute window — the ghost-independent interior
+    band actually hides the exchange."""
     try:
         from jax.experimental import topologies
 
@@ -264,24 +268,25 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model):
         if model == "burgers":
             solver = BurgersSolver(
                 BurgersConfig(grid=grid, nu=1e-4, dtype="float32",
-                              impl="pallas"),
+                              impl="pallas", overlap=overlap),
                 mesh=mesh,
                 decomp=Decomposition.of({0: "dy"}),
             )
         else:
             solver = DiffusionSolver(
-                DiffusionConfig(grid=grid, dtype="float32", impl="pallas"),
+                DiffusionConfig(grid=grid, dtype="float32", impl="pallas",
+                                overlap=overlap),
                 mesh=mesh,
                 decomp=Decomposition.of({0: "dy"}),
             )
         fused = solver._fused_stepper()
         assert fused is not None and fused.sharded
+        assert fused.overlap_split == (overlap == "split")
         refresh, offsets_fn, exch = solver._fused_sharded_ctx(fused)
-        assert refresh is not None and exch is None
 
         def block(u, t):
             return fused.run(u, t, 2, refresh=refresh,
-                             offsets=offsets_fn())
+                             offsets=offsets_fn(), exch=exch)
 
         f = solver._wrap(block)
         u = jax.ShapeDtypeStruct(grid.shape, jnp.float32,
@@ -294,3 +299,13 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model):
 
     assert "tpu_custom_call" in txt, "stage kernels did not lower via Mosaic"
     assert "collective-permute" in txt, "ghost refresh lost its ppermute"
+    if overlap == "split":
+        events = _schedule_events(
+            txt, extra=[(r"= .*custom-call.*tpu_custom_call", "kernel")]
+        )
+        kernels_in, have_pairs = _count_in_windows(events, "kernel")
+        assert have_pairs, "expected async collective-permute pairs"
+        assert kernels_in > 0, (
+            "no stage kernel scheduled inside a collective-permute "
+            "window — the 2-D split overlap is not being hidden"
+        )

@@ -58,6 +58,63 @@ def test_weno_pallas_matches_xla(ndim, axis, variant):
                                rtol=1e-4, atol=1e-6 * scale)
 
 
+@pytest.mark.parametrize("ndim,axis", [(2, 0), (2, 1), (3, 0), (3, 1), (3, 2)])
+def test_weno7_pallas_matches_xla(ndim, axis):
+    """The per-axis Pallas rung now covers WENO7 (halo-4 sweeps — the
+    deepest stress of the roll-based tiled-axis construction); every
+    sweep axis must match the XLA WENO7 path."""
+    shape = {2: (16, 24), 3: (10, 12, 32)}[ndim]
+    u = _field(shape, seed=20 + axis)
+    fx = flux_lib.burgers()
+    bc = Boundary("edge")
+    ref = flux_divergence(u, axis, 0.05, fx, order=7, bc=bc, impl="xla")
+    out = flux_divergence(u, axis, 0.05, fx, order=7, bc=bc, impl="pallas")
+    scale = float(np.max(np.abs(np.asarray(ref))))
+    # WENO7 betas carry ~1e5-scale integer coefficients, so f32
+    # cancellation noise between the roll- and slice-order evaluations
+    # is a few ulp of the *field* scale at near-zero-divergence cells
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5 * scale)
+
+
+def test_weno7_pallas_solver_end_to_end():
+    """A WENO7 solver with impl='pallas_axis' pins the per-axis WENO7
+    kernels (the fused stepper declines order 7) and matches the XLA
+    solver; impl='pallas' keeps XLA for order 7 (the per-axis WENO7
+    kernel measures ~2x slower at 512^3 — 'pallas' promises
+    best-available) and the engaged-path report says so."""
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    outs = {}
+    for impl in ("xla", "pallas_axis"):
+        cfg = BurgersConfig(grid=grid, weno_order=7, cfl=0.3,
+                            adaptive_dt=False, dtype="float32",
+                            ic="gaussian", impl=impl)
+        solver = BurgersSolver(cfg)
+        assert solver._fused_stepper() is None
+        st = solver.run(solver.initial_state(), 4)
+        outs[impl] = np.asarray(st.u)
+    scale = float(np.max(np.abs(outs["xla"])))
+    np.testing.assert_allclose(outs["pallas_axis"], outs["xla"],
+                               rtol=1e-4, atol=1e-6 * scale)
+
+    auto = BurgersSolver(BurgersConfig(
+        grid=grid, weno_order=7, dtype="float32", impl="pallas"))
+    path = auto.engaged_path()
+    assert path["stepper"] == "generic-xla"
+    assert "pallas_axis" in path["fallback"]
+
+
+def test_weno7_pallas_supported_gates():
+    """WENO7 support: JS only (like the XLA path and the reference's
+    MATLAB-only WENO7), 2-D/3-D, VMEM-gated with the larger live set."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas import weno as pw
+
+    assert pw.supported(3, 7, "js", shape=(512, 512, 512))
+    assert not pw.supported(3, 7, "z", shape=(64, 64, 64))
+    assert pw.supported(2, 7, "js", shape=(400, 406))
+    assert not pw.supported(1, 7, "js", shape=(1000,))
+
+
 def test_laplacian_pallas_gates_vmem_exceeding_rows():
     """The 3-D block picker must size the z-block against VMEM, not a
     fixed 8: the reference's 1601x986x35 slab workload (6.6 MB rows)
@@ -870,6 +927,87 @@ def test_fused2d_sharded_diffusion_run_to_matches_run(devices):
     assert int(adv.it) == 5
     np.testing.assert_allclose(np.asarray(adv.u), np.asarray(run.u),
                                rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("model", ["burgers", "diffusion"])
+def test_fused2d_split_overlap_matches_serialized(devices, model):
+    """overlap='split' on a 2-D y-slab mesh runs the three-band schedule
+    (interior band concurrent with the in-flight slab ppermute; only the
+    two h-row edge bands consume the exchanged slabs) — matching the
+    serialized-refresh path and the unsharded fused run at ulp level, in
+    run() and run_to."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 40, lengths=10.0)  # ly=10/shard >= 3*halo
+    mesh_kw = dict(mesh=make_mesh({"dy": 4}),
+                   decomp=Decomposition.of({0: "dy"}))
+    outs = {}
+    for overlap in ("padded", "split"):
+        if model == "burgers":
+            cfg = BurgersConfig(grid=grid, nu=1e-4, dtype="float32",
+                                impl="pallas", overlap=overlap)
+            solver = BurgersSolver(cfg, **mesh_kw)
+        else:
+            cfg = DiffusionConfig(grid=grid, dtype="float32",
+                                  impl="pallas", overlap=overlap)
+            solver = DiffusionSolver(cfg, **mesh_kw)
+        fused = solver._fused_stepper()
+        assert fused is not None and fused.sharded
+        assert fused.overlap_split == (overlap == "split")
+        outs[overlap] = solver.run(solver.initial_state(), 6)
+    a, b = np.asarray(outs["padded"].u), np.asarray(outs["split"].u)
+    scale = float(np.abs(a).max())
+    # band slicing/assembly compiles different FMA contractions than the
+    # whole-shard call — same values, few-ulp freedom (as in 3-D split)
+    assert float(np.abs(a - b).max()) <= 8 * np.finfo(np.float32).eps * scale
+    assert float(outs["padded"].t) == float(outs["split"].t)
+
+
+def test_fused2d_split_overlap_run_to(devices):
+    """The split schedule serves run_to (trimmed last step) with the
+    generic path's step count and landing time."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 40, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas",
+                          overlap="split")
+    solver = DiffusionSolver(cfg, mesh=make_mesh({"dy": 4}),
+                             decomp=Decomposition.of({0: "dy"}))
+    assert solver._fused_stepper().overlap_split
+    st0 = solver.initial_state()
+    t_end = float(st0.t) + 4.4 * solver.dt
+    out = solver.advance_to(st0, t_end)
+    assert "fused_adv" in solver._cache
+    assert int(out.it) == 5
+    np.testing.assert_allclose(float(out.t), t_end, rtol=1e-6)
+
+
+def test_fused2d_split_overlap_thin_band_falls_back(devices):
+    """Shards without a non-degenerate interior band (ly < 3*halo) fall
+    back to the serialized refresh — and still match."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 32, lengths=10.0)  # ly=8/shard < 3*3 for WENO5
+    cfg = BurgersConfig(grid=grid, nu=1e-4, dtype="float32",
+                        impl="pallas", overlap="split")
+    solver = BurgersSolver(cfg, mesh=make_mesh({"dy": 4}),
+                           decomp=Decomposition.of({0: "dy"}))
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded and not fused.overlap_split
+    ref = BurgersSolver(BurgersConfig(grid=grid, nu=1e-4, dtype="float32",
+                                      impl="pallas"))
+    r = ref.run(ref.initial_state(), 4)
+    o = solver.run(solver.initial_state(), 4)
+    _assert_fused_close(o.u, r.u)
 
 
 def test_fused2d_sharded_thin_shard_declines_loudly(devices):
