@@ -1,0 +1,25 @@
+#!/bin/sh
+# The mpirun analog through the CLI: MultiGPU/Diffusion3d_Baseline/run.sh
+# (`mpirun -np 2 ./Diffusion3d.run 1.00 2.00 2.00 2.00 400 200 200 1000
+# 64 4 1`) as two cooperating CLI processes joined by jax.distributed.
+# Run ONE copy of this block per host (here: both locally for a demo),
+# same --coordinator/--num-processes, unique --process-id. The compound
+# mesh axis dz_dcn=2,dz_ici=N puts the slab's DCN hop between process
+# granules and the ICI hops inside each host; the fused per-stage
+# kernels run shard-local with the overlapped halo schedule, and the
+# coordinator writes initial.bin/result.bin/summary.json from gathered
+# shards. N must match each host's local chip count.
+#
+# Demo on one machine with virtual CPU devices:
+#   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+#     sh examples/multihost_diffusion3d.sh --impl xla --n 64 32 32 --iters 10
+PORT=${PORT:-12357}
+for PID in 0 1; do
+  python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+      --K 1.0 --lengths 2 2 2 --n 400 200 200 --iters 1000 \
+      --mesh dz_dcn=2,dz_ici=4 --impl pallas --overlap split \
+      --coordinator localhost:$PORT --num-processes 2 --process-id $PID \
+      --checkpoint-every 500 --checkpoint-sharded \
+      --save out/multihost_diffusion3d "$@" &
+done
+wait

@@ -47,7 +47,19 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
     p.add_argument("--integrator", default="ssp_rk3",
                    choices=["euler", "ssp_rk2", "ssp_rk3"])
     p.add_argument("--mesh", default=None,
-                   help="device-mesh spec, e.g. 'dz=4' or 'dz=4,dy=2'")
+                   help="device-mesh spec, e.g. 'dz=4' or 'dz=4,dy=2'; a "
+                        "'_suffix' groups members of a compound axis for "
+                        "one grid axis, outermost first — the multi-host "
+                        "layout 'dz_dcn=2,dz_ici=4' splits z over 2 "
+                        "process granules x 4 chips")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-process launch (the mpirun analog): run "
+                        "one CLI process per host with the same "
+                        "--coordinator and --num-processes and a unique "
+                        "--process-id; jax.distributed joins them and "
+                        "the mesh spans every process's devices")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--save", default=None, metavar="DIR",
                    help="write initial.bin/result.bin/summary.json here")
     p.add_argument("--plot", action="store_true",
@@ -277,6 +289,30 @@ def main(argv=None):
 
     honor_platform_env()
     args = build_parser().parse_args(argv)
+    if getattr(args, "num_processes", None) is not None or getattr(
+        args, "process_id", None
+    ) is not None:
+        # symmetric validation: without it, forgetting --coordinator
+        # would silently run N independent solves racing on --save
+        if not getattr(args, "coordinator", None):
+            raise SystemExit(
+                "--num-processes/--process-id need --coordinator"
+            )
+    if getattr(args, "coordinator", None):
+        # the mpirun analog (MultiGPU/*/run.sh `mpirun -np 2 ...`): join
+        # this process into the jax.distributed runtime BEFORE any
+        # backend/mesh work, so jax.devices() spans every process
+        if args.num_processes is None or args.process_id is None:
+            raise SystemExit(
+                "--coordinator needs --num-processes and --process-id"
+            )
+        from multigpu_advectiondiffusion_tpu.parallel import multihost
+
+        multihost.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     if args.dtype == "float64":
         import jax
 

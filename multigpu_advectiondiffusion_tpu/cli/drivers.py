@@ -27,6 +27,11 @@ def parse_mesh_spec(spec: Optional[str]):
     """``'dz=4,dy=2'`` -> (mesh, Decomposition) or (None, None).
 
     Mesh axis names map to grid axes by suffix: dz/dy/dx/dr -> z/y/x/r.
+    A ``_suffix`` after the letter declares members of a *compound* axis
+    splitting one grid axis over several mesh axes, outermost first in
+    spec order — the multi-host layout: ``'dz_dcn=2,dz_ici=4'`` puts z
+    over ``('dz_dcn', 'dz_ici')`` with the DCN hop between process
+    granules (``parallel/mesh.py`` Decomposition docstring).
     """
     if not spec:
         return None, None
@@ -47,14 +52,18 @@ def decomposition_for(grid, mesh_sizes) -> Optional[Decomposition]:
         suffix_to_axis[n] = ax
     # r is the innermost axis of axisymmetric grids
     suffix_to_axis.setdefault("r", grid.ndim - 1)
-    mapping = {}
+    groups = {}  # grid axis -> mesh axis names, spec order (dcn first)
     for mesh_name in mesh_sizes:
-        suffix = mesh_name.lstrip("d")
+        suffix = mesh_name.lstrip("d").split("_", 1)[0]
         if suffix not in suffix_to_axis:
             raise ValueError(
                 f"mesh axis {mesh_name!r} has no grid axis (grid axes: {names})"
             )
-        mapping[suffix_to_axis[suffix]] = mesh_name
+        groups.setdefault(suffix_to_axis[suffix], []).append(mesh_name)
+    mapping = {
+        ax: (ns[0] if len(ns) == 1 else tuple(ns))
+        for ax, ns in groups.items()
+    }
     return Decomposition.of(mapping)
 
 
@@ -112,8 +121,22 @@ def run_solver(
     """
     if (iters is None) == (t_end is None):
         raise ValueError("provide exactly one of iters/t_end")
+    import jax
+
+    # Multi-process runs (the mpirun analog, --coordinator): file output
+    # happens once, on the coordinator; shards living on other processes
+    # are allgathered first. _fetch is a COLLECTIVE when sharded across
+    # processes — every process must call it, only the write is gated.
+    is_coord = jax.process_index() == 0
+
+    def _fetch(u):
+        if getattr(u, "is_fully_addressable", True):
+            return u
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(u, tiled=True)
+
     if resume:
-        import jax
         import jax.numpy as jnp
 
         # sharded checkpoint directories reassemble straight onto this
@@ -169,7 +192,9 @@ def run_solver(
 
     if save_dir:
         os.makedirs(save_dir, exist_ok=True)
-        io_utils.save_binary(state.u, os.path.join(save_dir, "initial.bin"))
+        u_host = _fetch(state.u)
+        if is_coord:
+            io_utils.save_binary(u_host, os.path.join(save_dir, "initial.bin"))
 
     # compile (untimed, like the reference's untimed warm phase)
     t0 = time.perf_counter()
@@ -217,12 +242,27 @@ def run_solver(
                     # writers and books as I/O, inflating the solve rate.
                     sync(out.u)
                     io_t0 = time.perf_counter()
-                    if snapshot_every and done % snapshot_every == 0:
-                        writer.submit(
-                            out.u,
-                            os.path.join(save_dir, f"snap_{glob_it:06d}.bin"),
-                        )
-                    if checkpoint_every and done % checkpoint_every == 0:
+                    snap_now = (
+                        snapshot_every and done % snapshot_every == 0
+                    )
+                    ckpt_now = (
+                        checkpoint_every and done % checkpoint_every == 0
+                    )
+                    # one gather serves both writers when they coincide
+                    u_host = (
+                        _fetch(out.u)
+                        if snap_now or (ckpt_now and not checkpoint_sharded)
+                        else None
+                    )
+                    if snap_now:
+                        if is_coord:
+                            writer.submit(
+                                u_host,
+                                os.path.join(
+                                    save_dir, f"snap_{glob_it:06d}.bin"
+                                ),
+                            )
+                    if ckpt_now:
                         if checkpoint_sharded:
                             # per-shard directory: no gather to one host
                             io_utils.save_checkpoint_sharded(
@@ -235,14 +275,16 @@ def run_solver(
                                 physics=physics_meta(solver),
                             )
                         else:
-                            io_utils.save_checkpoint(
-                                os.path.join(
-                                    save_dir, f"checkpoint_{glob_it:06d}.ckpt"
-                                ),
-                                out,
-                                grid=solver.grid,
-                                physics=physics_meta(solver),
-                            )
+                            if is_coord:
+                                io_utils.save_checkpoint(
+                                    os.path.join(
+                                        save_dir,
+                                        f"checkpoint_{glob_it:06d}.ckpt",
+                                    ),
+                                    type(out)(u=u_host, t=out.t, it=out.it),
+                                    grid=solver.grid,
+                                    physics=physics_meta(solver),
+                                )
                         io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
                     io_s += time.perf_counter() - io_t0
                 sync(out.u)
@@ -281,21 +323,31 @@ def run_solver(
     )
 
     if check_error and hasattr(solver, "error_norms"):
-        norms = solver.error_norms(out)
+        # gathered first: eager norm arithmetic mixes the state with a
+        # process-local analytic field, which non-fully-addressable
+        # arrays cannot do (_fetch is collective — all processes call)
+        norms = solver.error_norms(
+            type(out)(u=_fetch(out.u), t=out.t, it=out.it)
+        )
         summary.error_l1, summary.error_l2, summary.error_linf = tuple(norms)
 
     if save_dir:
-        io_utils.save_binary(out.u, os.path.join(save_dir, "result.bin"))
-        summary.write_json(os.path.join(save_dir, "summary.json"))
-        if plot:
-            from multigpu_advectiondiffusion_tpu.utils.plot import plot_field
+        u_host = _fetch(out.u)
+        if is_coord:
+            io_utils.save_binary(u_host, os.path.join(save_dir, "result.bin"))
+            summary.write_json(os.path.join(save_dir, "summary.json"))
+            if plot:
+                from multigpu_advectiondiffusion_tpu.utils.plot import (
+                    plot_field,
+                )
 
-            plot_field(
-                out.u,
-                grid=solver.grid,
-                title=f"{name} t={float(out.t):.4f}",
-                path=os.path.join(save_dir, f"{name}.png"),
-            )
+                plot_field(
+                    u_host,
+                    grid=solver.grid,
+                    title=f"{name} t={float(out.t):.4f}",
+                    path=os.path.join(save_dir, f"{name}.png"),
+                )
 
-    summary.print_block()
+    if is_coord:
+        summary.print_block()
     return summary

@@ -957,6 +957,8 @@ def test_fused2d_split_overlap_matches_serialized(devices, model):
         fused = solver._fused_stepper()
         assert fused is not None and fused.sharded
         assert fused.overlap_split == (overlap == "split")
+        want = "split" if overlap == "split" else "serialized-refresh"
+        assert solver.engaged_path()["overlap"] == want
         outs[overlap] = solver.run(solver.initial_state(), 6)
     a, b = np.asarray(outs["padded"].u), np.asarray(outs["split"].u)
     scale = float(np.abs(a).max())
