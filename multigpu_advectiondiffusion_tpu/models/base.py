@@ -295,11 +295,12 @@ class SolverBase:
             fallback = getattr(
                 self, "_fused_fallback", None
             ) or "config not fused-eligible"
-            if is_pallas_impl(impl) and op == "xla":
-                fallback += (
-                    "; per-axis rung not engaged (measured slower than "
-                    "XLA here — pin with impl='pallas_axis')"
-                )
+            op_reason = getattr(self, "_op_fallback", None)
+            if op_reason:
+                fallback += "; " + op_reason
+        elif is_pallas_impl(impl) and op == "xla":
+            # explicit per-axis rung requested but undispatchable
+            fallback = getattr(self, "_op_fallback", None)
         overlap = (
             getattr(self.cfg, "overlap", None)
             if self.mesh is not None

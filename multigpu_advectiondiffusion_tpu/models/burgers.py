@@ -78,20 +78,31 @@ class BurgersSolver(SolverBase):
 
     def _op_impl(self) -> str:
         """Per-op kernel strategy for this config. Pallas flavors map to
-        the per-axis kernels — EXCEPT WENO7 under ``impl="pallas"``:
-        the per-axis WENO7 kernel measures ~2x slower than XLA at 512^3
-        (PARITY.md ladder), and "pallas" promises best-available, so
-        order 7 keeps XLA unless the rung is explicitly pinned with
-        ``impl="pallas_axis"`` (the ladder's slower variants stay
-        selectable, like the reference's own)."""
+        the per-axis kernels, with two XLA exceptions (both reported via
+        ``engaged_path``): non-f32 dtypes (the per-axis DMA/roll kernels
+        are f32-calibrated and Mosaic has no f64 vector path — a TPU run
+        would fail in the compiler, not fall back), and WENO7 under
+        ``impl="pallas"`` (the per-axis WENO7 kernel measures ~2x slower
+        than XLA at 512^3, PARITY.md ladder; "pallas" promises
+        best-available — pin the rung with ``impl="pallas_axis"``)."""
+        import jax.numpy as jnp
+
         from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
 
         impl = _norm(self.cfg.impl)
-        if (
-            impl == "pallas"
-            and self.cfg.weno_order == 7
-            and self.cfg.impl != "pallas_axis"
-        ):
+        self._op_fallback = None
+        if impl != "pallas":
+            return impl
+        if self.dtype != jnp.float32:
+            self._op_fallback = (
+                "per-axis Pallas kernels are float32-only; XLA runs"
+            )
+            return "xla"
+        if self.cfg.weno_order == 7 and self.cfg.impl != "pallas_axis":
+            self._op_fallback = (
+                "per-axis WENO7 measured slower than XLA; pin with "
+                "impl='pallas_axis'"
+            )
             return "xla"
         return impl
 

@@ -86,6 +86,24 @@ class DiffusionSolver(SolverBase):
         super().__init__(cfg, mesh=mesh, decomp=decomp)
         self.dt = diffusive_dt(cfg.diffusivity, cfg.grid.spacing, cfg.safety)
 
+    def _op_impl(self) -> str:
+        """Per-op kernel strategy: Pallas flavors map to the per-axis
+        kernels for f32 only — the DMA/roll kernels are f32-calibrated
+        and Mosaic has no f64 vector path (a TPU run would fail in the
+        compiler rather than fall back). Reported via engaged_path."""
+        import jax.numpy as jnp
+
+        from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
+
+        impl = _norm(self.cfg.impl)
+        self._op_fallback = None
+        if impl == "pallas" and self.dtype != jnp.float32:
+            self._op_fallback = (
+                "per-axis Pallas kernels are float32-only; XLA runs"
+            )
+            return "xla"
+        return impl
+
     def ic_spec(self):
         """Thread the config's diffusivity/t0 into the analytic ICs so the
         initial state always matches :meth:`exact_solution` at ``t = t0``
@@ -130,9 +148,7 @@ class DiffusionSolver(SolverBase):
 
             ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
 
-            from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
-
-            impl = _norm(cfg.impl)
+            impl = self._op_impl()
 
             def operator(u):
                 return laplacian(

@@ -115,6 +115,27 @@ def test_weno7_pallas_supported_gates():
     assert not pw.supported(1, 7, "js", shape=(1000,))
 
 
+def test_pallas_impls_gate_non_f32_dtypes_to_xla():
+    """Non-f32 dtypes under any pallas flavor dispatch the per-op path
+    to XLA (the per-axis DMA/roll kernels are f32-calibrated and Mosaic
+    has no f64 vector path — on TPU the kernel would fail in the
+    compiler, not fall back), and the engaged path says so."""
+    grid = Grid.make(16, 12, 12, lengths=4.0)
+    d = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float64", impl="pallas"))
+    assert d._op_impl() == "xla"
+    p = d.engaged_path()
+    assert p["stepper"] == "generic-xla" and "float32-only" in p["fallback"]
+    b = BurgersSolver(
+        BurgersConfig(grid=grid, dtype="float64", impl="pallas_axis"))
+    assert b._op_impl() == "xla"
+    assert "float32-only" in b.engaged_path()["fallback"]
+    # f32 keeps the per-axis kernels
+    b32 = BurgersSolver(
+        BurgersConfig(grid=grid, dtype="float32", impl="pallas_axis"))
+    assert b32._op_impl() == "pallas"
+
+
 def test_laplacian_pallas_gates_vmem_exceeding_rows():
     """The 3-D block picker must size the z-block against VMEM, not a
     fixed 8: the reference's 1601x986x35 slab workload (6.6 MB rows)
