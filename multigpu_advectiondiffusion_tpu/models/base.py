@@ -236,6 +236,21 @@ class SolverBase:
         self._fused_fallback = reason
         return None
 
+    def _pallas_f32_gate(self, impl: str) -> str:
+        """Route non-f32 dtypes off the per-axis Pallas kernels: they
+        are f32-calibrated and Mosaic has no f64 vector path — a TPU
+        run would fail in the compiler rather than fall back. The ONE
+        definition both solvers' ``_op_impl`` use; the reason lands in
+        :meth:`engaged_path`."""
+        import jax.numpy as jnp
+
+        if impl == "pallas" and self.dtype != jnp.float32:
+            self._op_fallback = (
+                "per-axis Pallas kernels are float32-only; XLA runs"
+            )
+            return "xla"
+        return impl
+
     def engaged_path(self, mode: str = "iters") -> dict:
         """Which kernel strategy actually executes for this config.
 

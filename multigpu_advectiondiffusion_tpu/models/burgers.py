@@ -79,26 +79,20 @@ class BurgersSolver(SolverBase):
     def _op_impl(self) -> str:
         """Per-op kernel strategy for this config. Pallas flavors map to
         the per-axis kernels, with two XLA exceptions (both reported via
-        ``engaged_path``): non-f32 dtypes (the per-axis DMA/roll kernels
-        are f32-calibrated and Mosaic has no f64 vector path — a TPU run
-        would fail in the compiler, not fall back), and WENO7 under
+        ``engaged_path``): non-f32 dtypes
+        (``SolverBase._pallas_f32_gate``), and WENO7 under
         ``impl="pallas"`` (the per-axis WENO7 kernel measures ~2x slower
         than XLA at 512^3, PARITY.md ladder; "pallas" promises
         best-available — pin the rung with ``impl="pallas_axis"``)."""
-        import jax.numpy as jnp
-
         from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
 
-        impl = _norm(self.cfg.impl)
         self._op_fallback = None
-        if impl != "pallas":
-            return impl
-        if self.dtype != jnp.float32:
-            self._op_fallback = (
-                "per-axis Pallas kernels are float32-only; XLA runs"
-            )
-            return "xla"
-        if self.cfg.weno_order == 7 and self.cfg.impl != "pallas_axis":
+        impl = self._pallas_f32_gate(_norm(self.cfg.impl))
+        if (
+            impl == "pallas"
+            and self.cfg.weno_order == 7
+            and self.cfg.impl != "pallas_axis"
+        ):
             self._op_fallback = (
                 "per-axis WENO7 measured slower than XLA; pin with "
                 "impl='pallas_axis'"
@@ -257,9 +251,24 @@ class BurgersSolver(SolverBase):
                     kwargs["y_sharded"] = y_sharded
                     kwargs["overlap_split"] = self._split_overlap_requested()
                 if cfg.adaptive_dt:
+                    from multigpu_advectiondiffusion_tpu.timestepping.cfl import (  # noqa: E501
+                        dt_from_wave_speed,
+                        max_wave_speed,
+                    )
+
                     reduce = self.mesh_reduce_max()
                     kwargs["dt_fn"] = lambda u: advective_dt(
                         u, self.flux.df, spacing, cfg.cfl, reduce_max=reduce
+                    )
+                    # in-kernel emitted max: the final stage folds
+                    # max|f'(u_next)| so the CFL for the next step needs
+                    # no HBM re-read; wave_fn seeds the first step
+                    # (local max — dt_from_max applies the pmax)
+                    kwargs["dt_from_max"] = lambda m: dt_from_wave_speed(
+                        m, spacing, cfg.cfl, reduce_max=reduce
+                    )
+                    kwargs["wave_fn"] = lambda u: max_wave_speed(
+                        u, self.flux.df
                     )
                 else:
                     kwargs["dt"] = self.dt

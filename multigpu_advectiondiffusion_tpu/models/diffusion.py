@@ -88,21 +88,11 @@ class DiffusionSolver(SolverBase):
 
     def _op_impl(self) -> str:
         """Per-op kernel strategy: Pallas flavors map to the per-axis
-        kernels for f32 only — the DMA/roll kernels are f32-calibrated
-        and Mosaic has no f64 vector path (a TPU run would fail in the
-        compiler rather than fall back). Reported via engaged_path."""
-        import jax.numpy as jnp
-
+        kernels for f32 only (``SolverBase._pallas_f32_gate``)."""
         from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
 
-        impl = _norm(self.cfg.impl)
         self._op_fallback = None
-        if impl == "pallas" and self.dtype != jnp.float32:
-            self._op_fallback = (
-                "per-axis Pallas kernels are float32-only; XLA runs"
-            )
-            return "xla"
-        return impl
+        return self._pallas_f32_gate(_norm(self.cfg.impl))
 
     def ic_spec(self):
         """Thread the config's diffusivity/t0 into the analytic ICs so the

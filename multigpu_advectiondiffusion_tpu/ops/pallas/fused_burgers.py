@@ -290,11 +290,13 @@ def _stage_kernel(
     u_hbm,
     g_hbm,
     out_hbm,
+    mx_ref,
     vs,
     us,
     res,
     gyres,
     gzres,
+    macc,
     sem_v,
     sem_u,
     sem_w,
@@ -336,6 +338,15 @@ def _stage_kernel(
     padded buffer (whose z-ghost rows are stale in split mode), and
     ``z_edge_writes=False`` skips the z edge-replica maintenance (split
     mode never reads buffer z-ghosts).
+
+    ``mx_ref``/``macc`` (non-None only on the emitting final stage of
+    adaptive runs): the kernel folds ``max|f'(rk)|`` over every block's
+    interior lanes into an SMEM accumulator (the TPU grid is
+    sequential) and emits it as a scalar output — the next step's CFL
+    reduction without re-reading the state from HBM. Dead y-rounding
+    columns are edge *replicas* of interior values, so including them
+    cannot raise the max; x lanes beyond ``lx`` hold garbage and are
+    masked out.
     """
     lz, ly, lx = local_shape
     px, w = _x_widths(lx)
@@ -490,6 +501,24 @@ def _stage_kernel(
         edge = (ly - 1) - (n_by - 1) * by
         rk = jnp.where(gy >= ly, rk[:, edge : edge + 1], rk)
 
+    if mx_ref is not None:
+        gxc = lax.broadcasted_iota(jnp.int32, rk.shape, 2)
+        m = jnp.max(
+            jnp.where(gxc < lx, jnp.abs(flux.df(rk)), jnp.zeros_like(rk))
+        ).astype(jnp.float32)
+
+        @pl.when(k == 0)
+        def _():
+            macc[0] = m
+
+        @pl.when(k > 0)
+        def _():
+            macc[0] = jnp.maximum(macc[0], m)
+
+        @pl.when(k == n_blocks - 1)
+        def _():
+            mx_ref[0] = macc[0]
+
     @pl.when(k >= 2)
     def _():
         copy_w(k - 2, slot).wait()
@@ -565,7 +594,8 @@ def _stage_kernel(
 
 
 def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
-                nu_scales, flux, variant, a, b, u_source, role=None):
+                nu_scales, flux, variant, a, b, u_source, role=None,
+                emit_max=False):
     """One fused RK-stage call; output aliased onto the last operand.
 
     ``u_source``: ``"none"`` / ``"operand"`` / ``"target"`` (in-place
@@ -575,6 +605,11 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     serves sharded mode with the serialized between-stage refresh;
     ``"interior"``/``"bottom"``/``"top"`` are the three calls of the
     overlapped z-slab schedule (see :func:`_stage_kernel`).
+
+    ``emit_max`` (final stage of adaptive runs, "full" role only): the
+    call additionally returns the SMEM scalar ``max|f'(u_next)|`` folded
+    across all blocks — the next step's CFL input without an HBM
+    re-read.
     """
     lz = local_shape[0]
     ly_eff = padded_shape[1] - 2 * MARGIN  # ly rounded up to by multiple
@@ -627,12 +662,22 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
             u_hbm = None  # "target": read from out_hbm (in place)
         if use_g:
             g_hbm, *refs = refs
-        _tgt, out_hbm, vs, *refs = refs
+        _tgt, out_hbm, *refs = refs
+        if emit_max:
+            mx_ref, *refs = refs
+        else:
+            mx_ref = None
+        vs, *refs = refs
         if use_u:
             us, *refs = refs
         else:
             us = None
-        res, gyres, gzres, sem_v, *refs = refs
+        res, gyres, gzres, *refs = refs
+        if emit_max:
+            macc, *refs = refs
+        else:
+            macc = None
+        sem_v, *refs = refs
         if use_u:
             sem_u, *refs = refs
         else:
@@ -640,8 +685,8 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
         sem_w, sem_g, *refs = refs
         if use_g:
             (sem_gv,) = refs
-        kern(dt_ref, v_hbm, u_hbm, g_hbm, out_hbm, vs, us, res,
-             gyres, gzres, sem_v, sem_u, sem_w, sem_g, sem_gv)
+        kern(dt_ref, v_hbm, u_hbm, g_hbm, out_hbm, mx_ref, vs, us, res,
+             gyres, gzres, macc, sem_v, sem_u, sem_w, sem_g, sem_gv)
 
     n_in = 1 + (2 if u_source == "operand" else 1) + (1 if use_g else 0) + 1
     yb = by + 2 * MARGIN
@@ -652,6 +697,8 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     scratch.append(pltpu.VMEM((2, bz, by) + trailing, dtype))
     scratch.append(pltpu.VMEM((bz, MARGIN) + trailing, dtype))
     scratch.append(pltpu.VMEM((R, by) + trailing, dtype))
+    if emit_max:
+        scratch.append(pltpu.SMEM((1,), jnp.float32))
     scratch.append(pltpu.SemaphoreType.DMA((2,)))
     if use_u:
         scratch.append(pltpu.SemaphoreType.DMA((2,)))
@@ -663,12 +710,18 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
     in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 1)
 
+    out_specs = pl.BlockSpec(memory_space=pl.ANY)
+    out_shape = jax.ShapeDtypeStruct(tuple(padded_shape), dtype)
+    if emit_max:
+        out_specs = (out_specs, pl.BlockSpec(memory_space=pltpu.SMEM))
+        out_shape = (out_shape, jax.ShapeDtypeStruct((1,), jnp.float32))
+
     return pl.pallas_call(
         kernel,
         grid=(n_bz_grid, n_by),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=scratch,
         input_output_aliases={n_in - 1: 0},  # last operand -> out
         compiler_params=None if interpret_mode() else compiler_params(),
@@ -694,7 +747,8 @@ class FusedBurgersStepper(FusedStepperBase):
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
                  dt_fn=None, block=None, global_shape=None,
-                 y_sharded: bool = False, overlap_split: bool = False):
+                 y_sharded: bool = False, overlap_split: bool = False,
+                 dt_from_max=None, wave_fn=None):
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
         lz, ly, lx = interior_shape
@@ -739,6 +793,19 @@ class FusedBurgersStepper(FusedStepperBase):
         self.overlap_split = bool(
             overlap_split and self.sharded and lz // bz >= 3 and bz >= R
         )
+        # Adaptive mode on the "full" role emits max|f'(u_next)| from
+        # the final stage kernel, replacing the between-step full-array
+        # reduction (one whole HBM read per step). The split schedule's
+        # three stage-3 calls would need a cross-call fold — it keeps
+        # the read-back path.
+        self._emit_max = bool(
+            dt_fn is not None
+            and not self.overlap_split
+            and dt_from_max is not None
+            and wave_fn is not None
+        )
+        self._dt_from_max = dt_from_max
+        self._wave_fn = wave_fn
 
         def mk(role):
             return tuple(
@@ -747,6 +814,11 @@ class FusedBurgersStepper(FusedStepperBase):
                     bz=bz, by=by, inv_dx=inv_dx, nu_scales=nu_scales,
                     flux=flux, variant=variant, a=a, b=b, u_source=src,
                     role=role,
+                    emit_max=(
+                        self._emit_max
+                        and role == "full"
+                        and src == "target"
+                    ),
                 )
                 for (a, b), src in zip(_STAGES, sources)
             )
@@ -783,14 +855,27 @@ class FusedBurgersStepper(FusedStepperBase):
         else:
             s1, s2, s3 = mk("full")
 
-            def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
-                     exch=None):
-                del offsets, exch  # no global wall masks here
-                fix = refresh if refresh is not None else (lambda P: P)
-                T1 = fix(s1(dt_arr, S, T1))
-                T2 = fix(s2(dt_arr, T1, S, T2))
-                S = fix(s3(dt_arr, T2, S))
-                return S, T1, T2
+            if self._emit_max:
+
+                def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                         exch=None):
+                    del offsets, exch  # no global wall masks here
+                    fix = refresh if refresh is not None else (lambda P: P)
+                    T1 = fix(s1(dt_arr, S, T1))
+                    T2 = fix(s2(dt_arr, T1, S, T2))
+                    S, mx = s3(dt_arr, T2, S)
+                    return fix(S), T1, T2, mx[0]
+
+            else:
+
+                def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                         exch=None):
+                    del offsets, exch  # no global wall masks here
+                    fix = refresh if refresh is not None else (lambda P: P)
+                    T1 = fix(s1(dt_arr, S, T1))
+                    T2 = fix(s2(dt_arr, T1, S, T2))
+                    S = fix(s3(dt_arr, T2, S))
+                    return S, T1, T2
 
         self._step = step
 

@@ -55,21 +55,26 @@ class FusedStepperBase:
         ``(lo, hi)`` exchanged z-slabs the stages consume as separate
         operands. ``offsets`` is this shard's int32 global-offset vector
         (consumed only by steppers with global wall masks).
+
+        Steppers with ``_emit_max`` (adaptive Burgers, full role) carry
+        the stage-emitted ``max|f'(u)|`` scalar between steps instead of
+        re-reading the state for the CFL reduction — ``_dt_from_max``
+        must reproduce ``_dt_value`` exactly given the same max, so the
+        two modes are trajectory-identical.
         """
         self._check_sharded_args(refresh, offsets, exch)
         S = self.embed(u)
         if refresh is not None and not self.overlap_split:
             S = refresh(S)
+        dt_of, step_of, m0 = self._loop_pieces(u, refresh, offsets, exch)
 
         def body(i, carry):
-            S, T1, T2, t = carry
-            dt = self._dt_value(S)
-            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
-                                   offsets=offsets, refresh=refresh,
-                                   exch=exch)
-            return S, T1, T2, t + dt.astype(t.dtype)
+            S, T1, T2, t, m = carry
+            dt = dt_of(S, m)
+            S, T1, T2, m = step_of(S, T1, T2, dt, m)
+            return S, T1, T2, t + dt.astype(t.dtype), m
 
-        S, T1, T2, t = lax.fori_loop(0, num_iters, body, (S, S, S, t))
+        S, T1, T2, t, _ = lax.fori_loop(0, num_iters, body, (S, S, S, t, m0))
         return self.extract(S), t
 
     def run_to(self, u, t, t_end, refresh=None, offsets=None, exch=None):
@@ -85,21 +90,48 @@ class FusedStepperBase:
             S = refresh(S)
         te = jnp.asarray(t_end, t.dtype)
         eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+        dt_of, step_of, m0 = self._loop_pieces(u, refresh, offsets, exch)
 
         def cond(carry):
             return carry[3] < te - eps
 
         def body(carry):
-            S, T1, T2, t, it = carry
-            dt = jnp.minimum(
-                self._dt_value(S), (te - t).astype(jnp.float32)
-            )
-            S, T1, T2 = self._step(S, T1, T2, dt.reshape(1),
-                                   offsets=offsets, refresh=refresh,
-                                   exch=exch)
-            return S, T1, T2, t + dt.astype(t.dtype), it + 1
+            S, T1, T2, t, it, m = carry
+            dt = jnp.minimum(dt_of(S, m), (te - t).astype(jnp.float32))
+            S, T1, T2, m = step_of(S, T1, T2, dt, m)
+            return S, T1, T2, t + dt.astype(t.dtype), it + 1, m
 
-        S, T1, T2, t, steps = lax.while_loop(
-            cond, body, (S, S, S, t, jnp.zeros((), jnp.int32))
+        S, T1, T2, t, steps, _ = lax.while_loop(
+            cond, body, (S, S, S, t, jnp.zeros((), jnp.int32), m0)
         )
         return self.extract(S), t, steps
+
+    def _loop_pieces(self, u, refresh, offsets, exch):
+        """``(dt_of(S, m), step_of(S, T1, T2, dt, m), m0)`` — the single
+        place the dt source is chosen, so run()/run_to() each have ONE
+        loop body and the trim/termination semantics cannot fork between
+        the read-back and emit-max modes. Non-emitting steppers carry a
+        dummy scalar ``m``."""
+        emit = getattr(self, "_emit_max", False)
+        m0 = (
+            self._wave_fn(u).astype(jnp.float32)
+            if emit
+            else jnp.zeros((), jnp.float32)
+        )
+
+        def dt_of(S, m):
+            return (
+                self._dt_from_max(m).astype(jnp.float32)
+                if emit
+                else self._dt_value(S)
+            )
+
+        def step_of(S, T1, T2, dt, m):
+            out = self._step(S, T1, T2, dt.reshape(1), offsets=offsets,
+                             refresh=refresh, exch=exch)
+            if emit:
+                return out
+            S, T1, T2 = out
+            return S, T1, T2, m
+
+        return dt_of, step_of, m0

@@ -31,6 +31,24 @@ def max_wave_speed(
     return reduce_max(local) if reduce_max is not None else local
 
 
+def dt_from_wave_speed(
+    a: jnp.ndarray,
+    spacing: Sequence[float],
+    cfl: float,
+    reduce_max=None,
+    floor: float = 1e-12,
+):
+    """CFL dt from an already-computed local ``max|f'(u)|`` scalar — the
+    consumer of the fused steppers' in-kernel wave-speed emission, which
+    replaces the between-step full-array reduction (one whole HBM read
+    per step). The ONE definition of the CFL formula:
+    :func:`advective_dt` composes it, so the emit and read-back paths
+    cannot desynchronize."""
+    if reduce_max is not None:
+        a = reduce_max(a)
+    return cfl * min(spacing) / jnp.maximum(a, floor)
+
+
 def advective_dt(
     u: jnp.ndarray,
     dflux,
@@ -39,5 +57,6 @@ def advective_dt(
     reduce_max=None,
     floor: float = 1e-12,
 ):
-    a = max_wave_speed(u, dflux, reduce_max)
-    return cfl * min(spacing) / jnp.maximum(a, floor)
+    return dt_from_wave_speed(
+        max_wave_speed(u, dflux, reduce_max), spacing, cfl, floor=floor
+    )
