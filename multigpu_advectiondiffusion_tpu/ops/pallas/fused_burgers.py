@@ -56,10 +56,13 @@ Layout and ghost discipline:
   after the initial embed; no core output ever reads them. Lanes beyond
   ``nx`` hold garbage between stages (patched on every load).
 * dt enters as a runtime SMEM scalar, so the same compiled stages serve
-  fixed *and* adaptive dt — the adaptive mode computes the global
-  ``max|f'(u)|`` reduction (``lax.pmax`` across a mesh) between steps,
-  restoring the physically-correct CFL the reference hard-coded away
-  (``MultiGPU/Burgers3d_Baseline/main.c:193``).
+  fixed *and* adaptive dt — restoring the physically-correct CFL the
+  reference hard-coded away (``MultiGPU/Burgers3d_Baseline/main.c:193``).
+  The adaptive mode's ``max|f'(u)|`` is *emitted by the final stage
+  kernel* (folded across blocks in SMEM, x-slack lanes masked) and
+  carried between steps — no HBM re-read; a ``lax.pmax`` on the emitted
+  scalar serves sharded runs. The split-overlap schedule (three final-
+  stage calls) keeps the between-step read-back reduction.
 * Sharded mode (``global_shape`` != ``interior_shape``): the stages run
   shard-local inside ``shard_map`` with an SMEM global-offset operand
   (edge synthesis keyed on *global* coordinates), and the caller
