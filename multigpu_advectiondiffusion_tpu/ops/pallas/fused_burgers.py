@@ -59,10 +59,11 @@ Layout and ghost discipline:
   fixed *and* adaptive dt — restoring the physically-correct CFL the
   reference hard-coded away (``MultiGPU/Burgers3d_Baseline/main.c:193``).
   The adaptive mode's ``max|f'(u)|`` is *emitted by the final stage
-  kernel* (folded across blocks in SMEM, x-slack lanes masked) and
+  kernel(s)* (folded across blocks in SMEM, x-slack lanes masked) and
   carried between steps — no HBM re-read; a ``lax.pmax`` on the emitted
-  scalar serves sharded runs. The split-overlap schedule (three final-
-  stage calls) keeps the between-step read-back reduction.
+  scalar serves sharded runs, and the split-overlap schedule's three
+  final-stage calls each fold their own blocks (combined by two scalar
+  maxes).
 * Sharded mode (``global_shape`` != ``interior_shape``): the stages run
   shard-local inside ``shard_map`` with an SMEM global-offset operand
   (edge synthesis keyed on *global* coordinates), and the caller
@@ -796,14 +797,13 @@ class FusedBurgersStepper(FusedStepperBase):
         self.overlap_split = bool(
             overlap_split and self.sharded and lz // bz >= 3 and bz >= R
         )
-        # Adaptive mode on the "full" role emits max|f'(u_next)| from
-        # the final stage kernel, replacing the between-step full-array
-        # reduction (one whole HBM read per step). The split schedule's
-        # three stage-3 calls would need a cross-call fold — it keeps
-        # the read-back path.
+        # Adaptive mode emits max|f'(u_next)| from the final stage
+        # kernel(s), replacing the between-step full-array reduction
+        # (one whole HBM read per step). The split schedule's three
+        # stage-3 calls each fold their own blocks; the step combines
+        # the partials with two scalar maxes.
         self._emit_max = bool(
             dt_fn is not None
-            and not self.overlap_split
             and dt_from_max is not None
             and wave_fn is not None
         )
@@ -817,11 +817,9 @@ class FusedBurgersStepper(FusedStepperBase):
                     bz=bz, by=by, inv_dx=inv_dx, nu_scales=nu_scales,
                     flux=flux, variant=variant, a=a, b=b, u_source=src,
                     role=role,
-                    emit_max=(
-                        self._emit_max
-                        and role == "full"
-                        and src == "target"
-                    ),
+                    # the final stage emits in every role: the split
+                    # schedule's three calls each fold their own blocks
+                    emit_max=(self._emit_max and src == "target"),
                 )
                 for (a, b), src in zip(_STAGES, sources)
             )
@@ -834,6 +832,7 @@ class FusedBurgersStepper(FusedStepperBase):
             (s1i, s2i, s3i) = mk("interior")
             (s1b, s2b, s3b) = mk("bottom")
             (s1t, s2t, s3t) = mk("top")
+            emitting = self._emit_max
 
             def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
                      exch=None):
@@ -852,6 +851,12 @@ class FusedBurgersStepper(FusedStepperBase):
                 T2 = s2t(dt_arr, T1, S, hi,
                          s2b(dt_arr, T1, S, lo, s2i(dt_arr, T1, S, T2)))
                 lo, hi = exch(T2)
+                if emitting:
+                    Si, mi = s3i(dt_arr, T2, S)
+                    Sb, mb = s3b(dt_arr, T2, lo, Si)
+                    S, mt = s3t(dt_arr, T2, hi, Sb)
+                    m = jnp.maximum(jnp.maximum(mi[0], mb[0]), mt[0])
+                    return S, T1, T2, m
                 S = s3t(dt_arr, T2, hi, s3b(dt_arr, T2, lo, s3i(dt_arr, T2, S)))
                 return S, T1, T2
 

@@ -56,8 +56,8 @@ class FusedStepperBase:
         operands. ``offsets`` is this shard's int32 global-offset vector
         (consumed only by steppers with global wall masks).
 
-        Steppers with ``_emit_max`` (adaptive Burgers, full role) carry
-        the stage-emitted ``max|f'(u)|`` scalar between steps instead of
+        Steppers with ``_emit_max`` (adaptive Burgers) carry the
+        stage-emitted ``max|f'(u)|`` scalar between steps instead of
         re-reading the state for the CFL reduction — ``_dt_from_max``
         must reproduce ``_dt_value`` exactly given the same max, so the
         two modes are trajectory-identical.
