@@ -49,6 +49,10 @@ def _run_script(name, extra, timeout=600):
         ("multigpu_burgers2d.sh",
          ["--n", "32", "32", "--t-end", "0.05",
           "--save", "out/_ex_b2"], (32, 32)),
+        # single-GPU ladder script (whole-run VMEM stepper)
+        ("singlegpu_diffusion2d.sh",
+         ["--n", "48", "48", "--iters", "5",
+          "--save", "out/_ex_s2"], (48, 48)),
     ],
 )
 def test_example_script_runs(tmp_path, script, extra, result_shape):
@@ -61,4 +65,41 @@ def test_example_script_runs(tmp_path, script, extra, result_shape):
     out = _run_script(script, extra)
     assert "kernel path" in out  # the engaged-path PrintSummary line
     u = load_binary(os.path.join(save, "result.bin"), result_shape)
+    assert np.isfinite(u).all()
+
+
+def test_multihost_example_script_runs(tmp_path):
+    """The mpirun-analog launcher: two cooperating CLI processes on the
+    virtual backend, exactly the demo line examples/README.md documents
+    (4 virtual devices per process, dz_dcn=2 x dz_ici=4 needs 8 global)."""
+    import socket
+
+    from multigpu_advectiondiffusion_tpu.utils.io import load_binary
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    save = str(tmp_path / "out")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PORT": str(port),
+    }
+    res = subprocess.run(
+        ["sh", os.path.join(REPO, "examples", "multihost_diffusion3d.sh"),
+         "--impl", "xla", "--overlap", "padded",
+         "--n", "16", "16", "24", "--iters", "3",
+         "--checkpoint-every", "0", "--save", save],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+        timeout=600,
+    )
+    assert res.returncode == 0, (
+        f"multihost script failed:\n{res.stdout[-2000:]}\n"
+        f"{res.stderr[-2000:]}"
+    )
+    u = load_binary(os.path.join(save, "result.bin"), (24, 16, 16))
     assert np.isfinite(u).all()
