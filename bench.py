@@ -266,6 +266,12 @@ def main() -> None:
             solver.grid.num_cells, iters, STAGES[solver.cfg.integrator],
             timing.median_seconds,
         )
+        # the artifact records which kernel path actually ran — a row
+        # that silently fell back to the generic path would say so
+        # instead of publishing a mislabeled rate
+        engaged = solver.engaged_path(
+            "t_end" if mode == "t_end" else "iters"
+        )
         print(
             json.dumps(
                 {
@@ -275,6 +281,7 @@ def main() -> None:
                     "vs_baseline": round(rate / baseline, 3),
                     "spread": round(timing.spread, 4),
                     "outliers": timing.outliers,
+                    "engaged": engaged["stepper"],
                 }
             ),
             flush=True,
