@@ -515,6 +515,29 @@ def test_fused_burgers_sharded_matches_unsharded_fused(
     np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
 
 
+@pytest.mark.parametrize("flux", ["linear", "buckley"])
+def test_fused_burgers3d_generic_flux_matches_xla(flux):
+    """The 3-D fused kernel's generic Lax-Friedrichs split (any Flux,
+    not just the Burgers-specialized identity) plus the emitted
+    max|f'(u)| for a non-identity df must match the XLA path — only the
+    2-D whole-run stepper covered non-Burgers fluxes before."""
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, flux=flux, cfl=0.3, dtype="float32",
+                            ic="gaussian", impl=impl)
+        solver = BurgersSolver(cfg)
+        if impl == "pallas":
+            fused = solver._fused_stepper()
+            assert fused is not None and fused._emit_max
+        st = solver.run(solver.initial_state(), 4)
+        outs[impl] = (np.asarray(st.u), float(st.t))
+    scale = float(np.max(np.abs(outs["xla"][0])))
+    np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
+                               rtol=2e-5, atol=2e-6 * scale)
+    np.testing.assert_allclose(outs["pallas"][1], outs["xla"][1], rtol=1e-6)
+
+
 def test_fused_burgers_adaptive_emits_wave_speed_in_kernel(devices):
     """Adaptive runs emit max|f'(u_next)| from the final stage kernel(s)
     — no between-step HBM re-read (measured: the adaptive row closes to
