@@ -42,6 +42,11 @@ class TimedRun(NamedTuple):
     median_seconds: float  # median-of-reps net execution time
     spread: float  # (max - min) / median of the per-rep net times
     outliers: int = 0  # stalled reps discarded and re-measured
+    # spread over ALL measured reps including later-discarded ones —
+    # keeps the full dispersion evidence in the artifact (a genuinely
+    # bimodal row shows raw_spread >> spread, a stall shows one fat
+    # outlier); equal to ``spread`` when nothing was discarded
+    raw_spread: float = 0.0
 
 
 # A rep whose net time exceeds this multiple of the running median is a
@@ -66,6 +71,7 @@ def _timed(full: Callable, zero: Callable, reps: int) -> TimedRun:
     sync(zero())
 
     bases, raws = [], []
+    discarded = []  # stalled raw times, kept for raw_spread evidence
     outliers = 0
     budget = reps  # extra attempts for discarded reps
     while len(raws) < reps:
@@ -82,6 +88,7 @@ def _timed(full: Callable, zero: Callable, reps: int) -> TimedRun:
         ):
             outliers += 1
             budget -= 1
+            discarded.append(raw)
             continue  # a stall, not a measurement — re-measure
         bases.append(base)
         raws.append(raw)
@@ -91,19 +98,29 @@ def _timed(full: Callable, zero: Callable, reps: int) -> TimedRun:
     # measurement itself (tiny --quick grids), publish the raw time
     # instead of a jitter-dominated rate — conservative, never inflating.
     noise = max(bases) - base
-    if min(nets) <= noise:
+    raw_mode = min(nets) <= noise
+    if raw_mode:
         nets = list(raws)
     # Retrospective guard: the running-median filter above cannot catch a
     # stall in the FIRST rep (nothing to compare against yet) — drop any
     # rep that still exceeds the factor against the full set's median.
+    # loop-discarded stalls, converted once into the published units
+    discarded = [d if raw_mode else d - base for d in discarded]
     med0 = statistics.median(nets)
     kept = [n for n in nets if n <= _OUTLIER_FACTOR * med0]
     if kept and len(kept) < len(nets):
         outliers += len(nets) - len(kept)
+        discarded.extend(n for n in nets if n > _OUTLIER_FACTOR * med0)
         nets = kept
     best, med = min(nets), statistics.median(nets)
     spread = (max(nets) - min(nets)) / med if med > 0 else 0.0
-    return TimedRun(best, warmup, med, spread, outliers)
+    # pre-filter dispersion over every measured rep (kept + discarded),
+    # in the same units as the published nets
+    all_nets = nets + discarded
+    raw_spread = (
+        (max(all_nets) - min(all_nets)) / med if med > 0 else 0.0
+    )
+    return TimedRun(best, warmup, med, spread, outliers, raw_spread)
 
 
 def timed_run(solver, state, iters: int, reps: int = 3) -> TimedRun:

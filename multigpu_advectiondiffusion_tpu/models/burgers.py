@@ -173,8 +173,16 @@ class BurgersSolver(SolverBase):
             return self._decline(f"impl={cfg.impl!r} does not request fusion")
         if self.grid.ndim not in (2, 3):
             return self._decline("fused WENO kernels are 2-D/3-D only")
-        if cfg.weno_order != 5 or cfg.weno_variant not in ("js", "z"):
+        fused_orders = {(5, "js"), (5, "z"), (7, "js")}
+        if (cfg.weno_order, cfg.weno_variant) not in fused_orders:
+            if self.grid.ndim == 3:
+                return self._decline(
+                    "fused kernels implement WENO5-JS/Z and WENO7-JS only"
+                )
             return self._decline("fused kernels implement WENO5-JS/Z only")
+        if cfg.weno_order == 7 and self.grid.ndim != 3:
+            # the 2-D whole-run/per-stage kernels remain WENO5-only
+            return self._decline("fused 2-D kernels implement WENO5 only")
         if cfg.integrator != "ssp_rk3":
             return self._decline("fused kernels bake in SSP-RK3")
         if cfg.nu != 0.0 and cfg.laplacian_order != 4:
@@ -190,16 +198,18 @@ class BurgersSolver(SolverBase):
         )
         if self.grid.ndim == 3:
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (  # noqa: E501
-                R,
                 FusedBurgersStepper as cls,
             )
+            from multigpu_advectiondiffusion_tpu.ops.weno import HALO
 
+            halo = HALO[cfg.weno_order]
             # every sharded axis must serve the stencil halo from its core
             if self.mesh is not None and any(
-                lshape[ax] < R for ax, _ in self.decomp.axes
+                lshape[ax] < halo for ax, _ in self.decomp.axes
             ):
                 return self._decline(
-                    f"a sharded axis is thinner than the WENO5 halo ({R})"
+                    f"a sharded axis is thinner than the WENO{cfg.weno_order}"
+                    f" halo ({halo})"
                 )
             # the lane-aligned x layout stores no x ghosts, so an
             # x-sharded mesh has nothing for the ppermute refresh to
@@ -212,7 +222,8 @@ class BurgersSolver(SolverBase):
             # y-rounding is incompatible only with a y-sharded axis
             # (dead columns would be exchanged as neighbor ghosts)
             y_sharded = self.mesh is not None and 1 in dict(self.decomp.axes)
-            if not cls.supported(lshape, self.dtype, y_sharded=y_sharded):
+            if not cls.supported(lshape, self.dtype, y_sharded=y_sharded,
+                                 order=cfg.weno_order):
                 return self._decline(
                     "no viable VMEM block tiling for this local shape"
                 )
@@ -246,6 +257,7 @@ class BurgersSolver(SolverBase):
             spacing = self.grid.spacing
             kwargs = {}
             if self.grid.ndim == 3:
+                kwargs["order"] = cfg.weno_order
                 if self.mesh is not None:
                     kwargs["global_shape"] = self.grid.shape
                     kwargs["y_sharded"] = y_sharded

@@ -214,6 +214,10 @@ def _make_band_stage(in_rows, out_rows, out_row0, trailing, dtype,
 
     def kernel(*refs):
         dt_ref, offs_ref, v_ref, *rest = refs
+        # checked band contract: the rows the caller assembled must be
+        # exactly what this stage was built for — a mismatch would
+        # silently shift the emitted window
+        assert v_ref.shape[0] == in_rows, (v_ref.shape, in_rows)
         out_ref = rest[-1]
         u = rest[0][...] if use_u else None
         full = stage_fn(v_ref[...], u, dt_ref[0], offs_ref, a=a, b=b)

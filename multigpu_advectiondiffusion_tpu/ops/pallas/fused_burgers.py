@@ -1,4 +1,10 @@
-"""Fully-fused SSP-RK3 Burgers/WENO5 stepping on a persistent padded state.
+"""Fully-fused SSP-RK3 Burgers/WENO stepping on a persistent padded state.
+
+Serves WENO5-JS/Z (halo 3) and WENO7-JS (halo 4, forward-difference
+betas ``ops.weno._weno7_side_nd_e``; reference ground truth
+``Matlab_Prototipes/InviscidBurgersNd/WENO7resAdv_X.m:60-148``) with one
+kernel family — the stencil radius ``r`` parameterizes the layout and
+DMA discipline, the sweep helpers dispatch on ``order``.
 
 The reference's hot loop launches, per RK stage, three direction-sweep
 kernels (``Compute_dF/dG/dH``), an optional Laplacian, and an RK-update
@@ -10,8 +16,8 @@ is far below the VPU roof because XLA materializes the split fluxes and
 interface fluxes between fusions.
 
 This module collapses each RK stage to ONE Pallas kernel over a 2-D
-``(z, y)`` block grid: a ``(bz+6, by+16, X)`` box of the state is DMA'd
-into VMEM and all three WENO5 flux divergences, the viscous Laplacian
+``(z, y)`` block grid: a ``(bz+2r, by+16, X)`` box of the state is DMA'd
+into VMEM and all three WENO flux divergences, the viscous Laplacian
 (when ``nu > 0``), and the RK stage combination are evaluated in VMEM
 before the block's core cells are written back. The kernel is VPU-bound,
 so the design minimizes *arithmetic*, not just traffic:
@@ -32,9 +38,9 @@ so the design minimizes *arithmetic*, not just traffic:
 Layout and ghost discipline:
 
 * The state lives in a *padded, tile-aligned* layout for the whole run:
-  ``(nz+6, 8+ny+8, round128(nx))`` — z carries exactly the 3-row halo
+  ``(nz+2r, 8+ny+8, round128(nx))`` — z carries exactly the r-row halo
   (the leading axis is untiled, any slice is legal), y carries an
-  8-column margin on each side (ghosts in its inner 3 columns) because
+  8-column margin on each side (ghosts in its inner r columns) because
   Mosaic requires sublane-axis DMA offsets to be 8-aligned, and x is
   **lane-aligned at 0 with NO stored ghosts**: x ghost columns are
   synthesized in VMEM at block-load time (edge replicas,
@@ -43,12 +49,12 @@ Layout and ghost discipline:
   every non-x operation and every HBM transfer runs at
   ``round128(nx)`` lanes instead of ``round128(nx+6)`` (at 512^3 that
   one tile is 20% of all traffic and VPU work). The x sweep's circular
-  rolls read the ghosts at the wrap positions (last ``R`` lanes of the
+  rolls read the ghosts at the wrap positions (last ``r`` lanes of the
   working width = left ghosts), exactly like the old inline layout.
   Consequence: the x axis must not be sharded for this stepper (there
   are no stored x ghosts for a ppermute refresh to rewrite; such
   configs use the generic path).
-* Block (kz, ky) reads box ``[kz*bz, kz*bz+bz+6) x [ky*by, ky*by+by+16)``
+* Block (kz, ky) reads box ``[kz*bz, kz*bz+bz+2r) x [ky*by, ky*by+by+16)``
   (both starts/extents 8-aligned in y) and writes only its disjoint core
   box; edge blocks additionally write the adjacent ghost boxes with
   edge-replicated values. Disjoint writes keep the 2-slot DMA pipeline
@@ -101,13 +107,16 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.stepper_base import (
     FusedStepperBase,
 )
 from multigpu_advectiondiffusion_tpu.ops.weno import (
+    HALO,
     _curv,
     _weno5_side_nd,
     _weno5_side_nd_e,
+    _weno7_side_nd_e,
 )
 
-R = 3  # WENO5 stencil radius == persistent ghost width
-MARGIN = 8  # y-side margin: >= R, multiple of the (8) sublane tile
+R = 3  # WENO5 stencil radius; WENO7 instances run with r = HALO[7] = 4
+MARGIN = 8  # y-side margin: >= max stencil radius, multiple of the
+#             (8) sublane tile — covers both orders
 
 
 def _recip(x):
@@ -129,41 +138,48 @@ def _recip(x):
 _VMEM_BUDGET = 72 * 1024 * 1024
 
 
-def _x_widths(lx: int):
+def _x_widths(lx: int, r: int = R):
     """``(px, W)``: stored lane width (interior only, lane-aligned at 0)
-    and the x-sweep working width. The working buffer needs the ``R``
-    right-ghost lanes after ``lx`` and ``R`` left-ghost lanes at its very
+    and the x-sweep working width. The working buffer needs the ``r``
+    right-ghost lanes after ``lx`` and ``r`` left-ghost lanes at its very
     end (read via circular wrap), disjoint — when the stored slack can't
     hold both, the sweep works on a 128-lane-extended value instead."""
     px = round_up(lx, LANE)
-    return px, (px if px - lx >= 2 * R else px + LANE)
+    return px, (px if px - lx >= 2 * r else px + LANE)
 
 
-def _live_bytes(bz: int, by: int, lx: int, itemsize: int) -> int:
-    px, w = _x_widths(lx)
+def _live_bytes(bz: int, by: int, lx: int, itemsize: int,
+                r: int = R, order: int = 5) -> int:
+    px, w = _x_widths(lx, r)
     core = bz * by * px * itemsize
-    slab = (bz + 2 * R) * (by + 2 * MARGIN) * w * itemsize  # one box @W
+    slab = (bz + 2 * r) * (by + 2 * MARGIN) * w * itemsize  # one box @W
     # v double-buffered (2 slabs @W) + ghost-patched w + vp + vm (3
-    # slabs @W) + u/res double-buffered (4 cores) + ~14 live core-sized
-    # sweep intermediates
-    return 5 * slab + 18 * core
+    # slabs @W) + u/res double-buffered (4 cores) + live core-sized
+    # sweep intermediates (~14 for the 5-point sweeps; order 7 keeps 6
+    # e-windows per side plus the beta partial products in flight)
+    return 5 * slab + (18 if order == 5 else 24) * core
 
 
-def _pick_blocks(nz, ny, lx, itemsize):
+def _pick_blocks(nz, ny, lx, itemsize, r: int = R, order: int = 5):
     """First viable block in measured-preference order.
 
-    v5e, 512^3 (lane-aligned layout, roll-based y sweep): (8,64) 9491
-    MLUPS > (16,32) 9378 > (8,16)/(16,16) ~8877 > (16,64) 8289 — beyond
-    (8,64) the larger working set costs more in Mosaic scheduling than
-    the halo amortization returns.
+    v5e, 512^3 (lane-aligned layout, roll-based y sweep), order 5:
+    (8,64) 9491 MLUPS > (16,32) 9378 > (8,16)/(16,16) ~8877 > (16,64)
+    8289 — beyond (8,64) the larger working set costs more in Mosaic
+    scheduling than the halo amortization returns. Order 7 (halo 4, 6
+    e-windows per sweep side live) peaks one size smaller — (8,32) 5247
+    > (4,64) 5206 > (8,16) 5047 > (16,64) 5044 > (8,128) 4988 > (8,64)
+    4553 (out/weno7_block_exp.py sweeps) — so its y preference leads
+    with 32.
     """
-    for by in (64, 128, 32, 16, 8):
+    by_pref = (64, 128, 32, 16, 8) if order == 5 else (32, 64, 16, 128, 8)
+    for by in by_pref:
         if ny % by:
             continue
         for bz in (8, 7, 6, 5, 4, 3, 2, 1):
             if nz % bz:
                 continue
-            if _live_bytes(bz, by, lx, itemsize) <= _VMEM_BUDGET:
+            if _live_bytes(bz, by, lx, itemsize, r, order) <= _VMEM_BUDGET:
                 return (bz, by)
     return None
 
@@ -181,39 +197,49 @@ def _split(flux: Flux, v):
     return 0.5 * (fu + a * v), 0.5 * (fu - a * v)
 
 
-def _div_z(vp, vm, bz, by, inv_dx, variant):
+def _div_z(vp, vm, bz, by, inv_dx, variant, order=5, r=R):
     """Flux divergence along z of the core box via slab row slices.
 
-    Interface row ``s`` (0..bz) sits right of slab row ``R-1+s``; the
-    minus window is vp rows ``s..s+4`` (center ``s+2``), the plus window
-    vm rows ``s+1..s+5`` (center ``s+3``). The betas' curvature terms
-    are windows of one shared array per side (``_curv``); row slices of
-    the leading axis are free.
+    Interface row ``s`` (0..bz) sits right of slab row ``r-1+s``; the
+    minus window is vp rows ``s..s+2r-2`` (center ``s+r-1``), the plus
+    window vm rows ``s+1..s+2r-1`` (center ``s+r``). For order 5 the
+    betas' curvature terms are windows of one shared array per side
+    (``_curv``); order 7 uses the e-form per window (its betas are
+    quadratic forms of the same shared first-difference arrays). Row
+    slices of the leading axis are free.
     """
     yc = slice(MARGIN, MARGIN + by)
     p = vp[:, yc]
     m = vm[:, yc]
     ep = p[1:] - p[:-1]
     em = m[1:] - m[:-1]
-    cp = _curv(ep[1:] - ep[:-1])
-    cm = _curv(em[1:] - em[:-1])
-    nm, dm = _weno5_side_nd(
-        *(ep[j : j + bz + 1] for j in range(4)),
-        *(cp[j : j + bz + 1] for j in range(3)),
-        variant, "minus",
-    )
-    np_, dp = _weno5_side_nd(
-        *(em[j + 1 : j + 2 + bz] for j in range(4)),
-        *(cm[j + 1 : j + 2 + bz] for j in range(3)),
-        variant, "plus",
-    )
-    h = (p[2 : 3 + bz] + m[3 : 4 + bz]) + (
+    if order == 7:
+        nm, dm = _weno7_side_nd_e(
+            *(ep[j : j + bz + 1] for j in range(6)), "minus"
+        )
+        np_, dp = _weno7_side_nd_e(
+            *(em[j + 1 : j + 2 + bz] for j in range(6)), "plus"
+        )
+    else:
+        cp = _curv(ep[1:] - ep[:-1])
+        cm = _curv(em[1:] - em[:-1])
+        nm, dm = _weno5_side_nd(
+            *(ep[j : j + bz + 1] for j in range(4)),
+            *(cp[j : j + bz + 1] for j in range(3)),
+            variant, "minus",
+        )
+        np_, dp = _weno5_side_nd(
+            *(em[j + 1 : j + 2 + bz] for j in range(4)),
+            *(cm[j + 1 : j + 2 + bz] for j in range(3)),
+            variant, "plus",
+        )
+    h = (p[r - 1 : r + bz] + m[r : r + 1 + bz]) + (
         nm * _recip(dm) + np_ * _recip(dp)
     )
     return (h[1:] - h[:-1]) * inv_dx
 
 
-def _div_y(vp, vm, bz, by, inv_dx, variant):
+def _div_y(vp, vm, bz, by, inv_dx, variant, order=5, r=R):
     """Flux divergence along y of the core box via sublane *rolls* over
     the full margin-carrying width.
 
@@ -224,33 +250,44 @@ def _div_y(vp, vm, bz, by, inv_dx, variant):
     (the kernel is shift-bound, not FLOP-bound). Wrapped rows land only
     in margin columns, which the core output slice discards.
     """
-    h = _div_roll(vp[R : R + bz], vm[R : R + bz], 1, inv_dx, variant)
+    h = _div_roll(vp[r : r + bz], vm[r : r + bz], 1, inv_dx, variant,
+                  order)
     return h[:, MARGIN : MARGIN + by]
 
 
-def _div_roll(vp, vm, axis, inv_dx, variant):
+def _div_roll(vp, vm, axis, inv_dx, variant, order=5):
     """Flux divergence along ``axis`` via circular shifts (e-form);
     wrapped positions land only in ghost/slack outputs, which the edge
     synthesis overwrites. Used for the lane (x) axis here and for both
     axes of the 2-D whole-run stepper (:mod:`fused_burgers2d`)."""
     ep = _shift(vp, 1, axis) - vp
     em = _shift(vm, 1, axis) - vm
-    # curvature per-window (_weno5_side_nd_e): a shared cd array would
-    # cost 4 extra rolls — the binding resource — while recomputing from
-    # the already-rolled windows is ALU-only
-    nm, dm = _weno5_side_nd_e(
-        *(_shift(ep, j - 2, axis) for j in range(4)),
-        variant, "minus",
-    )
-    np_, dp = _weno5_side_nd_e(
-        *(_shift(em, j - 1, axis) for j in range(4)),
-        variant, "plus",
-    )
+    if order == 7:
+        # 6 e-windows per side (shifts -3..+2 minus / -2..+3 plus); the
+        # betas are ALU-only quadratic forms of the rolled windows
+        nm, dm = _weno7_side_nd_e(
+            *(_shift(ep, j - 3, axis) for j in range(6)), "minus"
+        )
+        np_, dp = _weno7_side_nd_e(
+            *(_shift(em, j - 2, axis) for j in range(6)), "plus"
+        )
+    else:
+        # curvature per-window (_weno5_side_nd_e): a shared cd array
+        # would cost 4 extra rolls — the binding resource — while
+        # recomputing from the already-rolled windows is ALU-only
+        nm, dm = _weno5_side_nd_e(
+            *(_shift(ep, j - 2, axis) for j in range(4)),
+            variant, "minus",
+        )
+        np_, dp = _weno5_side_nd_e(
+            *(_shift(em, j - 1, axis) for j in range(4)),
+            variant, "plus",
+        )
     h = (vp + _shift(vm, 1, axis)) + (nm * _recip(dm) + np_ * _recip(dp))
     return (h - _shift(h, -1, axis)) * inv_dx
 
 
-def _div_x(vp, vm, inv_dx, variant):
+def _div_x(vp, vm, inv_dx, variant, order=5):
     """Flux divergence along x (lanes) of the core box.
 
     Lane rolls, deliberately: routing this sweep through an in-VMEM
@@ -261,11 +298,11 @@ def _div_x(vp, vm, inv_dx, variant):
     block for each strategy: the transposes ride the same VPU permute
     unit and cost exactly the lane-vs-sublane premium they remove.
     Measured rejection table in PARITY.md."""
-    return _div_roll(vp, vm, 2, inv_dx, variant)
+    return _div_roll(vp, vm, 2, inv_dx, variant, order)
 
 
-def _laplacian(v, vc_w, bz, by, px, scales):
-    """O4 Laplacian of the core box (radius 2 < R, fits the same halo).
+def _laplacian(v, vc_w, bz, by, px, scales, r=R):
+    """O4 Laplacian of the core box (radius 2 < r, fits the same halo).
 
     ``v`` is the px-wide box (z/y terms need no x ghosts); ``vc_w`` the
     W-wide core whose circular x shifts read the synthesized ghost lanes
@@ -273,13 +310,13 @@ def _laplacian(v, vc_w, bz, by, px, scales):
     margin-carrying rows and slice the (tile-aligned, free) core columns
     — same rolls-beat-realignments measurement as :func:`_div_y`."""
     yc = slice(MARGIN, MARGIN + by)
-    vrows = v[R : R + bz]
+    vrows = v[r : r + bz]
     acc = None
     for axis in range(3):
         for j, c in enumerate(O4_COEFFS):
             coef = jnp.asarray(c * scales[axis], v.dtype)
             if axis == 0:
-                term = v[j + 1 : j + 1 + bz, yc] * coef
+                term = v[j + r - 2 : j + r - 2 + bz, yc] * coef
             elif axis == 1:
                 term = _shift(vrows, j - 2, 1)[:, yc] * coef
             else:
@@ -319,6 +356,8 @@ def _stage_kernel(
     variant: str,
     a: float,
     b: float,
+    order: int = 5,
+    r: int = R,
     kz_base: int = 0,
     n_bz_grid: int | None = None,
     ghost_src: str | None = None,
@@ -337,7 +376,7 @@ def _stage_kernel(
     calls so XLA can run interior compute concurrently with the halo
     ppermute): ``kz_base`` offsets this call's z-blocks inside the slab,
     ``n_bz_grid`` is this call's z-grid extent (default: all blocks),
-    ``ghost_src`` = ``"lo"``/``"hi"`` DMAs the R z-ghost rows of the box
+    ``ghost_src`` = ``"lo"``/``"hi"`` DMAs the ``r`` z-ghost rows of the box
     from the separate exchanged-slab operand ``g_hbm`` instead of the
     padded buffer (whose z-ghost rows are stale in split mode), and
     ``z_edge_writes=False`` skips the z edge-replica maintenance (split
@@ -353,7 +392,7 @@ def _stage_kernel(
     masked out.
     """
     lz, ly, lx = local_shape
-    px, w = _x_widths(lx)
+    px, w = _x_widths(lx, r)
     if n_bz_grid is None:
         n_bz_grid = n_bz
     kz = pl.program_id(0) + kz_base  # absolute z-block index
@@ -380,7 +419,7 @@ def _stage_kernel(
         if ghost_src is None:
             return [
                 pltpu.make_async_copy(
-                    v_hbm.at[pl.ds(z0, bz + 2 * R), ysl],
+                    v_hbm.at[pl.ds(z0, bz + 2 * r), ysl],
                     _xsl(vs.at[s]),
                     sem_v.at[s],
                 )
@@ -390,25 +429,25 @@ def _stage_kernel(
             return [
                 pltpu.make_async_copy(
                     g_hbm.at[:, ysl],
-                    _xsl(vs.at[s, pl.ds(0, R)]),
+                    _xsl(vs.at[s, pl.ds(0, r)]),
                     sem_gv.at[s],
                 ),
                 pltpu.make_async_copy(
-                    v_hbm.at[pl.ds(z0 + R, bz + R), ysl],
-                    _xsl(vs.at[s, pl.ds(R, bz + R)]),
+                    v_hbm.at[pl.ds(z0 + r, bz + r), ysl],
+                    _xsl(vs.at[s, pl.ds(r, bz + r)]),
                     sem_v.at[s],
                 ),
             ]
         # top shard edge
         return [
             pltpu.make_async_copy(
-                v_hbm.at[pl.ds(z0, bz + R), ysl],
-                _xsl(vs.at[s, pl.ds(0, bz + R)]),
+                v_hbm.at[pl.ds(z0, bz + r), ysl],
+                _xsl(vs.at[s, pl.ds(0, bz + r)]),
                 sem_v.at[s],
             ),
             pltpu.make_async_copy(
                 g_hbm.at[:, ysl],
-                _xsl(vs.at[s, pl.ds(bz + R, R)]),
+                _xsl(vs.at[s, pl.ds(bz + r, r)]),
                 sem_gv.at[s],
             ),
         ]
@@ -418,7 +457,7 @@ def _stage_kernel(
         src = u_hbm if u_hbm is not None else out_hbm
         return pltpu.make_async_copy(
             src.at[
-                pl.ds(R + z0, bz),
+                pl.ds(r + z0, bz),
                 pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
             ],
             us.at[s],
@@ -430,7 +469,7 @@ def _stage_kernel(
         return pltpu.make_async_copy(
             res.at[s],
             out_hbm.at[
-                pl.ds(R + z0, bz),
+                pl.ds(r + z0, bz),
                 pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
             ],
             sem_w.at[s],
@@ -458,16 +497,16 @@ def _stage_kernel(
     # x ghost synthesis on the freshly-loaded box: the stored layout
     # carries no x ghosts, so patch the slack/tail lanes with edge
     # replicas (WENO5resAdv_X.m:53) — right ghosts right after the
-    # interior at lanes lx..lx+R-1, left ghosts at the wrap positions
-    # W-R..W-1 the circular x sweep reads. Replaces the old layout's
+    # interior at lanes lx..lx+r-1, left ghosts at the wrap positions
+    # W-r..W-1 the circular x sweep reads. Replaces the old layout's
     # per-stage x edge rewrite on the store side; x is never sharded
     # here, so local replication is correct in every world.
     v = vs[slot]
     gxw = lax.broadcasted_iota(jnp.int32, v.shape, 2)
     v = jnp.where(gxw >= lx, v[:, :, lx - 1 : lx], v)
-    v = jnp.where(gxw >= w - R, v[:, :, 0:1], v)
+    v = jnp.where(gxw >= w - r, v[:, :, 0:1], v)
 
-    vc = v[R : R + bz, MARGIN : MARGIN + by, :px]
+    vc = v[r : r + bz, MARGIN : MARGIN + by, :px]
     dtype = v.dtype
     dt = dt_ref[0].astype(dtype)
 
@@ -477,19 +516,22 @@ def _stage_kernel(
     # stored px lanes).
     vp, vm = _split(flux, v)
     rhs = -(
-        _div_z(vp[:, :, :px], vm[:, :, :px], bz, by, inv_dx[0], variant)
-        + _div_y(vp[:, :, :px], vm[:, :, :px], bz, by, inv_dx[1], variant)
+        _div_z(vp[:, :, :px], vm[:, :, :px], bz, by, inv_dx[0], variant,
+               order, r)
+        + _div_y(vp[:, :, :px], vm[:, :, :px], bz, by, inv_dx[1], variant,
+                 order, r)
         + _div_x(
-            vp[R : R + bz, MARGIN : MARGIN + by],
-            vm[R : R + bz, MARGIN : MARGIN + by],
+            vp[r : r + bz, MARGIN : MARGIN + by],
+            vm[r : r + bz, MARGIN : MARGIN + by],
             inv_dx[2],
             variant,
+            order,
         )[:, :, :px]
     )
     if nu_scales is not None:
         rhs = rhs + _laplacian(
-            v[:, :, :px], v[R : R + bz, MARGIN : MARGIN + by], bz, by, px,
-            nu_scales,
+            v[:, :, :px], v[r : r + bz, MARGIN : MARGIN + by], bz, by, px,
+            nu_scales, r,
         )
 
     rk = b * (vc + dt * rhs) if a == 0.0 else a * us[slot] + b * (vc + dt * rhs)
@@ -534,12 +576,12 @@ def _stage_kernel(
 
     # y ghost+margin boxes: written by the shard-edge y-blocks with the
     # edge-replicated core column (meaningful only at *global* edges —
-    # elsewhere the refresh overwrites the inner R ghost columns).
+    # elsewhere the refresh overwrites the inner ``r`` ghost columns).
     @pl.when(ky == 0)
     def _():
         gyres[:] = jnp.broadcast_to(res[slot][:, 0:1], gyres.shape)
         cp = pltpu.make_async_copy(
-            gyres, out_hbm.at[pl.ds(R + z0, bz), pl.ds(0, MARGIN)], sem_g
+            gyres, out_hbm.at[pl.ds(r + z0, bz), pl.ds(0, MARGIN)], sem_g
         )
         cp.start()
         cp.wait()
@@ -550,7 +592,7 @@ def _stage_kernel(
         cp = pltpu.make_async_copy(
             gyres,
             out_hbm.at[
-                pl.ds(R + z0, bz),
+                pl.ds(r + z0, bz),
                 pl.ds(pl.multiple_of(MARGIN + ly_eff, SUBLANE), MARGIN),
             ],
             sem_g,
@@ -568,7 +610,7 @@ def _stage_kernel(
             cp = pltpu.make_async_copy(
                 gzres,
                 out_hbm.at[
-                    pl.ds(0, R),
+                    pl.ds(0, r),
                     pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
                 ],
                 sem_g,
@@ -582,7 +624,7 @@ def _stage_kernel(
             cp = pltpu.make_async_copy(
                 gzres,
                 out_hbm.at[
-                    pl.ds(R + lz, R),
+                    pl.ds(r + lz, r),
                     pl.ds(pl.multiple_of(MARGIN + y0, SUBLANE), by),
                 ],
                 sem_g,
@@ -599,7 +641,7 @@ def _stage_kernel(
 
 def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
                 nu_scales, flux, variant, a, b, u_source, role=None,
-                emit_max=False):
+                emit_max=False, order=5, r=R):
     """One fused RK-stage call; output aliased onto the last operand.
 
     ``u_source``: ``"none"`` / ``"operand"`` / ``"target"`` (in-place
@@ -618,7 +660,7 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     lz = local_shape[0]
     ly_eff = padded_shape[1] - 2 * MARGIN  # ly rounded up to by multiple
     trailing = padded_shape[2:]
-    px, w = _x_widths(local_shape[2])
+    px, w = _x_widths(local_shape[2], r)
     assert trailing == (px,), (trailing, px)
     use_u = u_source != "none"
     n_bz, n_by = lz // bz, ly_eff // by
@@ -650,6 +692,8 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
         variant=variant,
         a=a,
         b=b,
+        order=order,
+        r=r,
         kz_base=kz_base,
         n_bz_grid=n_bz_grid,
         ghost_src=ghost_src,
@@ -695,12 +739,12 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     n_in = 1 + (2 if u_source == "operand" else 1) + (1 if use_g else 0) + 1
     yb = by + 2 * MARGIN
     # the v slot is W-wide (ghost-synthesis tail); cores/ghost boxes px
-    scratch = [pltpu.VMEM((2, bz + 2 * R, yb, w), dtype)]
+    scratch = [pltpu.VMEM((2, bz + 2 * r, yb, w), dtype)]
     if use_u:
         scratch.append(pltpu.VMEM((2, bz, by) + trailing, dtype))
     scratch.append(pltpu.VMEM((2, bz, by) + trailing, dtype))
     scratch.append(pltpu.VMEM((bz, MARGIN) + trailing, dtype))
-    scratch.append(pltpu.VMEM((R, by) + trailing, dtype))
+    scratch.append(pltpu.VMEM((r, by) + trailing, dtype))
     if emit_max:
         scratch.append(pltpu.SMEM((1,), jnp.float32))
     scratch.append(pltpu.SemaphoreType.DMA((2,)))
@@ -743,7 +787,7 @@ class FusedBurgersStepper(FusedStepperBase):
     shard-local mode (see module docstring).
     """
 
-    halo = R
+    halo = R  # class default; instances set halo = HALO[order]
     # interior origin in the padded layout; x is lane-aligned at 0 (no
     # stored x ghosts — x must not be sharded for this stepper)
     core_offsets = (R, MARGIN, 0)
@@ -752,9 +796,17 @@ class FusedBurgersStepper(FusedStepperBase):
                  variant: str, nu: float, dt: float | None = None,
                  dt_fn=None, block=None, global_shape=None,
                  y_sharded: bool = False, overlap_split: bool = False,
-                 dt_from_max=None, wave_fn=None):
+                 dt_from_max=None, wave_fn=None, order: int = 5):
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
+        if order not in HALO:
+            raise ValueError(f"unsupported WENO order {order}")
+        if order == 7 and variant != "js":
+            raise ValueError("WENO7 supports only the 'js' variant")
+        r = HALO[order]
+        self.order = order
+        self.halo = r
+        self.core_offsets = (r, MARGIN, 0)
         lz, ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
@@ -769,13 +821,13 @@ class FusedBurgersStepper(FusedStepperBase):
             )
         ly_eff = round_up(ly, SUBLANE)
         self.padded_shape = (
-            lz + 2 * R,
+            lz + 2 * r,
             ly_eff + 2 * MARGIN,
-            _x_widths(lx)[0],
+            _x_widths(lx, r)[0],
         )
         self.dtype = jnp.dtype(dtype)
         blk = block if block is not None else _pick_blocks(
-            lz, ly_eff, lx, self.dtype.itemsize
+            lz, ly_eff, lx, self.dtype.itemsize, r, order
         )
         if blk is None or lz % blk[0] or ly_eff % blk[1] or blk[1] % 8:
             raise ValueError(
@@ -790,12 +842,12 @@ class FusedBurgersStepper(FusedStepperBase):
             ]
         sources = ("none", "operand", "target")
         # The split-overlap z-slab schedule needs a strict interior band
-        # (n_bz >= 3) AND bz >= R: with a thinner block, the first
+        # (n_bz >= 3) AND bz >= r: with a thinner block, the first
         # interior-role block's box (padded rows [bz, ...)) would reach
-        # into the z-ghost rows [0, R) that split mode never refreshes.
+        # into the z-ghost rows [0, r) that split mode never refreshes.
         # Otherwise fall back to the serialized refresh.
         self.overlap_split = bool(
-            overlap_split and self.sharded and lz // bz >= 3 and bz >= R
+            overlap_split and self.sharded and lz // bz >= 3 and bz >= r
         )
         # Adaptive mode emits max|f'(u_next)| from the final stage
         # kernel(s), replacing the between-step full-array reduction
@@ -816,7 +868,7 @@ class FusedBurgersStepper(FusedStepperBase):
                     self.padded_shape, self.interior_shape, self.dtype,
                     bz=bz, by=by, inv_dx=inv_dx, nu_scales=nu_scales,
                     flux=flux, variant=variant, a=a, b=b, u_source=src,
-                    role=role,
+                    role=role, order=order, r=r,
                     # the final stage emits in every role: the split
                     # schedule's three calls each fold their own blocks
                     emit_max=(self._emit_max and src == "target"),
@@ -888,29 +940,33 @@ class FusedBurgersStepper(FusedStepperBase):
         self._step = step
 
     @staticmethod
-    def supported(interior_shape, dtype, y_sharded: bool = False) -> bool:
+    def supported(interior_shape, dtype, y_sharded: bool = False,
+                  order: int = 5) -> bool:
         lz, ly, lx = interior_shape
         if y_sharded and ly % SUBLANE:
             return False
         ly_eff = round_up(ly, SUBLANE)
         return (
-            _pick_blocks(lz, ly_eff, lx, jnp.dtype(dtype).itemsize)
+            _pick_blocks(lz, ly_eff, lx, jnp.dtype(dtype).itemsize,
+                         HALO[order], order)
             is not None
         )
 
     def embed(self, u):
+        r = self.halo
         lz, ly, lx = self.interior_shape
         pz, py, px = self.padded_shape
         return jnp.pad(
             u.astype(self.dtype),
-            ((R, pz - lz - R), (MARGIN, py - ly - MARGIN), (0, px - lx)),
+            ((r, pz - lz - r), (MARGIN, py - ly - MARGIN), (0, px - lx)),
             mode="edge",
         )
 
     def extract(self, S):
+        r = self.halo
         lz, ly, lx = self.interior_shape
         return lax.slice(
-            S, (R, MARGIN, 0), (R + lz, MARGIN + ly, lx)
+            S, (r, MARGIN, 0), (r + lz, MARGIN + ly, lx)
         )
 
     def _dt_value(self, S):

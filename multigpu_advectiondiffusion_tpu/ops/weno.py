@@ -201,6 +201,80 @@ def _weno5_side_nd(e0, e1, e2, e3, cd0, cd1, cd2, variant, side):
 
 
 
+# WENO7 smoothness indicators as quadratic forms in the three first
+# differences of each 4-cell stencil. The q-form betas (``_weno7_betas``
+# below, ``WENO7resAdv_X.m:60-83``) are shift-invariant, so the rewrite
+# ``beta_k = A ea^2 + B eb^2 + C ec^2 + D ea eb + E eb ec + F ea ec``
+# with ``(ea, eb, ec) = (e_k, e_{k+1}, e_{k+2})`` is exact; coefficients
+# derived symbolically in ``out/weno7_diffform.py``. Note the mirror
+# symmetry (beta3/beta0, beta2/beta1 swap A<->C, D<->E) — the same
+# left/right symmetry the q-form hides.
+_B7 = (
+    (6649.0, 45076.0, 25729.0, -33916.0, -63436.0, 22778.0),
+    (3169.0, 17236.0, 6649.0, -13036.0, -17116.0, 5978.0),
+    (6649.0, 17236.0, 3169.0, -17116.0, -13036.0, 5978.0),
+    (25729.0, 45076.0, 6649.0, -63436.0, -33916.0, 22778.0),
+)
+
+# Candidate-polynomial deviations from the center cell (x12), in the
+# same per-stencil difference windows: stencil k's candidate is
+# ``c + (ca e_k + cb e_{k+1} + cc e_{k+2})/12``. Derived alongside _B7;
+# the plus side is the minus side under ``e_j -> -e_{5-j}``.
+_C7 = {
+    "minus": ((3.0, -10.0, 13.0), (-1.0, 4.0, 3.0),
+              (1.0, 6.0, -1.0), (9.0, -4.0, 1.0)),
+    "plus": ((-1.0, 4.0, -9.0), (1.0, -6.0, -1.0),
+             (-3.0, -4.0, 1.0), (-13.0, 10.0, -3.0)),
+}
+
+
+def _weno7_side_nd_e(e0, e1, e2, e3, e4, e5, side):
+    """One WENO7-JS reconstruction in forward-difference form, returned
+    as unnormalized ``(numerator, denominator)`` of the deviation from
+    the center cell: the reconstructed value is ``q3 + num/den``.
+
+    ``e_j = q_{j+1} - q_j`` over the 7-cell window ``q0..q6`` (center
+    ``q3``). ``side`` as in :func:`_weno5_side_nd`. The betas are the
+    :data:`_B7` quadratic forms; the nonlinear weights use the
+    division-free formulation (multiply every textbook alpha
+    ``d_k/(eps+beta_k)^2`` by ``(prod_j (eps+beta_j))^2``):
+    ``alpha_k' = d_k (prod_{j != k} s_j)^2`` with ``s_j = beta_j + eps``,
+    associated as ``(s s s)^2`` so every intermediate stays normal.
+
+    Range note (f32): alphas' scale as ``beta^6`` — the smooth-field
+    floor is ``d_min eps^6 ~ 2.9e-38`` (just above f32 min normal, no
+    flush) and the top overflows when ``beta > ~2.6e6``, i.e.
+    cell-to-cell jumps in the split flux beyond ~3.6. The solvers'
+    bounded states (|u| ~ 1) keep split-flux jumps under ~3, inside the
+    window; larger-amplitude data belongs on the f64 XLA path.
+    """
+    e = (e0, e1, e2, e3, e4, e5)
+    d = _D7 if side == "minus" else tuple(reversed(_D7))
+    cs = _C7[side]
+    s = []
+    for k in range(4):
+        A, B, C, D, E, F = _B7[k]
+        ea, eb, ec = e[k], e[k + 1], e[k + 2]
+        beta = (A * ea + D * eb + F * ec) * ea + (B * eb + E * ec) * eb \
+            + C * (ec * ec)
+        s.append(beta + EPSILON)
+    # shared partial products: each alpha' is d_k * (product of the
+    # OTHER three s_j) squared
+    p01 = s[0] * s[1]
+    p23 = s[2] * s[3]
+    m = (s[1] * p23, s[0] * p23, p01 * s[3], p01 * s[2])
+    t = 1.0 / 12.0
+    num = None
+    den = None
+    for k in range(4):
+        a = d[k] * (m[k] * m[k])
+        ca, cb, cc = cs[k]
+        dev = (ca * t) * e[k] + (cb * t) * e[k + 1] + (cc * t) * e[k + 2]
+        num = a * dev if num is None else num + a * dev
+        den = a if den is None else den + a
+    return num, den
+
+
 def _weno7_betas(q):
     m3, m2, m1, c, p1, p2, p3 = q
     b0 = (

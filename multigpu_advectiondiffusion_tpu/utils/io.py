@@ -400,11 +400,21 @@ def load_checkpoint(path: str, sharding=None) -> SolverState:
     if os.path.isdir(path):
         return load_checkpoint_sharded(path, sharding=sharding)
     if not path.endswith(".npz"):
-        return _load_ckpt(path)
-    with np.load(path, allow_pickle=False) as z:
-        return SolverState(
-            u=jnp.asarray(z["u"]), t=jnp.asarray(z["t"]), it=jnp.asarray(z["it"])
-        )
+        st = _load_ckpt(path)
+    else:
+        with np.load(path, allow_pickle=False) as z:
+            st = SolverState(
+                u=jnp.asarray(z["u"]), t=jnp.asarray(z["t"]),
+                it=jnp.asarray(z["it"]),
+            )
+    if sharding is not None:
+        # single-file checkpoints load as one host array; honor the
+        # requested placement here so direct API callers get the same
+        # contract as the .ckptd directory path
+        import jax
+
+        st = SolverState(u=jax.device_put(st.u, sharding), t=st.t, it=st.it)
+    return st
 
 
 # --------------------------------------------------------------------- #

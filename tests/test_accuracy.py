@@ -198,3 +198,22 @@ def test_weno7_linear_part_is_seventh_order():
         [-3.0, 25.0, -101.0, 319.0, 214.0, -38.0, 4.0],
         rtol=1e-12, atol=1e-9,
     )
+
+
+def test_weno7_difference_form_matches_q_form():
+    """The fused kernels' forward-difference WENO7 reconstruction
+    (``_weno7_side_nd_e`` — betas as _B7 quadratic forms in the window's
+    first differences, division-free weights, deviation-from-center
+    candidates) must equal the q-form oracle ``_weno7_minus``/``_plus``
+    on arbitrary data. f64 pins the algebraic identity to round-off."""
+    import multigpu_advectiondiffusion_tpu.ops.weno as W
+
+    rng = np.random.default_rng(7)
+    q = [jnp.asarray(rng.standard_normal(257), jnp.float64) * s
+         for s in (1.0, 3.0, 0.1, 1.0, 2.0, 0.5, 1.0)]
+    e = [q[j + 1] - q[j] for j in range(6)]
+    for side, oracle in (("minus", W._weno7_minus), ("plus", W._weno7_plus)):
+        num, den = W._weno7_side_nd_e(*e, side)
+        got = np.asarray(q[3] + num / den)
+        ref = np.asarray(oracle(q))
+        np.testing.assert_allclose(got, ref, rtol=1e-11, atol=1e-13)

@@ -70,6 +70,22 @@ def test_burgers3d_sharded_bit_identical(devices, variant):
     assert float(ref.t) == float(out.t)
 
 
+def test_burgers3d_weno7_sharded(devices):
+    """3-D WENO7 (halo 4) under a pencil mesh on the generic path: the
+    4-deep ppermute exchange must reproduce the unsharded trajectory
+    (adaptive dt, so the pmax reduction is exercised too)."""
+    grid = Grid.make(16, 16, 16, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, weno_order=7, nu=1e-5, dtype="float64")
+    mesh = make_mesh({"dz": 2, "dy": 2})
+    ref = BurgersSolver(cfg).run(BurgersSolver(cfg).initial_state(), 5)
+    solver = BurgersSolver(
+        cfg, mesh=mesh, decomp=Decomposition.of({0: "dz", 1: "dy"})
+    )
+    out = solver.run(solver.initial_state(), 5)
+    assert _max_abs_diff(ref.u, out.u) <= _WENO_ULPS
+    assert float(ref.t) == float(out.t)
+
+
 def test_burgers2d_sharded_innermost_axis(devices):
     """Sharding the x (innermost/lane) axis exercises the awkward sweep."""
     grid = Grid.make(32, 32, lengths=2.0)
