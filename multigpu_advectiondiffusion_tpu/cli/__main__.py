@@ -202,7 +202,17 @@ def _run_burgers(args, ndim):
 
 
 def _run_convergence(args):
-    """The TestingAccuracy.m equivalent: grid-refinement OOA study."""
+    """The TestingAccuracy.m equivalent: grid-refinement OOA study.
+
+    ``--save DIR`` archives the study the way TestingAccuracy.m does
+    (``Matlab_Prototipes/DiffusionNd/TestingAccuracy.m:51-70`` saves
+    ``TestAccuracy.fig`` + ``.log``): the printed table as
+    ``convergence.log``, machine-readable rows as ``convergence.json``,
+    and a loglog error-vs-h figure as ``convergence.png`` (when
+    matplotlib is available).
+    """
+    import json as _json
+
     from multigpu_advectiondiffusion_tpu.core.grid import Grid
     from multigpu_advectiondiffusion_tpu.models.diffusion import (
         DiffusionConfig,
@@ -213,9 +223,12 @@ def _run_convergence(args):
     ndim = args.ndim
     ns = args.cells or {1: [17, 33, 65, 129], 2: [17, 33, 65],
                         3: [9, 17, 33]}[ndim]
-    print(f"-- diffusion{ndim}d grid-refinement study "
-          f"(TestingAccuracy.m analog), dtype={args.dtype}")
-    print(f"{'n':>6} {'L1':>12} {'Linf':>12} {'OOA(L1)':>8}")
+    lines = [
+        f"-- diffusion{ndim}d grid-refinement study "
+        f"(TestingAccuracy.m analog), dtype={args.dtype}",
+        f"{'n':>6} {'L1':>12} {'Linf':>12} {'OOA(L1)':>8}",
+    ]
+    rows = []
     prev_l1 = None
     for n in ns:
         grid = Grid.make(*(n,) * ndim, lengths=10.0)
@@ -224,10 +237,37 @@ def _run_convergence(args):
         )
         out = solver.advance_to(solver.initial_state(), args.t_end)
         norms = solver.error_norms(out, t=args.t_end)
-        ooa = (f"{observed_order(prev_l1, norms.l1):8.2f}"
-               if prev_l1 else " " * 8)
-        print(f"{n:>6} {norms.l1:>12.4e} {norms.linf:>12.4e} {ooa}")
+        ooa = observed_order(prev_l1, norms.l1) if prev_l1 else None
+        lines.append(
+            f"{n:>6} {norms.l1:>12.4e} {norms.linf:>12.4e} "
+            + (f"{ooa:8.2f}" if ooa is not None else " " * 8)
+        )
+        rows.append({"n": n, "h": grid.spacing[0], "l1": norms.l1,
+                     "linf": norms.linf, "ooa_l1": ooa})
         prev_l1 = norms.l1
+    print("\n".join(lines))
+    if args.save:
+        import os
+
+        os.makedirs(args.save, exist_ok=True)
+        with open(os.path.join(args.save, "convergence.log"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with open(os.path.join(args.save, "convergence.json"), "w") as f:
+            _json.dump({"ndim": ndim, "dtype": args.dtype,
+                        "order": args.order, "t_end": args.t_end,
+                        "rows": rows}, f, indent=1)
+        from multigpu_advectiondiffusion_tpu.utils.plot import (
+            plot_convergence,
+        )
+
+        try:
+            plot_convergence(
+                rows, args.order,
+                os.path.join(args.save, "convergence.png"),
+                title=f"diffusion{ndim}d OOA study",
+            )
+        except ImportError:
+            pass  # matplotlib not installed: log/json still archived
     return None
 
 
@@ -280,6 +320,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--t-end", type=float, default=0.2)
     p.add_argument("--dtype", default="float64")
     p.add_argument("--order", type=int, default=4, choices=[2, 4])
+    p.add_argument("--save", default=None, metavar="DIR",
+                   help="archive the study (convergence.log/.json + "
+                        "loglog .png) like TestingAccuracy.m's "
+                        "TestAccuracy.fig/.log")
     p.set_defaults(fn=_run_convergence)
 
     return ap

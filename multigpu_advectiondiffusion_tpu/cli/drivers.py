@@ -218,6 +218,14 @@ def run_solver(
     if profile_dir:
         from multigpu_advectiondiffusion_tpu.utils.profiling import trace
 
+        # Multi-process launches write one trace dir per process —
+        # the %q{OMPI_COMM_WORLD_RANK} per-rank naming of the
+        # reference's profile.sh (MultiGPU/Diffusion3d_Baseline/
+        # profile.sh:2), keyed on jax.process_index().
+        if jax.process_count() > 1:
+            profile_dir = os.path.join(
+                profile_dir, f"rank{jax.process_index()}"
+            )
         profiled.enter_context(trace(profile_dir))
     with profiled:
         if periodic:

@@ -329,9 +329,12 @@ class SolverBase:
         }
 
     def _split_overlap_requested(self) -> bool:
-        """``overlap='split'`` with a pure z-slab decomposition — the
-        only topology the fused steppers' three-call overlapped schedule
-        serves. Single definition for every solver's eligibility."""
+        """``overlap='split'`` with a decomposition the fused steppers'
+        three-call overlapped schedule serves: the leading (z) axis
+        sharded, and — 3-D only — optionally y as well (pencil meshes:
+        the z halo rides the overlapped exchanged-slab schedule, the y
+        halo a serialized per-stage refresh). Single definition for
+        every solver's eligibility."""
         if self.mesh is None or getattr(self.cfg, "overlap", None) != "split":
             return False
         sizes = dict(self.mesh.shape)
@@ -342,7 +345,9 @@ class SolverBase:
             ax for ax, name in self.decomp.axes
             if axis_extent(sizes, name) > 1
         ]
-        return sharded == [0]
+        if sharded == [0]:
+            return True
+        return self.grid.ndim == 3 and sharded == [0, 1]
 
     def _fused_sharded_ctx(self, fused):
         """``(refresh, offsets_fn, exch)`` for running a fused stepper
@@ -387,7 +392,24 @@ class SolverBase:
                     core, 0, fused.halo, name, nsh, self.bcs[0]
                 )
 
-            return None, offsets_fn, exch
+            # Pencil meshes: the non-z sharded axes keep the serialized
+            # per-stage buffer refresh — only the z halo rides the
+            # overlapped exchanged-slab schedule (the stages' y-ghost
+            # reads come from the buffer, so each stage's composed
+            # output is refreshed before the next consumes it).
+            others = {
+                ax: nm
+                for ax, nm in self.decomp.axes
+                if ax != 0 and axis_extent(sizes, nm) > 1
+            }
+            refresh = None
+            if others:
+                refresh = make_ghost_refresh(
+                    Decomposition.of(others), sizes, self.bcs, fused.halo,
+                    fused.interior_shape,
+                    core_offsets=getattr(fused, "core_offsets", None),
+                )
+            return refresh, offsets_fn, exch
 
         refresh = make_ghost_refresh(
             self.decomp, sizes, self.bcs, fused.halo, fused.interior_shape,

@@ -895,21 +895,28 @@ class FusedBurgersStepper(FusedStepperBase):
                 # slabs), then finish the shard-edge blocks. The
                 # reference overlaps its tuned kernel with MPI halo
                 # traffic the same way, by z-partitioned streams
-                # (MultiGPU/Diffusion3d_Baseline/main.c:203-260).
-                del offsets, refresh  # no global wall masks here
+                # (MultiGPU/Diffusion3d_Baseline/main.c:203-260). On
+                # pencil meshes ``refresh`` serializes the y ghosts on
+                # each stage's composed output.
+                del offsets  # no global wall masks here
+                fix = refresh if refresh is not None else (lambda P: P)
                 lo, hi = exch(S)
-                T1 = s1t(dt_arr, S, hi, s1b(dt_arr, S, lo, s1i(dt_arr, S, T1)))
+                T1 = fix(
+                    s1t(dt_arr, S, hi, s1b(dt_arr, S, lo, s1i(dt_arr, S, T1)))
+                )
                 lo, hi = exch(T1)
-                T2 = s2t(dt_arr, T1, S, hi,
-                         s2b(dt_arr, T1, S, lo, s2i(dt_arr, T1, S, T2)))
+                T2 = fix(s2t(dt_arr, T1, S, hi,
+                             s2b(dt_arr, T1, S, lo, s2i(dt_arr, T1, S, T2))))
                 lo, hi = exch(T2)
                 if emitting:
                     Si, mi = s3i(dt_arr, T2, S)
                     Sb, mb = s3b(dt_arr, T2, lo, Si)
                     S, mt = s3t(dt_arr, T2, hi, Sb)
                     m = jnp.maximum(jnp.maximum(mi[0], mb[0]), mt[0])
-                    return S, T1, T2, m
-                S = s3t(dt_arr, T2, hi, s3b(dt_arr, T2, lo, s3i(dt_arr, T2, S)))
+                    return fix(S), T1, T2, m
+                S = fix(
+                    s3t(dt_arr, T2, hi, s3b(dt_arr, T2, lo, s3i(dt_arr, T2, S)))
+                )
                 return S, T1, T2
 
         else:

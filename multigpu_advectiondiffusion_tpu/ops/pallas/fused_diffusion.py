@@ -505,15 +505,18 @@ class FusedDiffusionStepper(FusedStepperBase):
                 # ppermute; only the two edge calls consume the
                 # exchanged slabs — the reference's five-stream
                 # boundary/interior split (main.c:203-260) as dataflow.
-                del refresh
+                # On pencil meshes ``refresh`` serializes the non-z
+                # sharded axes' ghosts on each stage's composed output
+                # (the next stage reads them from the buffer).
+                fix = refresh if refresh is not None else (lambda P: P)
                 pre = (dt_arr, offsets)
                 lo, hi = exch(S)
-                T1 = s1t(*pre, S, hi, s1b(*pre, S, lo, s1i(*pre, S, T1)))
+                T1 = fix(s1t(*pre, S, hi, s1b(*pre, S, lo, s1i(*pre, S, T1))))
                 lo, hi = exch(T1)
-                T2 = s2t(*pre, T1, S, hi,
-                         s2b(*pre, T1, S, lo, s2i(*pre, T1, S, T2)))
+                T2 = fix(s2t(*pre, T1, S, hi,
+                             s2b(*pre, T1, S, lo, s2i(*pre, T1, S, T2))))
                 lo, hi = exch(T2)
-                S = s3t(*pre, T2, hi, s3b(*pre, T2, lo, s3i(*pre, T2, S)))
+                S = fix(s3t(*pre, T2, hi, s3b(*pre, T2, lo, s3i(*pre, T2, S))))
                 return S, T1, T2
 
         else:

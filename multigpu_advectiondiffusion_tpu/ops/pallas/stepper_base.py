@@ -44,6 +44,23 @@ class FusedStepperBase:
             raise ValueError("split-overlap fused stepper needs exch")
         if not self.overlap_split and refresh is None:
             raise ValueError("sharded fused stepper needs a ghost refresh")
+        if (
+            self.overlap_split
+            and refresh is None
+            and any(
+                g != l
+                for g, l in zip(
+                    self.global_shape[1:], self.interior_shape[1:]
+                )
+            )
+        ):
+            # pencil meshes: only the leading axis rides the exchanged
+            # slabs — the other sharded axes' ghosts need the serialized
+            # refresh, or they silently stay frozen at embed time
+            raise ValueError(
+                "pencil split-overlap stepper needs a ghost refresh for "
+                "its non-leading sharded axes"
+            )
 
     def run(self, u, t, num_iters: int, refresh=None, offsets=None,
             exch=None):
@@ -64,7 +81,10 @@ class FusedStepperBase:
         """
         self._check_sharded_args(refresh, offsets, exch)
         S = self.embed(u)
-        if refresh is not None and not self.overlap_split:
+        if refresh is not None:
+            # non-split: full sharded-axis refresh of the fresh embed;
+            # pencil split mode: the serialized (non-z) axes' refresh —
+            # the z ghosts ride the exchanged-slab operands instead
             S = refresh(S)
         dt_of, step_of, m0 = self._loop_pieces(u, refresh, offsets, exch)
 
@@ -86,7 +106,10 @@ class FusedStepperBase:
         """
         self._check_sharded_args(refresh, offsets, exch)
         S = self.embed(u)
-        if refresh is not None and not self.overlap_split:
+        if refresh is not None:
+            # non-split: full sharded-axis refresh of the fresh embed;
+            # pencil split mode: the serialized (non-z) axes' refresh —
+            # the z ghosts ride the exchanged-slab operands instead
             S = refresh(S)
         te = jnp.asarray(t_end, t.dtype)
         eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))

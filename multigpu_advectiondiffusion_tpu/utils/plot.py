@@ -128,3 +128,29 @@ def plot_comparison(u, u_exact, grid=None, title="", path=None):
         fig.savefig(path, dpi=120, bbox_inches="tight")
         plt.close(fig)
     return fig
+
+
+def plot_convergence(rows, order, path, title="OOA study"):
+    """Loglog error-vs-h figure for a grid-refinement study — the
+    archived-figure half of ``TestingAccuracy.m:51-70``'s
+    ``TestAccuracy.fig``. ``rows`` are the convergence CLI's dicts
+    (``h``/``l1``/``linf``); a reference slope-``order`` line anchors
+    the eye."""
+    plt = _mpl()
+    h = np.array([r["h"] for r in rows], dtype=float)
+    l1 = np.array([r["l1"] for r in rows], dtype=float)
+    linf = np.array([r["linf"] for r in rows], dtype=float)
+    fig, ax = plt.subplots(figsize=(5, 4))
+    ax.loglog(h, l1, "o-", label="L1")
+    ax.loglog(h, linf, "s-", label="Linf")
+    ref = l1[0] * (h / h[0]) ** order
+    ax.loglog(h, ref, "k--", linewidth=0.8, label=f"slope {order}")
+    ax.set_xlabel("h")
+    ax.set_ylabel("error")
+    ax.set_title(title)
+    ax.legend()
+    ax.grid(True, which="both", alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path

@@ -310,6 +310,7 @@ main([
     "diffusion3d", "--n", "16", "16", "24", "--iters", "3",
     "--mesh", "dz_dcn=2,dz_ici=4", "--impl", "pallas",
     "--save", outdir, "--check-error",
+    "--profile", outdir + "/trace",
     "--coordinator", f"localhost:{port}",
     "--num-processes", "2", "--process-id", str(pid),
 ])
@@ -378,6 +379,12 @@ def test_two_process_cli_launch(tmp_path):
     assert summary["error_l1"] is not None and summary["error_l1"] < 1.0
     # only the coordinator prints the summary block
     assert "kernel path" in logs[0].read_text()
+    # --profile in a multi-process launch writes one trace dir PER
+    # PROCESS (profile.sh's %q{OMPI_COMM_WORLD_RANK} per-rank naming,
+    # MultiGPU/Diffusion3d_Baseline/profile.sh:2), each non-empty
+    for rank in (0, 1):
+        d = outdir / "trace" / f"rank{rank}"
+        assert d.is_dir() and any(d.rglob("*")), f"missing trace for {rank}"
     assert "kernel path" not in logs[1].read_text()
 
 

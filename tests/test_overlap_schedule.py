@@ -134,7 +134,8 @@ def test_split_overlap_tpu_schedule_hides_collectives():
     )
 
 
-@pytest.mark.parametrize("model", ["burgers", "diffusion"])
+@pytest.mark.parametrize("model", ["burgers", "diffusion",
+                                   "burgers-pencil"])
 def test_fused_split_overlap_tpu_schedule_hides_collectives(
     monkeypatch, model
 ):
@@ -169,7 +170,11 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
     monkeypatch.setattr(lap, "interpret_mode", lambda: False)
 
     devs = np.asarray(topo.devices[:4])
-    mesh = Mesh(devs, ("dz",))
+    mesh = (
+        Mesh(devs.reshape(2, 2), ("dz", "dy"))
+        if model == "burgers-pencil"
+        else Mesh(devs, ("dz",))
+    )
     # x64 (the suite default) poisons Mosaic verification with i64
     # constants — the kernels are f32/i32 by design
     with jax.enable_x64(False):
@@ -183,6 +188,17 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
                 mesh=mesh,
                 decomp=Decomposition.slab("dz"),
             )
+        elif model == "burgers-pencil":
+            # {dz, dy} pencil: local (64, 8, 128) — the z halo rides the
+            # overlapped exchanged-slab schedule, y a serialized refresh
+            grid = Grid.make(128, 16, 128, lengths=2.0)
+            solver = BurgersSolver(
+                BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                              adaptive_dt=False, impl="pallas",
+                              overlap="split"),
+                mesh=mesh,
+                decomp=Decomposition.of({0: "dz", 1: "dy"}),
+            )
         else:
             # local lz = 60 -> bz=20 -> n_bz=3
             grid = Grid.make(128, 16, 240, lengths=2.0)
@@ -195,10 +211,15 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
         fused = solver._fused_stepper()
         assert fused is not None and fused.overlap_split
         refresh, offsets_fn, exch = solver._fused_sharded_ctx(fused)
-        assert refresh is None and exch is not None
+        assert exch is not None
+        # pencil meshes carry the serialized y refresh alongside the
+        # overlapped z exchange; pure slabs have no refresh at all
+        assert (refresh is not None) == (model == "burgers-pencil")
 
         def block(u, t):
             kw = {"exch": exch}
+            if refresh is not None:
+                kw["refresh"] = refresh
             if offsets_fn is not None and model == "diffusion":
                 kw["offsets"] = offsets_fn()
             return fused.run(u, t, 2, **kw)

@@ -762,6 +762,91 @@ def test_fused_burgers_split_overlap_matches_serialized(devices, adaptive):
                                atol=2e-6 * scale)
 
 
+@pytest.mark.parametrize("model", ["burgers", "diffusion"])
+@pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
+def test_fused_split_overlap_pencil_matches_serialized(
+    devices, model, adaptive
+):
+    """overlap='split' on a {dz, dy} PENCIL mesh: the z halo rides the
+    three-call overlapped schedule while the y halo keeps the
+    serialized per-stage refresh on each stage's composed output. Must
+    match the all-serialized fused path and the unsharded fused run —
+    the reference's boundary/interior stream split generalized past
+    what its 1-D MPI slabs could decompose (SURVEY §2.1.5)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    if model == "diffusion" and adaptive:
+        pytest.skip("diffusion has no adaptive dt")
+    # local z must host a 3-block interior band for each model's block
+    # picker: burgers bz<=8 -> lz=24; diffusion bz=20 -> lz=60
+    grid = (
+        Grid.make(24, 16, 48, lengths=2.0)
+        if model == "burgers"
+        else Grid.make(24, 16, 120, lengths=2.0)
+    )
+    mk = (
+        (lambda **kw: BurgersSolver(
+            BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                          adaptive_dt=adaptive, impl="pallas", **kw)))
+        if model == "burgers"
+        else (lambda **kw: DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype="float32", impl="pallas",
+                            **kw)))
+    )
+    unsharded = mk()
+    assert unsharded._fused_stepper() is not None
+    ref = unsharded.run(unsharded.initial_state(), 5)
+
+    outs = {}
+    for overlap in ("split", "padded"):
+        solver = mk(overlap=overlap).__class__(
+            mk(overlap=overlap).cfg,
+            mesh=make_mesh({"dz": 2, "dy": 2}),
+            decomp=Decomposition.of({0: "dz", 1: "dy"}),
+        )
+        fused = solver._fused_stepper()
+        assert fused is not None and fused.sharded
+        assert fused.overlap_split == (overlap == "split"), (
+            model, overlap, getattr(solver, "_fused_fallback", None)
+        )
+        st = solver.run(solver.initial_state(), 5)
+        outs[overlap] = np.asarray(st.u)
+        np.testing.assert_allclose(float(st.t), float(ref.t), rtol=1e-6)
+    _assert_fused_close(outs["split"], outs["padded"])
+    _assert_fused_close(outs["split"], ref.u)
+
+
+def test_fused_burgers_split_overlap_pencil_run_to(devices):
+    """advance_to through the pencil split-overlap schedule (run_to
+    inside shard_map with both the exchanged-slab z path and the y
+    refresh) matches the unsharded fused trajectory and step count."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(24, 16, 48, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                        adaptive_dt=True, impl="pallas", overlap="split")
+    ref_s = BurgersSolver(cfg)
+    t_end = 0.04
+    ref = ref_s.advance_to(ref_s.initial_state(), t_end)
+    solver = BurgersSolver(
+        cfg,
+        mesh=make_mesh({"dz": 2, "dy": 2}),
+        decomp=Decomposition.of({0: "dz", 1: "dy"}),
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.overlap_split
+    out = solver.advance_to(solver.initial_state(), t_end)
+    _assert_fused_close(out.u, ref.u)
+    np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
+    assert int(out.it) == int(ref.it) > 0
+
+
 @pytest.mark.parametrize(
     "nz_global",
     [16, 44],
@@ -1298,3 +1383,25 @@ def test_step_fused_diffusion_matches_xla():
     np.testing.assert_allclose(np.asarray(u), np.asarray(st.u),
                                rtol=1e-5, atol=1e-6)
     assert float(t) == float(st.t)
+
+
+def test_fused_pencil_split_requires_refresh(devices):
+    """A pencil split-overlap stepper driven directly with only `exch`
+    (no serialized refresh for the non-leading sharded axes) must raise
+    — silently-frozen y ghosts are the failure mode the guard exists
+    for."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+        FusedBurgersStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops import flux as flux_lib
+
+    st = FusedBurgersStepper(
+        (24, 8, 48), "float32", (0.1, 0.1, 0.1), flux_lib.burgers(),
+        "js", 0.0, dt=0.01, global_shape=(48, 16, 48), y_sharded=True,
+        overlap_split=True,
+    )
+    assert st.overlap_split
+    u = jnp.zeros((24, 8, 48), jnp.float32)
+    with pytest.raises(ValueError, match="non-leading"):
+        st.run(u, jnp.zeros((), jnp.float32), 1,
+               exch=lambda P: (P[:3], P[:3]))
