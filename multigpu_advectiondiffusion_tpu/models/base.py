@@ -331,10 +331,10 @@ class SolverBase:
     def _split_overlap_requested(self) -> bool:
         """``overlap='split'`` with a decomposition the fused steppers'
         three-call overlapped schedule serves: the leading (z) axis
-        sharded, and — 3-D only — optionally y as well (pencil meshes:
-        the z halo rides the overlapped exchanged-slab schedule, the y
-        halo a serialized per-stage refresh). Single definition for
-        every solver's eligibility."""
+        sharded, and — 3-D only — optionally y and/or x as well (pencil/
+        block meshes: the z halo rides the overlapped exchanged-slab
+        schedule, the other sharded axes a serialized per-stage
+        refresh). Single definition for every solver's eligibility."""
         if self.mesh is None or getattr(self.cfg, "overlap", None) != "split":
             return False
         sizes = dict(self.mesh.shape)
@@ -347,7 +347,7 @@ class SolverBase:
         ]
         if sharded == [0]:
             return True
-        return self.grid.ndim == 3 and sharded == [0, 1]
+        return self.grid.ndim == 3 and bool(sharded) and sharded[0] == 0
 
     def _fused_sharded_ctx(self, fused):
         """``(refresh, offsets_fn, exch)`` for running a fused stepper

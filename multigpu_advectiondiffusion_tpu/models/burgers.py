@@ -157,8 +157,8 @@ class BurgersSolver(SolverBase):
         scalar (global ``max|f'(u)|`` reduction between steps), and under
         a mesh the kernel runs shard-local with ppermute ghost refresh
         between stages (the tuned kernel under MPI,
-        ``MultiGPU/Burgers3d_Baseline/main.c:189-317``; x must be
-        unsharded — the lane-aligned layout stores no x ghosts). In 2-D
+        ``MultiGPU/Burgers3d_Baseline/main.c:189-317``; x-sharded
+        meshes switch to the stored-x-ghost layout, PARITY.md). In 2-D
         the single-chip path is the whole-run VMEM stepper (adaptive dt
         via an in-core reduction per step); under a mesh the per-stage
         whole-shard kernels take over with the same ghost-refresh
@@ -211,19 +211,29 @@ class BurgersSolver(SolverBase):
                     f"a sharded axis is thinner than the WENO{cfg.weno_order}"
                     f" halo ({halo})"
                 )
-            # the lane-aligned x layout stores no x ghosts, so an
-            # x-sharded mesh has nothing for the ppermute refresh to
-            # rewrite — such configs run the generic path
-            if self.mesh is not None and 2 in dict(self.decomp.axes):
-                return self._decline(
-                    "x-sharded mesh: the lane-aligned layout stores no x "
-                    "ghosts to refresh"
-                )
+            # an x-sharded mesh switches the stepper to the stored-x-ghost
+            # layout (interior at lane offset halo) so the ppermute
+            # refresh has real ghost lanes to rewrite — the lane-aligned
+            # default stores none (fused_burgers._x_widths; priced in
+            # PARITY.md). Extent-1 mesh axes need no ghosts.
+            sizes = {} if self.mesh is None else dict(self.mesh.shape)
+            from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+                axis_extent,
+            )
+
+            x_sharded = self.mesh is not None and any(
+                ax == 2 and axis_extent(sizes, nm) > 1
+                for ax, nm in self.decomp.axes
+            )
             # y-rounding is incompatible only with a y-sharded axis
-            # (dead columns would be exchanged as neighbor ghosts)
-            y_sharded = self.mesh is not None and 1 in dict(self.decomp.axes)
+            # (dead columns would be exchanged as neighbor ghosts);
+            # extent-1 axes exchange nothing
+            y_sharded = self.mesh is not None and any(
+                ax == 1 and axis_extent(sizes, nm) > 1
+                for ax, nm in self.decomp.axes
+            )
             if not cls.supported(lshape, self.dtype, y_sharded=y_sharded,
-                                 order=cfg.weno_order):
+                                 order=cfg.weno_order, x_sharded=x_sharded):
                 return self._decline(
                     "no viable VMEM block tiling for this local shape"
                 )
@@ -261,6 +271,7 @@ class BurgersSolver(SolverBase):
                 if self.mesh is not None:
                     kwargs["global_shape"] = self.grid.shape
                     kwargs["y_sharded"] = y_sharded
+                    kwargs["x_sharded"] = x_sharded
                     kwargs["overlap_split"] = self._split_overlap_requested()
                 if cfg.adaptive_dt:
                     from multigpu_advectiondiffusion_tpu.timestepping.cfl import (  # noqa: E501

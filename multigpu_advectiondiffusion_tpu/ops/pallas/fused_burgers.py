@@ -51,9 +51,16 @@ Layout and ghost discipline:
   one tile is 20% of all traffic and VPU work). The x sweep's circular
   rolls read the ghosts at the wrap positions (last ``r`` lanes of the
   working width = left ghosts), exactly like the old inline layout.
-  Consequence: the x axis must not be sharded for this stepper (there
-  are no stored x ghosts for a ppermute refresh to rewrite; such
-  configs use the generic path).
+  Consequence: the x axis must not be sharded in this layout (there
+  are no stored x ghosts for a ppermute refresh to rewrite).
+  **x-sharded meshes** instead construct the stepper with
+  ``x_sharded=True``, which switches to a stored-x-ghost layout —
+  interior at lane offset ``r``, ``round128(nx_local + 2r)`` stored
+  lanes, ghost lanes maintained on the write side (edge replicas,
+  correct at global walls) and rewritten by the between-stage ppermute
+  refresh at shard edges. That accepts the extra lane tile the default
+  layout avoids; the measured price and the comparison against the
+  generic path's loss are in PARITY.md.
 * Block (kz, ky) reads box ``[kz*bz, kz*bz+bz+2r) x [ky*by, ky*by+by+16)``
   (both starts/extents 8-aligned in y) and writes only its disjoint core
   box; edge blocks additionally write the adjacent ghost boxes with
@@ -138,19 +145,30 @@ def _recip(x):
 _VMEM_BUDGET = 72 * 1024 * 1024
 
 
-def _x_widths(lx: int, r: int = R):
+def _x_widths(lx: int, r: int = R, x_ghosts: bool = False):
     """``(px, W)``: stored lane width (interior only, lane-aligned at 0)
     and the x-sweep working width. The working buffer needs the ``r``
     right-ghost lanes after ``lx`` and ``r`` left-ghost lanes at its very
     end (read via circular wrap), disjoint — when the stored slack can't
-    hold both, the sweep works on a 128-lane-extended value instead."""
+    hold both, the sweep works on a 128-lane-extended value instead.
+
+    ``x_ghosts`` selects the stored-x-ghost layout for x-sharded meshes:
+    the interior sits at lane offset ``r`` with real ghost lanes on both
+    sides (``round128(lx + 2r)`` stored lanes, no working tail — the
+    sweeps read inline ghosts, nothing wraps). This buys the ppermute
+    refresh an x slab to rewrite at the price of the extra lane tile the
+    lane-aligned layout exists to avoid (measured in PARITY.md)."""
+    if x_ghosts:
+        px = round_up(lx + 2 * r, LANE)
+        return px, px
     px = round_up(lx, LANE)
     return px, (px if px - lx >= 2 * r else px + LANE)
 
 
 def _live_bytes(bz: int, by: int, lx: int, itemsize: int,
-                r: int = R, order: int = 5) -> int:
-    px, w = _x_widths(lx, r)
+                r: int = R, order: int = 5,
+                x_ghosts: bool = False) -> int:
+    px, w = _x_widths(lx, r, x_ghosts)
     core = bz * by * px * itemsize
     slab = (bz + 2 * r) * (by + 2 * MARGIN) * w * itemsize  # one box @W
     # v double-buffered (2 slabs @W) + ghost-patched w + vp + vm (3
@@ -160,7 +178,8 @@ def _live_bytes(bz: int, by: int, lx: int, itemsize: int,
     return 5 * slab + (18 if order == 5 else 24) * core
 
 
-def _pick_blocks(nz, ny, lx, itemsize, r: int = R, order: int = 5):
+def _pick_blocks(nz, ny, lx, itemsize, r: int = R, order: int = 5,
+                 x_ghosts: bool = False):
     """First viable block in measured-preference order.
 
     v5e, 512^3 (lane-aligned layout, roll-based y sweep), order 5:
@@ -179,7 +198,8 @@ def _pick_blocks(nz, ny, lx, itemsize, r: int = R, order: int = 5):
         for bz in (8, 7, 6, 5, 4, 3, 2, 1):
             if nz % bz:
                 continue
-            if _live_bytes(bz, by, lx, itemsize, r, order) <= _VMEM_BUDGET:
+            if _live_bytes(bz, by, lx, itemsize, r, order,
+                           x_ghosts) <= _VMEM_BUDGET:
                 return (bz, by)
     return None
 
@@ -362,6 +382,8 @@ def _stage_kernel(
     n_bz_grid: int | None = None,
     ghost_src: str | None = None,
     z_edge_writes: bool = True,
+    x0: int = 0,
+    x_ghosts: bool = False,
 ):
     """One (z, y) block of one RK stage, 2-slot double-buffered.
 
@@ -392,7 +414,7 @@ def _stage_kernel(
     masked out.
     """
     lz, ly, lx = local_shape
-    px, w = _x_widths(lx, r)
+    px, w = _x_widths(lx, r, x_ghosts)
     if n_bz_grid is None:
         n_bz_grid = n_bz
     kz = pl.program_id(0) + kz_base  # absolute z-block index
@@ -494,17 +516,21 @@ def _stage_kernel(
     for cp in copy_v(k, slot):
         cp.wait()
 
-    # x ghost synthesis on the freshly-loaded box: the stored layout
-    # carries no x ghosts, so patch the slack/tail lanes with edge
-    # replicas (WENO5resAdv_X.m:53) — right ghosts right after the
-    # interior at lanes lx..lx+r-1, left ghosts at the wrap positions
+    # x ghost synthesis on the freshly-loaded box: the lane-aligned
+    # stored layout carries no x ghosts, so patch the slack/tail lanes
+    # with edge replicas (WENO5resAdv_X.m:53) — right ghosts right after
+    # the interior at lanes lx..lx+r-1, left ghosts at the wrap positions
     # W-r..W-1 the circular x sweep reads. Replaces the old layout's
-    # per-stage x edge rewrite on the store side; x is never sharded
-    # here, so local replication is correct in every world.
+    # per-stage x edge rewrite on the store side; x is not sharded in
+    # this layout, so local replication is correct in every world. The
+    # stored-x-ghost layout (``x_ghosts``) needs no load-side patch: its
+    # ghost lanes hold real values (write-side maintenance at global
+    # walls, ppermute refresh at shard edges) and nothing wraps.
     v = vs[slot]
-    gxw = lax.broadcasted_iota(jnp.int32, v.shape, 2)
-    v = jnp.where(gxw >= lx, v[:, :, lx - 1 : lx], v)
-    v = jnp.where(gxw >= w - r, v[:, :, 0:1], v)
+    if not x_ghosts:
+        gxw = lax.broadcasted_iota(jnp.int32, v.shape, 2)
+        v = jnp.where(gxw >= lx, v[:, :, lx - 1 : lx], v)
+        v = jnp.where(gxw >= w - r, v[:, :, 0:1], v)
 
     vc = v[r : r + bz, MARGIN : MARGIN + by, :px]
     dtype = v.dtype
@@ -547,10 +573,26 @@ def _stage_kernel(
         edge = (ly - 1) - (n_by - 1) * by
         rk = jnp.where(gy >= ly, rk[:, edge : edge + 1], rk)
 
+    if x_ghosts:
+        # stored-x-ghost maintenance: ghost and slack lanes get the edge
+        # replica of the boundary interior lane — correct at global x
+        # walls (edge BC, WENO5resAdv_X.m:53); at interior shard edges
+        # the ppermute refresh overwrites the inner r ghost lanes before
+        # the next stage reads them. The x analog of the y-margin
+        # rewrite above, done in-register instead of by edge-block DMAs
+        # because every block owns its full lane extent.
+        gx = lax.broadcasted_iota(jnp.int32, rk.shape, 2)
+        rk = jnp.where(gx < x0, rk[:, :, x0 : x0 + 1], rk)
+        rk = jnp.where(gx >= x0 + lx, rk[:, :, x0 + lx - 1 : x0 + lx], rk)
+
     if mx_ref is not None:
         gxc = lax.broadcasted_iota(jnp.int32, rk.shape, 2)
         m = jnp.max(
-            jnp.where(gxc < lx, jnp.abs(flux.df(rk)), jnp.zeros_like(rk))
+            jnp.where(
+                (gxc >= x0) & (gxc < x0 + lx),
+                jnp.abs(flux.df(rk)),
+                jnp.zeros_like(rk),
+            )
         ).astype(jnp.float32)
 
         @pl.when(k == 0)
@@ -641,7 +683,7 @@ def _stage_kernel(
 
 def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
                 nu_scales, flux, variant, a, b, u_source, role=None,
-                emit_max=False, order=5, r=R):
+                emit_max=False, order=5, r=R, x0=0, x_ghosts=False):
     """One fused RK-stage call; output aliased onto the last operand.
 
     ``u_source``: ``"none"`` / ``"operand"`` / ``"target"`` (in-place
@@ -660,7 +702,7 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
     lz = local_shape[0]
     ly_eff = padded_shape[1] - 2 * MARGIN  # ly rounded up to by multiple
     trailing = padded_shape[2:]
-    px, w = _x_widths(local_shape[2], r)
+    px, w = _x_widths(local_shape[2], r, x_ghosts)
     assert trailing == (px,), (trailing, px)
     use_u = u_source != "none"
     n_bz, n_by = lz // bz, ly_eff // by
@@ -698,6 +740,8 @@ def _make_stage(padded_shape, local_shape, dtype, *, bz, by, inv_dx,
         n_bz_grid=n_bz_grid,
         ghost_src=ghost_src,
         z_edge_writes=z_edge,
+        x0=x0,
+        x_ghosts=x_ghosts,
     )
 
     def kernel(*refs):
@@ -789,14 +833,16 @@ class FusedBurgersStepper(FusedStepperBase):
 
     halo = R  # class default; instances set halo = HALO[order]
     # interior origin in the padded layout; x is lane-aligned at 0 (no
-    # stored x ghosts — x must not be sharded for this stepper)
+    # stored x ghosts) unless the instance runs the x-sharded layout,
+    # which stores ghosts at lane offset r (instances overwrite this)
     core_offsets = (R, MARGIN, 0)
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
                  dt_fn=None, block=None, global_shape=None,
                  y_sharded: bool = False, overlap_split: bool = False,
-                 dt_from_max=None, wave_fn=None, order: int = 5):
+                 dt_from_max=None, wave_fn=None, order: int = 5,
+                 x_sharded: bool = False):
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
         if order not in HALO:
@@ -806,7 +852,13 @@ class FusedBurgersStepper(FusedStepperBase):
         r = HALO[order]
         self.order = order
         self.halo = r
-        self.core_offsets = (r, MARGIN, 0)
+        # x-sharded meshes switch to the stored-x-ghost layout: interior
+        # at lane offset r with real ghost lanes for the ppermute
+        # refresh to rewrite (_x_widths docstring; priced in PARITY.md)
+        self.x_sharded = bool(x_sharded)
+        x0 = r if self.x_sharded else 0
+        self.x0 = x0
+        self.core_offsets = (r, MARGIN, x0)
         lz, ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
@@ -823,11 +875,11 @@ class FusedBurgersStepper(FusedStepperBase):
         self.padded_shape = (
             lz + 2 * r,
             ly_eff + 2 * MARGIN,
-            _x_widths(lx, r)[0],
+            _x_widths(lx, r, self.x_sharded)[0],
         )
         self.dtype = jnp.dtype(dtype)
         blk = block if block is not None else _pick_blocks(
-            lz, ly_eff, lx, self.dtype.itemsize, r, order
+            lz, ly_eff, lx, self.dtype.itemsize, r, order, self.x_sharded
         )
         if blk is None or lz % blk[0] or ly_eff % blk[1] or blk[1] % 8:
             raise ValueError(
@@ -868,7 +920,8 @@ class FusedBurgersStepper(FusedStepperBase):
                     self.padded_shape, self.interior_shape, self.dtype,
                     bz=bz, by=by, inv_dx=inv_dx, nu_scales=nu_scales,
                     flux=flux, variant=variant, a=a, b=b, u_source=src,
-                    role=role, order=order, r=r,
+                    role=role, order=order, r=r, x0=x0,
+                    x_ghosts=self.x_sharded,
                     # the final stage emits in every role: the split
                     # schedule's three calls each fold their own blocks
                     emit_max=(self._emit_max and src == "target"),
@@ -948,14 +1001,14 @@ class FusedBurgersStepper(FusedStepperBase):
 
     @staticmethod
     def supported(interior_shape, dtype, y_sharded: bool = False,
-                  order: int = 5) -> bool:
+                  order: int = 5, x_sharded: bool = False) -> bool:
         lz, ly, lx = interior_shape
         if y_sharded and ly % SUBLANE:
             return False
         ly_eff = round_up(ly, SUBLANE)
         return (
             _pick_blocks(lz, ly_eff, lx, jnp.dtype(dtype).itemsize,
-                         HALO[order], order)
+                         HALO[order], order, x_sharded)
             is not None
         )
 
@@ -965,7 +1018,8 @@ class FusedBurgersStepper(FusedStepperBase):
         pz, py, px = self.padded_shape
         return jnp.pad(
             u.astype(self.dtype),
-            ((r, pz - lz - r), (MARGIN, py - ly - MARGIN), (0, px - lx)),
+            ((r, pz - lz - r), (MARGIN, py - ly - MARGIN),
+             (self.x0, px - lx - self.x0)),
             mode="edge",
         )
 
@@ -973,7 +1027,7 @@ class FusedBurgersStepper(FusedStepperBase):
         r = self.halo
         lz, ly, lx = self.interior_shape
         return lax.slice(
-            S, (r, MARGIN, 0), (r + lz, MARGIN + ly, lx)
+            S, (r, MARGIN, self.x0), (r + lz, MARGIN + ly, self.x0 + lx)
         )
 
     def _dt_value(self, S):

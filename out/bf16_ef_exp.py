@@ -1,0 +1,295 @@
+"""Measure the bf16 error-feedback (compensated-storage) diffusion rung.
+
+PARITY.md's bf16-storage section rejects plain bf16 state on accuracy
+(the stability-dt update rounds away against bf16's quantum) and argued
+— without numbers — that error-feedback storage "would need a second
+buffer and give the traffic win back". VERDICT r4 item 6 asks for the
+measurement. This script implements the scheme honestly and times it:
+
+* the natural home is the WHOLE-STEP kernel (fused_diffusion_step): the
+  three RK stages live in VMEM at f32, so the state is quantized ONCE
+  per step — per-stage error feedback cannot work at all, since T1/T2
+  themselves stagnate when stored plain-bf16;
+* state q (bf16) + residual e (bf16), reconstructed x = f32(q) + f32(e)
+  at load (both slabs read WITH the z halo — neighbors need precision
+  too), compensated re-split on store: q' = bf16(x'), e' = bf16(x' - q').
+
+Byte accounting per cell-step (the whole point): read 2+2, write 2+2 =
+f32's 4+4 — the traffic win is exactly given back, so on an HBM-bound
+kernel the expected rate is the f32 whole-step rate, not the 1.6x of
+plain bf16. The accuracy column shows what the compensation buys back
+(two bf16s carry ~16 mantissa bits, not f32's 24).
+
+Table rows (same grid, 400x200x208 — z rounded to a whole-step-friendly
+block multiple of the literal 400x200x206 north-star — 303 iters, one
+chip): f32 per-stage | plain bf16 per-stage | f32 whole-step |
+bf16+EF whole-step. Lands in PARITY.md next to the existing bf16 table.
+
+Run: python out/bf16_ef_exp.py  (real TPU; ~3 min)
+"""
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multigpu_advectiondiffusion_tpu.bench.timing import _timed
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+from multigpu_advectiondiffusion_tpu.models.diffusion import (
+    DiffusionConfig,
+    DiffusionSolver,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import _STAGES
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion_step import (
+    ZGHOST,
+    _stage_rows,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    LANE,
+    R,
+    SUBLANE,
+    VMEM_LIMIT,
+    compiler_params,
+    interpret_mode,
+    pick_block,
+    round_up,
+)
+
+ITERS = 303
+REPS = 5
+
+
+def _ef_step_kernel(q_hbm, e_hbm, _tq, _te, outq_hbm, oute_hbm,
+                    qs, es, rq, re_, sem_q, sem_e, sem_wq, sem_we, *,
+                    bz, n_blocks, interior_shape, scales, dt, band,
+                    bc_value):
+    """One z-block of one full EF step; DMA discipline mirrors
+    fused_diffusion_step._step_kernel, doubled for the (q, e) pair."""
+    k = pl.program_id(0)
+    slot = lax.rem(k, jnp.asarray(2, k.dtype))
+    nslot = lax.rem(k + 1, jnp.asarray(2, k.dtype))
+    halo = 3 * R
+
+    def copy_in(hbm, buf, sem, j, s):
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds((ZGHOST - halo) + j * bz, bz + 2 * halo)],
+            buf.at[s], sem.at[s],
+        )
+
+    def copy_out(buf, hbm, sem, j, s):
+        return pltpu.make_async_copy(
+            buf.at[s], hbm.at[pl.ds(ZGHOST + j * bz, bz)], sem.at[s]
+        )
+
+    @pl.when(k == 0)
+    def _():
+        copy_in(q_hbm, qs, sem_q, 0, 0).start()
+        copy_in(e_hbm, es, sem_e, 0, 0).start()
+
+    @pl.when(k + 1 < n_blocks)
+    def _():
+        copy_in(q_hbm, qs, sem_q, k + 1, nslot).start()
+        copy_in(e_hbm, es, sem_e, k + 1, nslot).start()
+
+    copy_in(q_hbm, qs, sem_q, k, slot).wait()
+    copy_in(e_hbm, es, sem_e, k, slot).wait()
+
+    # reconstruct the f32 state: two bf16s ~ 16 mantissa bits
+    v = qs[slot].astype(jnp.float32) + es[slot].astype(jnp.float32)
+
+    stage = functools.partial(
+        _stage_rows, interior_shape=tuple(interior_shape),
+        scales=tuple(scales), dt=dt, band=band, bc_value=bc_value,
+    )
+    (a1, b1), (a2, b2), (a3, b3) = _STAGES
+    base = k * bz - halo
+    t1 = stage(v, None, gz0=base + R, a=a1, b=b1)
+    t2 = stage(t1, v[2 * R : 2 * R + bz + 4], gz0=base + 2 * R, a=a2, b=b2)
+    t3 = stage(t2, v[3 * R : 3 * R + bz], gz0=base + 3 * R, a=a3, b=b3)
+
+    # compensated split: e' carries what bf16(x') rounds away
+    q = t3.astype(jnp.bfloat16)
+    e = (t3 - q.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    @pl.when(k >= 2)
+    def _():
+        copy_out(rq, outq_hbm, sem_wq, k - 2, slot).wait()
+        copy_out(re_, oute_hbm, sem_we, k - 2, slot).wait()
+
+    rq[slot] = q
+    re_[slot] = e
+    copy_out(rq, outq_hbm, sem_wq, k, slot).start()
+    copy_out(re_, oute_hbm, sem_we, k, slot).start()
+
+    @pl.when(k == n_blocks - 1)
+    def _():
+        copy_out(rq, outq_hbm, sem_wq, k, slot).wait()
+        copy_out(re_, oute_hbm, sem_we, k, slot).wait()
+        if n_blocks >= 2:
+            copy_out(rq, outq_hbm, sem_wq, k - 1, nslot).wait()
+            copy_out(re_, oute_hbm, sem_we, k - 1, nslot).wait()
+
+
+class EFStepStepper:
+    """bf16 state + bf16 residual, f32 compute, one quantization per
+    step. Interface mirrors StepFusedDiffusionStepper."""
+
+    def __init__(self, interior_shape, spacing, diffusivity, dt, band,
+                 bc_value, block_z=None):
+        nz, ny, nx = interior_shape
+        self.interior_shape = tuple(interior_shape)
+        sub = SUBLANE * 2  # bf16 (16, 128) tiles
+        self.padded_shape = (
+            nz + 2 * ZGHOST,
+            round_up(ny + 2 * R, sub),
+            round_up(nx + 2 * R, LANE),
+        )
+        self.bc_value = float(bc_value)
+        row_f32 = self.padded_shape[1] * self.padded_shape[2] * 4
+        if block_z is None:
+            # ~12 live f32-row-equivalents per block row (f32 slab + two
+            # bf16 slab pairs + stage windows) + ~140 fixed rows
+            budget = (VMEM_LIMIT // row_f32 - 140) // 12
+            block_z = pick_block(nz, max(1, min(20, int(budget))))
+        if nz % block_z != 0:
+            raise ValueError(f"block_z={block_z} must divide nz={nz}")
+        self.block_z = bz = block_z
+        n_blocks = nz // bz
+        scales = [
+            float(diffusivity) / (12.0 * spacing[i] * spacing[i])
+            for i in range(3)
+        ]
+        kern = functools.partial(
+            _ef_step_kernel, bz=bz, n_blocks=n_blocks,
+            interior_shape=self.interior_shape, scales=tuple(scales),
+            dt=float(dt), band=band, bc_value=float(bc_value),
+        )
+        halo = 3 * R
+        bf16 = jnp.bfloat16
+        self._step_call = pl.pallas_call(
+            kern,
+            grid=(n_blocks,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct(self.padded_shape, bf16),
+                jax.ShapeDtypeStruct(self.padded_shape, bf16),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, bz + 2 * halo) + self.padded_shape[1:], bf16),
+                pltpu.VMEM((2, bz + 2 * halo) + self.padded_shape[1:], bf16),
+                pltpu.VMEM((2, bz) + self.padded_shape[1:], bf16),
+                pltpu.VMEM((2, bz) + self.padded_shape[1:], bf16),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            input_output_aliases={2: 0, 3: 1},
+            compiler_params=None if interpret_mode() else compiler_params(),
+            interpret=interpret_mode(),
+        )
+        self.dt = float(dt)
+
+    def embed(self, u):
+        full = jnp.full(self.padded_shape, self.bc_value, jnp.float32)
+        P = lax.dynamic_update_slice(
+            full, u.astype(jnp.float32), (ZGHOST, R, R)
+        )
+        q = P.astype(jnp.bfloat16)
+        e = (P - q.astype(jnp.float32)).astype(jnp.bfloat16)
+        return q, e
+
+    def extract(self, Sq, Se):
+        nz, ny, nx = self.interior_shape
+        x = Sq.astype(jnp.float32) + Se.astype(jnp.float32)
+        return lax.slice(
+            x, (ZGHOST, R, R), (ZGHOST + nz, R + ny, R + nx)
+        )
+
+    def run(self, u, t, num_iters: int):
+        Sq, Se = self.embed(u)
+        Tq, Te = Sq, Se
+
+        def body(i, carry):
+            Sq, Se, Tq, Te, t = carry
+            Tq, Te = self._step_call(Sq, Se, Tq, Te)
+            return Tq, Te, Sq, Se, t + self.dt
+
+        Sq, Se, Tq, Te, t = lax.fori_loop(
+            0, num_iters, body, (Sq, Se, Tq, Te, t)
+        )
+        return self.extract(Sq, Se), t
+
+
+def main():
+    grid = Grid.make(400, 200, 208, lengths=(10.0, 5.0, 5.2))
+    cells = grid.num_cells
+
+    rows = []
+
+    def solver_row(label, **kw):
+        cfg = DiffusionConfig(grid=grid, diffusivity=1.0, **kw)
+        s = DiffusionSolver(cfg)
+        assert s._fused_stepper() is not None, (label, s._fused_fallback)
+        st = s.initial_state()
+        from multigpu_advectiondiffusion_tpu.bench.timing import timed_run
+
+        tr = timed_run(s, st, ITERS, reps=REPS)
+        out = s.run(st, ITERS)
+        n = s.error_norms(out)
+        # stage-update MLUPS (3 RK stages/step), as everywhere else
+        rows.append((label, cells * ITERS * 3 / tr.seconds / 1e6,
+                     n.l1, n.linf))
+        return s, out
+
+    s_f32, out_f32 = solver_row("f32 per-stage", dtype="float32",
+                                impl="pallas")
+    solver_row("bf16 per-stage (plain)", dtype="bfloat16", impl="pallas")
+    solver_row("f32 whole-step", dtype="float32", impl="pallas_step")
+
+    # the EF whole-step experiment, driven like the solver drives its
+    # fused steppers (same dt, same walls)
+    cfg = s_f32.cfg
+    ef = EFStepStepper(grid.shape, grid.spacing, 1.0, s_f32.dt,
+                       cfg.boundary_band, 0.0)
+    st = s_f32.initial_state()
+    u0, t0 = st.u, st.t
+    run = jax.jit(lambda u, t: ef.run(u, t, ITERS)[0])
+    zero = jax.jit(lambda u, t: ef.run(u, t, 0)[0])
+    tr = _timed(lambda: run(u0, t0), lambda: zero(u0, t0), REPS)
+    u_end = run(u0, t0)
+    t_end = float(t0) + ITERS * ef.dt
+    from multigpu_advectiondiffusion_tpu.utils import metrics
+
+    n = metrics.error_norms(u_end, s_f32.exact_solution(t_end),
+                            grid.spacing)
+    rows.append((f"bf16+EF whole-step (bz={ef.block_z})",
+                 cells * ITERS * 3 / tr.seconds / 1e6, n.l1, n.linf))
+
+    import numpy as np
+
+    dev = np.max(np.abs(np.asarray(u_end) - np.asarray(out_f32.u)))
+
+    print(f"\n400x200x208, {ITERS} iters, stability dt, one chip "
+          f"({jax.devices()[0].platform}):")
+    print(f"{'storage':<30} {'MLUPS':>8} {'vs f32':>7} {'L1':>10} {'Linf':>10}")
+    base = rows[0][1]
+    for label, rate, l1, linf in rows:
+        print(f"{label:<30} {rate:>8.0f} {rate / base:>6.2f}x "
+              f"{l1:>10.2e} {linf:>10.2e}")
+    print(f"\nmax |EF - f32-per-stage| after {ITERS} steps: {dev:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""MXU-offload experiment for the WENO x sweep (VERDICT r4 item 3).
+
+The fused Burgers kernels are bound by the VPU's shift/permute unit
+(PARITY.md ablations: removing ~8% of ALU moved the rate 0%; one lane
+tile moved it 14%), and the x sweep prices at ~1.5x the y sweep because
+lane-axis shifts are the permute unit's most expensive op. The MXU sits
+idle in these kernels. Candidate: express the x sweep's circular window
+shifts as permutation matmuls on the MXU — `roll(v, k)` is exactly
+`v @ P_k` with `P_k[j, i] = [j == (i + k) mod W]` — so every shift the
+x sweep issues moves from the permute unit to the (idle) systolic
+array. Permutation matmuls are bit-exact even through XLA's bf16x3 f32
+path: each output element is `1.0 * x + zeros`, and the bf16 hi/lo
+split of `x` re-sums exactly.
+
+The arithmetic says dense-matmul shifts are priced at W MACs/element
+against the roll's ~1 permute-op/element — a ~640x op-count inflation
+the MXU's ~30x throughput advantage over the VPU cannot absorb — but
+the ladder's ethos is to measure the other unit before declaring the
+roof (the transpose-x-sweep rejection was measured too, and tied). So:
+monkeypatch `fused_burgers._div_x` with the MXU variant, verify
+equality, and time both at 512^3 viscous fixed-dt. Accept if >5% over
+the production rate; table lands in PARITY.md.
+
+Run: python out/mxu_offload_exp.py  (real TPU; ~4 min)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from multigpu_advectiondiffusion_tpu.bench.timing import _timed
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+from multigpu_advectiondiffusion_tpu.models.burgers import (
+    BurgersConfig,
+    BurgersSolver,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas import fused_burgers as fb
+
+ITERS = 20
+REPS = 3
+
+
+def _shift_mxu(v, off: int):
+    """Circular ``result[i] = v[..., i + off]`` on the lane axis as a
+    permutation matmul (MXU), replacing the VPU lane roll."""
+    W = v.shape[-1]
+    if off % W == 0:
+        return v
+    i = lax.broadcasted_iota(jnp.int32, (W, W), 0)  # input lane j
+    j = lax.broadcasted_iota(jnp.int32, (W, W), 1)  # output lane i
+    P = (i == lax.rem(j + off + 4 * W, W)).astype(v.dtype)
+    flat = v.reshape(-1, W)
+    out = lax.dot_general(
+        flat, P, (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=v.dtype,
+    )
+    return out.reshape(v.shape)
+
+
+def _div_x_mxu(vp, vm, inv_dx, variant, order=5):
+    """fused_burgers._div_x with every lane shift routed to the MXU."""
+    from multigpu_advectiondiffusion_tpu.ops.weno import (
+        _weno5_side_nd_e,
+        _weno7_side_nd_e,
+    )
+
+    sh = _shift_mxu
+    ep = sh(vp, 1) - vp
+    em = sh(vm, 1) - vm
+    if order == 7:
+        nm, dm = _weno7_side_nd_e(*(sh(ep, j - 3) for j in range(6)), "minus")
+        np_, dp = _weno7_side_nd_e(*(sh(em, j - 2) for j in range(6)), "plus")
+    else:
+        nm, dm = _weno5_side_nd_e(
+            *(sh(ep, j - 2) for j in range(4)), variant, "minus"
+        )
+        np_, dp = _weno5_side_nd_e(
+            *(sh(em, j - 1) for j in range(4)), variant, "plus"
+        )
+    h = (vp + sh(vm, 1)) + (nm * fb._recip(dm) + np_ * fb._recip(dp))
+    return (h - sh(h, -1)) * inv_dx
+
+
+def make_solver(n):
+    grid = Grid.make(n, n, n, lengths=2.0)
+    return BurgersSolver(
+        BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                      adaptive_dt=False, impl="pallas")
+    )
+
+
+def run_variant(n, iters, reps):
+    s = make_solver(n)
+    fused = s._fused_stepper()
+    assert fused is not None
+    st = s.initial_state()
+    u0, t0 = st.u, st.t
+    run = jax.jit(lambda u, t: fused.run(u, t, iters)[0])
+    zero = jax.jit(lambda u, t: fused.run(u, t, 0)[0])
+    tr = _timed(lambda: run(u0, t0), lambda: zero(u0, t0), reps)
+    return n**3 * iters * 3 / tr.seconds / 1e6, np.asarray(run(u0, t0))
+
+
+def main():
+    orig = fb._div_x
+
+    # equality first, at a size where the slow variant is cheap
+    _, a = run_variant(64, 5, 1)
+    fb._div_x = _div_x_mxu
+    try:
+        _, b = run_variant(64, 5, 1)
+        scale = float(np.max(np.abs(a)))
+        dev = float(np.max(np.abs(a - b))) / scale
+        print(f"64^3 5-step max-diff/scale (MXU vs roll): {dev:.2e}")
+        assert dev <= 32 * np.finfo(np.float32).eps, dev
+
+        mxu_rate, _ = run_variant(512, ITERS, REPS)
+    finally:
+        fb._div_x = orig
+    roll_rate, _ = run_variant(512, ITERS, REPS)
+
+    print(f"\n512^3 viscous WENO5-JS, fixed dt, one chip "
+          f"({jax.devices()[0].platform}):")
+    print(f"{'x-sweep shifts':<34} {'MLUPS':>8}")
+    print(f"{'VPU lane rolls (production)':<34} {roll_rate:>8.0f}")
+    print(f"{'MXU permutation matmuls':<34} {mxu_rate:>8.0f}")
+    print(f"\nMXU/roll: {mxu_rate / roll_rate:.3f}x "
+          f"(accept threshold: > 1.05x)")
+
+
+if __name__ == "__main__":
+    main()

@@ -723,6 +723,126 @@ def test_fused_burgers_advance_to_sharded_matches_unsharded(devices, adaptive):
     assert int(out.it) == int(ref.it) > 0
 
 
+@pytest.mark.parametrize("order", [5, 7], ids=["weno5", "weno7"])
+@pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
+def test_fused_burgers_xsharded_matches_unsharded(devices, adaptive, order):
+    """An x-sharded mesh engages the stored-x-ghost layout (interior at
+    lane offset r, ppermute refresh rewriting real ghost lanes) instead
+    of falling back to the generic path, and must reproduce the
+    unsharded fused run — the lane-axis analog of the tuned-kernel-
+    under-MPI property (SURVEY §2.1.5: decomposition on any axis)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(16, 16, 48, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, weno_order=order, nu=1e-5,
+                        dtype="float32", adaptive_dt=adaptive,
+                        impl="pallas")
+    ref_solver = BurgersSolver(cfg)
+    ref_fused = ref_solver._fused_stepper()
+    assert ref_fused is not None and not ref_fused.x_sharded
+    ref = ref_solver.run(ref_solver.initial_state(), 5)
+    solver = BurgersSolver(
+        cfg, mesh=make_mesh({"dx": 2}), decomp=Decomposition.of({2: "dx"})
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded and fused.x_sharded, (
+        getattr(solver, "_fused_fallback", None)
+    )
+    assert fused.core_offsets[2] == fused.halo
+    out = solver.run(solver.initial_state(), 5)
+    _assert_fused_close(out.u, ref.u)
+    np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
+
+
+def test_fused_burgers_extent1_mesh_axis_still_engages_fused(devices):
+    """An extent-1 mesh axis exchanges no ghosts, so it must not trip
+    the y-rounding (or x-layout) eligibility gates: a {dz:4, dy:1} mesh
+    with ly % 8 != 0 engages the fused stepper exactly like {dz:4}."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(16, 50, 16, lengths=2.0)  # ly = 50, not 8-aligned
+    cfg = BurgersConfig(grid=grid, nu=1e-5, dtype="float32", impl="pallas")
+    s = BurgersSolver(cfg, mesh=make_mesh({"dz": 4, "dy": 1}),
+                      decomp=Decomposition.of({0: "dz", 1: "dy"}))
+    fused = s._fused_stepper()
+    assert fused is not None and not fused.x_sharded, (
+        getattr(s, "_fused_fallback", None)
+    )
+    ref = BurgersSolver(cfg)
+    r = ref.run(ref.initial_state(), 3)
+    o = s.run(s.initial_state(), 3)
+    _assert_fused_close(o.u, r.u)
+
+
+def test_fused_burgers_xsharded_block_mesh_split_overlap(devices):
+    """A {dz, dx} block mesh with overlap='split': the z halo rides the
+    overlapped exchanged-slab schedule while the x ghosts (stored-x-ghost
+    layout) keep the serialized per-stage refresh — and the exchanged z
+    slabs must carry fresh x ghost lanes. Matches the all-serialized
+    fused path and the unsharded run."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(24, 16, 48, lengths=2.0)
+    unsharded = BurgersSolver(
+        BurgersConfig(grid=grid, nu=1e-5, dtype="float32", impl="pallas")
+    )
+    ref = unsharded.run(unsharded.initial_state(), 5)
+    outs = {}
+    for overlap in ("split", "padded"):
+        cfg = BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                            impl="pallas", overlap=overlap)
+        solver = BurgersSolver(
+            cfg,
+            mesh=make_mesh({"dz": 2, "dx": 2}),
+            decomp=Decomposition.of({0: "dz", 2: "dx"}),
+        )
+        fused = solver._fused_stepper()
+        assert fused is not None and fused.x_sharded
+        assert fused.overlap_split == (overlap == "split"), (
+            overlap, getattr(solver, "_fused_fallback", None)
+        )
+        st = solver.run(solver.initial_state(), 5)
+        outs[overlap] = np.asarray(st.u)
+        np.testing.assert_allclose(float(st.t), float(ref.t), rtol=1e-6)
+    _assert_fused_close(outs["split"], outs["padded"])
+    _assert_fused_close(outs["split"], ref.u)
+
+
+def test_fused_burgers_xsharded_advance_to(devices):
+    """run_to through the stored-x-ghost layout (adaptive dt, emitted
+    wave speed, x refresh between stages) matches the unsharded fused
+    trajectory and step count."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(16, 16, 48, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                        adaptive_dt=True, impl="pallas")
+    ref_s = BurgersSolver(cfg)
+    t_end = 0.04
+    ref = ref_s.advance_to(ref_s.initial_state(), t_end)
+    solver = BurgersSolver(
+        cfg, mesh=make_mesh({"dx": 2}), decomp=Decomposition.of({2: "dx"})
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.x_sharded
+    out = solver.advance_to(solver.initial_state(), t_end)
+    _assert_fused_close(out.u, ref.u)
+    np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
+    assert int(out.it) == int(ref.it) > 0
+
+
 @pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
 def test_fused_burgers_split_overlap_matches_serialized(devices, adaptive):
     """overlap='split' on a z-slab mesh runs the three-call overlapped
