@@ -166,6 +166,20 @@ def _cases(on_tpu: bool):
                             impl="xla")
         )
 
+    def burg2d_weno7():
+        # The 2-D order-7 rung on the MultiGPU Burgers2d workload: the
+        # halo-4 whole-run VMEM stepper (LFWENO7FDM2d.m is MATLAB-only,
+        # never benchmarked; the anchor is the 2-D order-5 baseline).
+        g = (
+            Grid.make(400, 406, lengths=2.0)
+            if on_tpu
+            else Grid.make(40, 46, lengths=2.0)
+        )
+        return BurgersSolver(
+            BurgersConfig(grid=g, weno_order=7, dtype="float32",
+                          adaptive_dt=False, impl="pallas")
+        )
+
     def burg3d_weno7():
         # The order-7 rung of the fused family at the flagship 512^3
         # viscous workload (halo-4 kernels). The reference's WENO7 is
@@ -241,6 +255,10 @@ def _cases(on_tpu: bool):
         # ~30 iters x 3 stages at ~4.7k MLUPS => ~2.5 s window
         ("burgers3d_weno7_mlups", burg3d_weno7, "iters", it(30),
          BASELINES_MLUPS["burgers3d_512_weno7"][0]),
+        # 12000 iters (~0.9 s at ~6.2k MLUPS): the 2-D window rule —
+        # whole-run calls must dwarf the per-call sync jitter
+        ("burgers2d_weno7_mlups", burg2d_weno7, "iters", it(12000),
+         BASELINES_MLUPS["burgers2d_weno7"][0]),
     ]
 
 

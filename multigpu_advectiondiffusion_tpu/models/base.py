@@ -328,6 +328,23 @@ class SolverBase:
             "fallback": fallback,
         }
 
+    def _sharded_axes(self):
+        """Array axes that are *actually* decomposed: listed in the
+        decomposition AND backed by a mesh extent > 1. The single
+        definition of "sharded" for every eligibility gate — extent-1
+        axes exchange no ghosts and must never trip layout/rounding
+        gates (axis_extent, not sizes.get: compound (tuple) mesh-axis
+        entries — the multihost z layout ('dz_dcn', 'dz_ici') — are
+        never keys of mesh.shape and would silently read as extent 1).
+        """
+        if self.mesh is None:
+            return []
+        sizes = dict(self.mesh.shape)
+        return [
+            ax for ax, name in self.decomp.axes
+            if axis_extent(sizes, name) > 1
+        ]
+
     def _split_overlap_requested(self) -> bool:
         """``overlap='split'`` with a decomposition the fused steppers'
         three-call overlapped schedule serves: the leading (z) axis
@@ -337,14 +354,7 @@ class SolverBase:
         refresh). Single definition for every solver's eligibility."""
         if self.mesh is None or getattr(self.cfg, "overlap", None) != "split":
             return False
-        sizes = dict(self.mesh.shape)
-        # axis_extent, not sizes.get: compound (tuple) mesh-axis entries —
-        # the multihost z layout ('dz_dcn', 'dz_ici') — are never keys of
-        # mesh.shape and would silently read as extent 1
-        sharded = [
-            ax for ax, name in self.decomp.axes
-            if axis_extent(sizes, name) > 1
-        ]
+        sharded = self._sharded_axes()
         if sharded == [0]:
             return True
         return self.grid.ndim == 3 and bool(sharded) and sharded[0] == 0

@@ -152,7 +152,7 @@ class BurgersSolver(SolverBase):
     def _fused_stepper(self):
         """The fused SSP-RK3 stepper when this config is eligible, else
         ``None``. Eligibility mirrors the kernels' assumptions: 2-D/3-D
-        cartesian WENO5, edge ghosts, f32. The 3-D per-stage kernel
+        cartesian WENO5-JS/Z or WENO7-JS, edge ghosts, f32. The 3-D per-stage kernel
         serves every dt mode and world: adaptive dt rides a runtime SMEM
         scalar (global ``max|f'(u)|`` reduction between steps), and under
         a mesh the kernel runs shard-local with ppermute ghost refresh
@@ -175,14 +175,9 @@ class BurgersSolver(SolverBase):
             return self._decline("fused WENO kernels are 2-D/3-D only")
         fused_orders = {(5, "js"), (5, "z"), (7, "js")}
         if (cfg.weno_order, cfg.weno_variant) not in fused_orders:
-            if self.grid.ndim == 3:
-                return self._decline(
-                    "fused kernels implement WENO5-JS/Z and WENO7-JS only"
-                )
-            return self._decline("fused kernels implement WENO5-JS/Z only")
-        if cfg.weno_order == 7 and self.grid.ndim != 3:
-            # the 2-D whole-run/per-stage kernels remain WENO5-only
-            return self._decline("fused 2-D kernels implement WENO5 only")
+            return self._decline(
+                "fused kernels implement WENO5-JS/Z and WENO7-JS only"
+            )
         if cfg.integrator != "ssp_rk3":
             return self._decline("fused kernels bake in SSP-RK3")
         if cfg.nu != 0.0 and cfg.laplacian_order != 4:
@@ -215,23 +210,13 @@ class BurgersSolver(SolverBase):
             # layout (interior at lane offset halo) so the ppermute
             # refresh has real ghost lanes to rewrite — the lane-aligned
             # default stores none (fused_burgers._x_widths; priced in
-            # PARITY.md). Extent-1 mesh axes need no ghosts.
-            sizes = {} if self.mesh is None else dict(self.mesh.shape)
-            from multigpu_advectiondiffusion_tpu.parallel.mesh import (
-                axis_extent,
-            )
-
-            x_sharded = self.mesh is not None and any(
-                ax == 2 and axis_extent(sizes, nm) > 1
-                for ax, nm in self.decomp.axes
-            )
-            # y-rounding is incompatible only with a y-sharded axis
-            # (dead columns would be exchanged as neighbor ghosts);
-            # extent-1 axes exchange nothing
-            y_sharded = self.mesh is not None and any(
-                ax == 1 and axis_extent(sizes, nm) > 1
-                for ax, nm in self.decomp.axes
-            )
+            # PARITY.md). y-rounding is incompatible only with a
+            # y-sharded axis (dead columns would be exchanged as
+            # neighbor ghosts). _sharded_axes filters out extent-1 mesh
+            # axes, which exchange nothing and trip neither gate.
+            sharded_axes = self._sharded_axes()
+            x_sharded = 2 in sharded_axes
+            y_sharded = 1 in sharded_axes
             if not cls.supported(lshape, self.dtype, y_sharded=y_sharded,
                                  order=cfg.weno_order, x_sharded=x_sharded):
                 return self._decline(
@@ -241,7 +226,8 @@ class BurgersSolver(SolverBase):
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (  # noqa: E501
                 FusedBurgers2DStepper as cls,
             )
-            if not cls.supported(lshape, self.dtype):
+            if not cls.supported(lshape, self.dtype,
+                                 order=cfg.weno_order):
                 return self._decline(
                     "2-D grid exceeds the whole-run VMEM budget"
                 )
@@ -252,14 +238,18 @@ class BurgersSolver(SolverBase):
             from multigpu_advectiondiffusion_tpu.ops.pallas.fused2d_sharded import (  # noqa: E501
                 ShardedFusedBurgers2DStepper as cls,
             )
+            from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+            halo = HALO[cfg.weno_order]
             if any(
-                lshape[ax] < cls.halo for ax, _ in self.decomp.axes
+                lshape[ax] < halo for ax, _ in self.decomp.axes
             ):
                 return self._decline(
-                    f"a sharded axis is thinner than the WENO5 halo "
-                    f"({cls.halo})"
+                    f"a sharded axis is thinner than the WENO"
+                    f"{cfg.weno_order} halo ({halo})"
                 )
-            if not cls.supported(lshape, self.dtype):
+            if not cls.supported(lshape, self.dtype,
+                                 order=cfg.weno_order):
                 return self._decline(
                     "2-D shard exceeds the per-stage VMEM budget"
                 )
@@ -300,6 +290,7 @@ class BurgersSolver(SolverBase):
                     cfg.weno_variant, cfg.nu, **kwargs,
                 )
             else:
+                kwargs["order"] = cfg.weno_order
                 if self.mesh is not None:
                     kwargs["global_shape"] = self.grid.shape
                     kwargs["overlap_split"] = self._split_overlap_requested()

@@ -119,23 +119,24 @@ def _edge_fill_global(rk, offs_ref, local_shape, global_shape, halo):
 
 
 def _burgers_stage(v, u, dt, offs_ref, *, a, b, local_shape, global_shape,
-                   inv_dx, nu_scales, flux, variant):
-    """One RK stage of 2-D Burgers/WENO5 over the whole padded shard.
+                   inv_dx, nu_scales, flux, variant, order=5, halo=R_WENO):
+    """One RK stage of 2-D Burgers/WENO over the whole padded shard
+    (order 5 halo 3, order 7 halo 4).
 
     Same op sequence as the single-chip whole-run stage
     (``fused_burgers2d._stage``) so the sharded run reproduces it
     per-cell; only the ghost synthesis is keyed on global coordinates."""
     vp, vm = _split(flux, v)
     rhs = -(
-        _div_roll(vp, vm, 0, inv_dx[0], variant)
-        + _div_roll(vp, vm, 1, inv_dx[1], variant)
+        _div_roll(vp, vm, 0, inv_dx[0], variant, order)
+        + _div_roll(vp, vm, 1, inv_dx[1], variant, order)
     )
     if nu_scales is not None:
         rhs = rhs + _laplacian_2d(v, nu_scales)
     dt = dt.astype(v.dtype)
     rk = b * (v + dt * rhs) if a == 0.0 else a * u + b * (v + dt * rhs)
     return _edge_fill_global(
-        rk.astype(v.dtype), offs_ref, local_shape, global_shape, R_WENO
+        rk.astype(v.dtype), offs_ref, local_shape, global_shape, halo
     )
 
 
@@ -373,26 +374,34 @@ class ShardedFusedBurgers2DStepper(_Sharded2DStepperBase):
     fixed (CUDA parity) and adaptive (``max|f'(u)|`` + ``lax.pmax``
     between steps through the runtime SMEM dt scalar)."""
 
-    halo = R_WENO
+    halo = R_WENO  # class default; instances set halo = HALO[order]
     core_offsets = (R_WENO, R_WENO)
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
                  dt_fn=None, global_shape=None,
-                 overlap_split: bool = False):
+                 overlap_split: bool = False, order: int = 5):
+        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
+        if order == 7 and variant != "js":
+            raise ValueError("WENO7 supports only the 'js' variant")
+        r = HALO[order]
+        self.order = order
+        self.halo = r
+        self.core_offsets = (r, r)
         ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
         # split needs a non-degenerate interior band (>= h rows)
         self.overlap_split = bool(
-            overlap_split and self.sharded and ly >= 3 * R_WENO
+            overlap_split and self.sharded and ly >= 3 * r
         )
         self.padded_shape = (
-            round_up(ly + 2 * R_WENO, SUBLANE),
-            round_up(lx + 2 * R_WENO, LANE),
+            round_up(ly + 2 * r, SUBLANE),
+            round_up(lx + 2 * r, LANE),
         )
         self.dtype = jnp.dtype(dtype)
         nu_scales = None
@@ -410,6 +419,8 @@ class ShardedFusedBurgers2DStepper(_Sharded2DStepperBase):
                 nu_scales=nu_scales,
                 flux=flux,
                 variant=variant,
+                order=order,
+                halo=r,
             )
 
         self._build_step(stage_fn_for, self.dtype)
@@ -417,18 +428,25 @@ class ShardedFusedBurgers2DStepper(_Sharded2DStepperBase):
         self._dt_fn = dt_fn
 
     @staticmethod
-    def supported(interior_shape, dtype) -> bool:
+    def supported(interior_shape, dtype, order: int = 5) -> bool:
+        from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (  # noqa: E501
+            _LIVE_BUFFERS_W7,
+        )
+        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
         return fits_vmem(
-            interior_shape, R_WENO, _BURGERS_LIVE,
+            interior_shape, HALO[order],
+            _BURGERS_LIVE if order == 5 else _LIVE_BUFFERS_W7,
             jnp.dtype(dtype).itemsize, budget=_BURGERS_BUDGET,
         )
 
     def embed(self, u):
+        r = self.halo
         ly, lx = self.interior_shape
         py, px = self.padded_shape
         return jnp.pad(
             u.astype(self.dtype),
-            ((R_WENO, py - ly - R_WENO), (R_WENO, px - lx - R_WENO)),
+            ((r, py - ly - r), (r, px - lx - r)),
             mode="edge",
         )
 

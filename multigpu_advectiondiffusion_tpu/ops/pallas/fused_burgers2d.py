@@ -1,4 +1,9 @@
-"""Whole-run VMEM-resident SSP-RK3 stepping for 2-D Burgers/WENO5.
+"""Whole-run VMEM-resident SSP-RK3 stepping for 2-D Burgers/WENO.
+
+Serves WENO5-JS/Z (halo 3) and WENO7-JS (halo 4) with the same in-core
+sweeps — the order parameterizes the ghost width and the e-window count
+(``fused_burgers._div_roll``), mirroring the 3-D family
+(``LFWENO7FDM2d.m`` is the reference ground truth for order 7).
 
 Same design as :mod:`fused_diffusion2d`: a reference-scale 2-D grid
 (400×406, ``MultiGPU/Burgers2d_Baseline/Run.m``) is under 1 MB in f32,
@@ -42,22 +47,24 @@ from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
     round_up,
 )
 
-R = 3  # WENO5 stencil radius == ghost width
+R = 3  # WENO5 stencil radius == ghost width; order 7 runs with halo 4
 
 # WENO keeps many more live full-array temporaries than the Laplacian
-# (vp/vm, 10 shifted operands, betas, weights, interface fluxes).
+# (vp/vm, 10 shifted operands, betas, weights, interface fluxes);
+# order 7 holds 6 e-windows per side plus the quadratic-form partials.
 _VMEM_BUDGET = 64 * 1024 * 1024
 _LIVE_BUFFERS = 24
+_LIVE_BUFFERS_W7 = 30
 
 
-def _edge_fill_2d(rk, ny, nx):
+def _edge_fill_2d(rk, ny, nx, r=R):
     """Edge-replicate every non-interior cell (corners/slack included)."""
-    gy = lax.broadcasted_iota(jnp.int32, rk.shape, 0) - R
-    gx = lax.broadcasted_iota(jnp.int32, rk.shape, 1) - R
-    t = jnp.where(gx < 0, rk[:, R : R + 1], rk)
-    t = jnp.where(gx >= nx, t[:, R + nx - 1 : R + nx], t)
-    t = jnp.where(gy < 0, t[R : R + 1, :], t)
-    return jnp.where(gy >= ny, t[R + ny - 1 : R + ny, :], t)
+    gy = lax.broadcasted_iota(jnp.int32, rk.shape, 0) - r
+    gx = lax.broadcasted_iota(jnp.int32, rk.shape, 1) - r
+    t = jnp.where(gx < 0, rk[:, r : r + 1], rk)
+    t = jnp.where(gx >= nx, t[:, r + nx - 1 : r + nx], t)
+    t = jnp.where(gy < 0, t[r : r + 1, :], t)
+    return jnp.where(gy >= ny, t[r + ny - 1 : r + ny, :], t)
 
 
 def _laplacian_2d(v, scales):
@@ -69,21 +76,22 @@ def _laplacian_2d(v, scales):
     return acc
 
 
-def _stage(u, v, *, interior_shape, inv_dx, nu_scales, flux, variant, a, b, dt):
+def _stage(u, v, *, interior_shape, inv_dx, nu_scales, flux, variant, a, b,
+           dt, order=5, r=R):
     """One RK stage over the full padded array, ghosts re-synthesized.
     ``dt`` is a trace-time float (fixed mode) or a traced in-core scalar
     (adaptive mode, bound per-iteration by ``whole_run_adaptive``)."""
     ny, nx = interior_shape
     vp, vm = _split(flux, v)
     rhs = -(
-        _div_roll(vp, vm, 0, inv_dx[0], variant)
-        + _div_roll(vp, vm, 1, inv_dx[1], variant)
+        _div_roll(vp, vm, 0, inv_dx[0], variant, order)
+        + _div_roll(vp, vm, 1, inv_dx[1], variant, order)
     )
     if nu_scales is not None:
         rhs = rhs + _laplacian_2d(v, nu_scales)
     dt = jnp.asarray(dt, v.dtype)
     rk = b * (v + dt * rhs) if a == 0.0 else a * u + b * (v + dt * rhs)
-    return _edge_fill_2d(rk.astype(v.dtype), ny, nx)
+    return _edge_fill_2d(rk.astype(v.dtype), ny, nx, r)
 
 
 class FusedBurgers2DStepper:
@@ -97,14 +105,21 @@ class FusedBurgers2DStepper:
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
-                 dt_fn=None):
+                 dt_fn=None, order: int = 5):
+        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
         if (dt is None) == (dt_fn is None):
             raise ValueError("provide exactly one of dt/dt_fn")
+        if order == 7 and variant != "js":
+            raise ValueError("WENO7 supports only the 'js' variant")
+        r = HALO[order]
+        self.order = order
+        self.halo = r
         ny, nx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.padded_shape = (
-            round_up(ny + 2 * R, SUBLANE),
-            round_up(nx + 2 * R, LANE),
+            round_up(ny + 2 * r, SUBLANE),
+            round_up(nx + 2 * r, LANE),
         )
         self.dtype = jnp.dtype(dtype)
         nu_scales = None
@@ -119,33 +134,39 @@ class FusedBurgers2DStepper:
             nu_scales=nu_scales,
             flux=flux,
             variant=variant,
+            order=order,
+            r=r,
         )
         self.dt = None if dt is None else float(dt)
         self._dt_fn = dt_fn
 
     @staticmethod
-    def supported(interior_shape, dtype) -> bool:
+    def supported(interior_shape, dtype, order: int = 5) -> bool:
         from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
             fits_vmem,
         )
+        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
 
         return fits_vmem(
-            interior_shape, R, _LIVE_BUFFERS,
+            interior_shape, HALO[order],
+            _LIVE_BUFFERS if order == 5 else _LIVE_BUFFERS_W7,
             jnp.dtype(dtype).itemsize, budget=_VMEM_BUDGET,
         )
 
     def embed(self, u):
+        r = self.halo
         ny, nx = self.interior_shape
         py, px = self.padded_shape
         return jnp.pad(
             u.astype(self.dtype),
-            ((R, py - ny - R), (R, px - nx - R)),
+            ((r, py - ny - r), (r, px - nx - r)),
             mode="edge",
         )
 
     def extract(self, S):
+        r = self.halo
         ny, nx = self.interior_shape
-        return lax.slice(S, (R, R), (R + ny, R + nx))
+        return lax.slice(S, (r, r), (r + ny, r + nx))
 
     def run(self, u, t, num_iters: int):
         from multigpu_advectiondiffusion_tpu.ops.pallas.whole_run import (

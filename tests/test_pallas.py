@@ -817,6 +817,49 @@ def test_fused_burgers_xsharded_block_mesh_split_overlap(devices):
     _assert_fused_close(outs["split"], ref.u)
 
 
+def test_fused_diffusion_xsharded_split_overlap(devices):
+    """The split-overlap broadening also exposes {dz, dx} DIFFUSION
+    meshes: the z halo rides the exchanged-slab schedule while the x
+    ghosts (stored layout — diffusion keeps ghosts on every axis) take
+    the serialized refresh. Must match the serialized fused path and
+    the unsharded fused run to the same ulp band the z-slab split test
+    uses (interpret mode compiles each schedule separately, so FMA
+    fusion may differ by an ulp)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    # local lz = 96 hosts a >= 3-block interior band for diffusion's
+    # larger block sizes
+    grid = Grid.make(32, 16, 192, lengths=2.0)
+    unsharded = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    )
+    ref = unsharded.run(unsharded.initial_state(), 5)
+    outs = {}
+    for overlap in ("split", "padded"):
+        cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas",
+                              overlap=overlap)
+        solver = DiffusionSolver(
+            cfg,
+            mesh=make_mesh({"dz": 2, "dx": 2}),
+            decomp=Decomposition.of({0: "dz", 2: "dx"}),
+        )
+        fused = solver._fused_stepper()
+        assert fused is not None and fused.sharded
+        assert fused.overlap_split == (overlap == "split"), (
+            overlap, getattr(solver, "_fused_fallback", None)
+        )
+        st = solver.run(solver.initial_state(), 5)
+        outs[overlap] = np.asarray(st.u)
+    scale = float(np.max(np.abs(outs["padded"])))
+    np.testing.assert_allclose(outs["split"], outs["padded"],
+                               rtol=1e-6, atol=1e-7 * scale)
+    np.testing.assert_allclose(outs["split"], np.asarray(ref.u),
+                               rtol=1e-6, atol=1e-7 * scale)
+
+
 def test_fused_burgers_xsharded_advance_to(devices):
     """run_to through the stored-x-ghost layout (adaptive dt, emitted
     wave speed, x refresh between stages) matches the unsharded fused
@@ -1277,6 +1320,107 @@ def test_fused2d_sharded_burgers_matches_unsharded(devices, mesh_axes,
     out = solver.run(solver.initial_state(), 6)
     _assert_fused_close(out.u, ref.u)
     np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
+
+
+@pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
+def test_fused_burgers2d_weno7_matches_xla(adaptive):
+    """The 2-D whole-run stepper at order 7 (halo 4, LFWENO7FDM2d.m)
+    must agree with the generic XLA path in both dt modes — order
+    parity for the 2-D fused family, matching what the 3-D family
+    already serves."""
+    grid = Grid.make(40, 24, lengths=[4.0, 2.5])
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cfg = BurgersConfig(grid=grid, weno_order=7, cfl=0.3, nu=1e-4,
+                            dtype="float32", ic="gaussian", impl=impl,
+                            adaptive_dt=adaptive)
+        solver = BurgersSolver(cfg)
+        if impl == "pallas":
+            fused = solver._fused_stepper()
+            assert type(fused).__name__ == "FusedBurgers2DStepper", (
+                getattr(solver, "_fused_fallback", None)
+            )
+            assert fused.halo == 4
+        st = solver.run(solver.initial_state(), 8)
+        outs[impl] = (np.asarray(st.u), float(st.t))
+    scale = float(np.max(np.abs(outs["xla"][0])))
+    # same band as the 3-D WENO7-vs-XLA tests: the fused e-form and the
+    # XLA q-form round differently through the order-7 nonlinear
+    # weights, compounding over the 8 steps (adaptive additionally
+    # feeds the gap back through dt)
+    np.testing.assert_allclose(
+        outs["pallas"][0], outs["xla"][0], rtol=2e-5,
+        atol=(6e-5 if adaptive else 3e-5) * scale,
+    )
+    np.testing.assert_allclose(outs["pallas"][1], outs["xla"][1], rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "mesh_axes,decomp_map",
+    [({"dy": 2, "dx": 2}, {0: "dy", 1: "dx"})],
+    ids=["pencil"],
+)
+def test_fused2d_sharded_burgers_weno7(devices, mesh_axes, decomp_map):
+    """Order 7 through the sharded per-stage 2-D kernels: the 4-deep
+    ppermute refresh on both axes must reproduce the single-chip
+    whole-run order-7 stepper (adaptive dt, pmax in the loop)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 32, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, weno_order=7, nu=1e-4, dtype="float32",
+                        adaptive_dt=True, impl="pallas")
+    ref_solver = BurgersSolver(cfg)
+    assert type(ref_solver._fused_stepper()).__name__ == (
+        "FusedBurgers2DStepper"
+    )
+    ref = ref_solver.run(ref_solver.initial_state(), 6)
+    solver = BurgersSolver(
+        cfg, mesh=make_mesh(mesh_axes), decomp=Decomposition.of(decomp_map)
+    )
+    fused = solver._fused_stepper()
+    assert fused is not None and fused.sharded and fused.halo == 4, (
+        getattr(solver, "_fused_fallback", None)
+    )
+    out = solver.run(solver.initial_state(), 6)
+    _assert_fused_close(out.u, ref.u)
+    np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
+
+
+def test_fused2d_weno7_split_overlap(devices):
+    """Order 7 through the 2-D split-overlap band schedule (halo-4 edge
+    bands consuming the exchanged slabs) matches the serialized refresh
+    and the unsharded whole-run stepper."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(40, 48, lengths=2.0)  # ly local 12 >= 3*4
+    ref_solver = BurgersSolver(
+        BurgersConfig(grid=grid, weno_order=7, nu=1e-4, dtype="float32",
+                      impl="pallas")
+    )
+    ref = ref_solver.run(ref_solver.initial_state(), 6)
+    outs = {}
+    for overlap in ("split", "padded"):
+        cfg = BurgersConfig(grid=grid, weno_order=7, nu=1e-4,
+                            dtype="float32", impl="pallas",
+                            overlap=overlap)
+        solver = BurgersSolver(
+            cfg, mesh=make_mesh({"dy": 4}), decomp=Decomposition.of({0: "dy"})
+        )
+        fused = solver._fused_stepper()
+        assert fused is not None and fused.halo == 4
+        assert fused.overlap_split == (overlap == "split"), (
+            overlap, getattr(solver, "_fused_fallback", None)
+        )
+        st = solver.run(solver.initial_state(), 6)
+        outs[overlap] = np.asarray(st.u)
+    _assert_fused_close(outs["split"], outs["padded"])
+    _assert_fused_close(outs["split"], ref.u)
 
 
 @pytest.mark.parametrize("adaptive", [False, True], ids=["fixed", "adaptive"])
