@@ -135,7 +135,7 @@ def test_split_overlap_tpu_schedule_hides_collectives():
 
 
 @pytest.mark.parametrize("model", ["burgers", "diffusion",
-                                   "burgers-pencil"])
+                                   "burgers-pencil", "burgers-xghost"])
 def test_fused_split_overlap_tpu_schedule_hides_collectives(
     monkeypatch, model
 ):
@@ -170,11 +170,12 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
     monkeypatch.setattr(lap, "interpret_mode", lambda: False)
 
     devs = np.asarray(topo.devices[:4])
-    mesh = (
-        Mesh(devs.reshape(2, 2), ("dz", "dy"))
-        if model == "burgers-pencil"
-        else Mesh(devs, ("dz",))
-    )
+    if model == "burgers-pencil":
+        mesh = Mesh(devs.reshape(2, 2), ("dz", "dy"))
+    elif model == "burgers-xghost":
+        mesh = Mesh(devs.reshape(2, 2), ("dz", "dx"))
+    else:
+        mesh = Mesh(devs, ("dz",))
     # x64 (the suite default) poisons Mosaic verification with i64
     # constants — the kernels are f32/i32 by design
     with jax.enable_x64(False):
@@ -199,6 +200,20 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
                 mesh=mesh,
                 decomp=Decomposition.of({0: "dz", 1: "dy"}),
             )
+        elif model == "burgers-xghost":
+            # {dz, dx}: the stored-x-ghost layout (interior at lane
+            # offset r) through REAL Mosaic lowering — the CPU interpret
+            # tests can't validate this layout's Mosaic compile — with
+            # the z exchange overlapped and the x refresh serialized
+            grid = Grid.make(128, 16, 128, lengths=2.0)
+            solver = BurgersSolver(
+                BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                              adaptive_dt=False, impl="pallas",
+                              overlap="split"),
+                mesh=mesh,
+                decomp=Decomposition.of({0: "dz", 2: "dx"}),
+            )
+            assert solver._fused_stepper().x_sharded
         else:
             # local lz = 60 -> bz=20 -> n_bz=3
             grid = Grid.make(128, 16, 240, lengths=2.0)
@@ -212,9 +227,11 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
         assert fused is not None and fused.overlap_split
         refresh, offsets_fn, exch = solver._fused_sharded_ctx(fused)
         assert exch is not None
-        # pencil meshes carry the serialized y refresh alongside the
-        # overlapped z exchange; pure slabs have no refresh at all
-        assert (refresh is not None) == (model == "burgers-pencil")
+        # pencil/x-sharded meshes carry a serialized non-z refresh
+        # alongside the overlapped z exchange; pure slabs have none
+        assert (refresh is not None) == (
+            model in ("burgers-pencil", "burgers-xghost")
+        )
 
         def block(u, t):
             kw = {"exch": exch}

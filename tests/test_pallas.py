@@ -80,10 +80,11 @@ def test_weno7_pallas_matches_xla(ndim, axis):
 def test_weno7_pallas_solver_end_to_end():
     """A WENO7 solver with impl='pallas_axis' pins the per-axis WENO7
     kernels (explicitly opting out of the fused stepper) and matches the
-    XLA solver; impl='pallas' now engages the fused WENO7 stepper
-    (halo-4), and a 2-D order-7 config still declines to the per-op
-    ladder with XLA winning (the per-axis WENO7 kernel measures ~2x
-    slower at 512^3 — 'pallas' promises best-available)."""
+    XLA solver; impl='pallas' engages the fused WENO7 stepper in BOTH
+    dimensions (3-D per-stage, 2-D whole-run — round 5); and a 2-D
+    order-7 config too large for the whole-run VMEM budget declines to
+    the per-op ladder with XLA winning (the per-axis WENO7 kernel
+    measures ~2x slower at 512^3 — 'pallas' promises best-available)."""
     grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
     outs = {}
     for impl in ("xla", "pallas_axis"):
@@ -105,7 +106,12 @@ def test_weno7_pallas_solver_end_to_end():
     flat = BurgersSolver(BurgersConfig(
         grid=Grid.make(32, 32, lengths=4.0), weno_order=7,
         dtype="float32", impl="pallas"))
-    path = flat.engaged_path()
+    assert flat.engaged_path()["stepper"] == "fused-whole-run"
+
+    big = BurgersSolver(BurgersConfig(
+        grid=Grid.make(8192, 8192, lengths=4.0), weno_order=7,
+        dtype="float32", impl="pallas"))
+    path = big.engaged_path()
     assert path["stepper"] == "generic-xla"
     assert "pallas_axis" in path["fallback"]
 
@@ -529,16 +535,20 @@ def test_fused_burgers_sharded_matches_unsharded_fused(
     np.testing.assert_allclose(float(out.t), float(ref.t), rtol=1e-6)
 
 
+@pytest.mark.parametrize("order", [5, 7], ids=["weno5", "weno7"])
 @pytest.mark.parametrize("flux", ["linear", "buckley"])
-def test_fused_burgers3d_generic_flux_matches_xla(flux):
+def test_fused_burgers3d_generic_flux_matches_xla(flux, order):
     """The 3-D fused kernel's generic Lax-Friedrichs split (any Flux,
     not just the Burgers-specialized identity) plus the emitted
     max|f'(u)| for a non-identity df must match the XLA path — only the
-    2-D whole-run stepper covered non-Burgers fluxes before."""
+    2-D whole-run stepper covered non-Burgers fluxes before. Both
+    orders: the split and the emission are shared across the radius-
+    parameterized family, and this pins that for halo 4 too."""
     grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
     outs = {}
     for impl in ("xla", "pallas"):
-        cfg = BurgersConfig(grid=grid, flux=flux, cfl=0.3, dtype="float32",
+        cfg = BurgersConfig(grid=grid, flux=flux, weno_order=order,
+                            cfl=0.3, dtype="float32",
                             ic="gaussian", impl=impl)
         solver = BurgersSolver(cfg)
         if impl == "pallas":
@@ -547,8 +557,11 @@ def test_fused_burgers3d_generic_flux_matches_xla(flux):
         st = solver.run(solver.initial_state(), 4)
         outs[impl] = (np.asarray(st.u), float(st.t))
     scale = float(np.max(np.abs(outs["xla"][0])))
+    # order 7 carries the wider e-form/q-form rounding band of the
+    # adaptive weno7-vs-XLA tests (dt feeds the gap back per step)
     np.testing.assert_allclose(outs["pallas"][0], outs["xla"][0],
-                               rtol=2e-5, atol=2e-6 * scale)
+                               rtol=2e-5,
+                               atol=(2e-6 if order == 5 else 6e-5) * scale)
     np.testing.assert_allclose(outs["pallas"][1], outs["xla"][1], rtol=1e-6)
 
 
