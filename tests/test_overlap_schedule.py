@@ -269,7 +269,8 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
 
 
 @pytest.mark.parametrize("overlap", ["padded", "split"])
-@pytest.mark.parametrize("model", ["burgers", "diffusion"])
+@pytest.mark.parametrize("model", ["burgers", "diffusion",
+                                   "burgers-weno7"])
 def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
     """The sharded 2-D per-stage steppers (whole-shard VMEM kernels +
     ppermute ghost refresh, or the three-band split-overlap schedule)
@@ -310,6 +311,15 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
                 mesh=mesh,
                 decomp=Decomposition.of({0: "dy"}),
             )
+        elif model == "burgers-weno7":
+            # order 7 (halo-4 bands) through real Mosaic lowering
+            solver = BurgersSolver(
+                BurgersConfig(grid=grid, weno_order=7, nu=1e-4,
+                              dtype="float32", impl="pallas",
+                              overlap=overlap),
+                mesh=mesh,
+                decomp=Decomposition.of({0: "dy"}),
+            )
         else:
             solver = DiffusionSolver(
                 DiffusionConfig(grid=grid, dtype="float32", impl="pallas",
@@ -320,6 +330,8 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
         fused = solver._fused_stepper()
         assert fused is not None and fused.sharded
         assert fused.overlap_split == (overlap == "split")
+        if model == "burgers-weno7":
+            assert fused.halo == 4
         refresh, offsets_fn, exch = solver._fused_sharded_ctx(fused)
 
         def block(u, t):
