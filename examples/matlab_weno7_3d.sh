@@ -1,0 +1,11 @@
+#!/bin/sh
+# Matlab_Prototipes/InviscidBurgersNd/LFWENO7FDM3d.m: 100^3 cells on
+# [-1,1]^3, CFL=0.4, tEnd=0.4, burgers flux, gaussian IC exp(-r^2/0.1)
+# (the CLI's `gaussian` default), real adaptive dt (the MATLAB
+# prototypes never hard-code max|u|). Order 7 engages the halo-4 fused
+# stepper. The reference never ported WENO7 off MATLAB, so there is no
+# run.sh to mirror — this maps the .m driver itself.
+python -m multigpu_advectiondiffusion_tpu.cli burgers3d \
+    --weno-order 7 --t-end 0.4 --cfl 0.4 --lengths 2 2 2 \
+    --n 100 100 100 --impl pallas \
+    --save out/matlab_weno7_3d "$@"

@@ -53,6 +53,11 @@ def _run_script(name, extra, timeout=600):
         ("singlegpu_diffusion2d.sh",
          ["--n", "48", "48", "--iters", "5",
           "--save", "out/_ex_s2"], (48, 48)),
+        # the MATLAB WENO7 driver analog (halo-4 fused stepper,
+        # adaptive dt)
+        ("matlab_weno7_3d.sh",
+         ["--n", "24", "16", "16", "--t-end", "0.05",
+          "--save", "out/_ex_w7"], (16, 16, 24)),
     ],
 )
 def test_example_script_runs(tmp_path, script, extra, result_shape):
