@@ -10,9 +10,12 @@ become full-slab vector slices.
 
 The kernel consumes an array *pre-padded by 3 along the sweep axis* (BC
 ghosts or ppermute halo attached by the caller), so one kernel serves
-single-device and sharded execution. The WENO math itself is shared with
-the XLA path (``ops.weno._weno5_minus/_weno5_plus``) — one source of
-truth for the stencil algebra.
+single-device and sharded execution. The WENO5 stencil algebra is the
+fused kernels' difference form (``ops.weno._weno5_side_nd[_e]``, the
+same functions the fused steppers trace — equivalent to the XLA path's
+``_weno5_minus/_weno5_plus`` up to the documented few-ulp FMA bound);
+WENO7 keeps the XLA path's full-range q-form (``_weno7_minus/_plus``)
+— see :func:`_face_flux` for the range argument.
 """
 
 from __future__ import annotations
@@ -81,31 +84,58 @@ def _face_flux(window, axis, n_faces, flux, variant, order):
 
     Used only for the *leading* (untiled) axis, whose slices are free
     row selections; tiled-axis sweeps go through :func:`_div_windowed`
-    instead."""
+    instead.
+
+    WENO5 reconstruction runs in the fused kernels' forward-difference
+    form (``fused_burgers._div_z`` generalized to any free axis):
+    shared first-difference/curvature windows, single-division weights,
+    Newton reciprocals (range bound ~3e4 split-flux jumps — harmless).
+    WENO7 deliberately keeps the classical q-form: the single-division
+    order-7 weights raise betas to the 6th power, which bounds valid
+    split-flux jumps to ~3.6 (``ops.weno._weno7_side_nd_e``) — fine
+    inside the fused steppers, whose bounded solver states they serve,
+    but this per-axis op is a general-purpose primitive that must
+    accept arbitrary data (the suite feeds it random-normal fields)."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+        _recip,
+        _split,
+    )
     from multigpu_advectiondiffusion_tpu.ops.weno import (
-        _weno5_minus,
-        _weno5_plus,
+        _curv,
+        _weno5_side_nd,
         _weno7_minus,
         _weno7_plus,
     )
 
-    a = jnp.abs(flux.df(window))
-    fu = flux.f(window)
-    vp = 0.5 * (fu + a * window)
-    vm = 0.5 * (fu - a * window)
+    vp, vm = _split(flux, window)
+    r = _halo(order)
 
-    def shifts(arr, lo):
-        out = []
-        for j in range(order):
-            idx = [slice(None)] * arr.ndim
-            idx[axis] = slice(lo + j, lo + j + n_faces)
-            out.append(arr[tuple(idx)])
-        return out
+    def sl(arr, lo, ln=n_faces):
+        idx = [slice(None)] * arr.ndim
+        idx[axis] = slice(lo, lo + ln)
+        return arr[tuple(idx)]
 
     if order == 7:
-        return _weno7_minus(shifts(vp, 0)) + _weno7_plus(shifts(vm, 1))
-    return _weno5_minus(*shifts(vp, 0), variant) + _weno5_plus(
-        *shifts(vm, 1), variant
+        return _weno7_minus([sl(vp, j) for j in range(7)]) + _weno7_plus(
+            [sl(vm, j + 1) for j in range(7)]
+        )
+    ne = window.shape[axis] - 1
+    ep = sl(vp, 1, ne) - sl(vp, 0, ne)
+    em = sl(vm, 1, ne) - sl(vm, 0, ne)
+    cp = _curv(sl(ep, 1, ne - 1) - sl(ep, 0, ne - 1))
+    cm = _curv(sl(em, 1, ne - 1) - sl(em, 0, ne - 1))
+    nm, dm = _weno5_side_nd(
+        *(sl(ep, j) for j in range(4)),
+        *(sl(cp, j) for j in range(3)),
+        variant, "minus",
+    )
+    np_, dp = _weno5_side_nd(
+        *(sl(em, j + 1) for j in range(4)),
+        *(sl(cm, j + 1) for j in range(3)),
+        variant, "plus",
+    )
+    return (sl(vp, r - 1) + sl(vm, r)) + (
+        nm * _recip(dm) + np_ * _recip(dp)
     )
 
 
@@ -113,7 +143,9 @@ def _div_windowed(window, axis, n, flux, variant, inv_dx, order):
     """Divergence over a slab padded by the order's halo on a *tiled*
     sweep axis, via whole-array circular rolls
     (:func:`fused_burgers._div_roll` for WENO5; the same construction
-    with the 7-point reconstructions for WENO7).
+    with the full-range q-form reconstructions for WENO7 — see
+    :func:`_face_flux` for why order 7 must not use the range-bounded
+    e-form here).
 
     On the VPU a tiled-axis window slice lowers to a per-operand
     realignment through the same shift unit a roll uses once — the
