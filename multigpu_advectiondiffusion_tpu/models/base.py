@@ -20,7 +20,6 @@ overlap by hand with five CUDA streams, ``main.c:189-303``).
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Callable, Optional, Sequence, Tuple
 
 import jax

@@ -20,7 +20,6 @@ WENO7 keeps the XLA path's full-range q-form (``_weno7_minus/_plus``)
 
 from __future__ import annotations
 
-from typing import Sequence
 
 import jax
 import jax.numpy as jnp

@@ -3,7 +3,6 @@ test suite (SURVEY §4: ``Matlab_Prototipes/DiffusionNd/TestingAccuracy.m``,
 ``diffusion{1,2,3}dTest.m``), plus IC/exact-solution consistency checks.
 """
 
-import math
 
 import jax.numpy as jnp
 import numpy as np

@@ -9,7 +9,6 @@ environment, which must not leak into this process's backend.
 
 import os
 import subprocess
-import sys
 
 import numpy as np
 import pytest
