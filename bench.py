@@ -24,8 +24,9 @@ from __future__ import annotations
 import json
 
 def _cases(on_tpu: bool):
-    """(metric, make_solver, iters, baseline) rows. CPU mode shrinks the
-    grids — it validates mechanics only (Pallas runs interpreted there)."""
+    """(metric, make_solver, mode, work, baseline, expected) rows. CPU
+    mode shrinks the grids — it validates mechanics only (Pallas runs
+    interpreted there)."""
     # Reference baselines in stage-update MLUPS — single source of truth
     # is bench/matrix.py BASELINES_MLUPS (derivations in BASELINE.md).
     # Imported here so main() can set the platform before any jax import.
@@ -153,9 +154,11 @@ def _cases(on_tpu: bool):
         # The literal MultiGPU interior (400x200x206, same grid as
         # diff3d_ref_grid) in the reference's own precision (USE_FLOAT
         # false, DiffusionMPICUDA.h:66) — the apples-to-apples row
-        # against its 731 MLUPS. XLA path: the Pallas DMA tiling is
-        # f32-calibrated (bench/matrix.py resolve_impl). Runs under a
-        # scoped jax.enable_x64 (see main()).
+        # against its 731 MLUPS. Since the slab-run round this rides the
+        # fused 3-D path through the f64-storage/f32-compute convention
+        # (state at f64, kernels f32 — Mosaic has no f64 vector path;
+        # accuracy priced in PARITY.md) instead of falling to
+        # generic-xla. Runs under a scoped enable_x64 (see main()).
         g = (
             Grid.make(400, 200, 206, lengths=(10.0, 5.0, 5.15))
             if on_tpu
@@ -163,7 +166,7 @@ def _cases(on_tpu: bool):
         )
         return DiffusionSolver(
             DiffusionConfig(grid=g, diffusivity=1.0, dtype="float64",
-                            impl="xla")
+                            impl="pallas")
         )
 
     def burg2d_weno7():
@@ -210,55 +213,67 @@ def _cases(on_tpu: bool):
         )
 
     it = (lambda n: n) if on_tpu else (lambda n: min(n, 4))
-    # rows: (metric, make_solver, mode, work, baseline) where mode is
-    # "iters" (fixed-count run) or "t_end" (the drivers' native
-    # `while t < tEnd` loop; work = equivalent fixed-dt step count)
+    # rows: (metric, make_solver, mode, work, baseline, expected) where
+    # mode is "iters" (fixed-count run) or "t_end" (the drivers' native
+    # `while t < tEnd` loop; work = equivalent fixed-dt step count) and
+    # expected is the set of stepper labels this config may legitimately
+    # engage (grids/VMEM budgets differ between CPU smoke mode and TPU,
+    # so slab-vs-stage may flip; a silent fall to generic-xla or
+    # per-axis-pallas is NEVER legitimate for a fused row and fails the
+    # run loudly — the engagement guard, see main()).
+    SLAB_OR_STAGE = {"fused-whole-run-slab", "fused-stage"}
     return [
         # ~1 s windows for the 3-D diffusion rows: at ~0.5 s the captured
         # headline sat 15-18% below repeated local runs on tunnel-shared
         # HBM (r3 artifact vs ROUND3.md) — the longer window narrows the
         # band the driver can land in
-        ("diffusion3d_mlups", diff3d_tiled, "iters", it(1010), B_DIFF3D),
+        ("diffusion3d_mlups", diff3d_tiled, "iters", it(1010), B_DIFF3D,
+         SLAB_OR_STAGE),
         ("diffusion3d_ref_grid_mlups", diff3d_ref_grid, "iters", it(606),
-         B_DIFF3D),
+         B_DIFF3D, SLAB_OR_STAGE),
         # 20000 iters (~500 ms): the whole-run VMEM stepper finishes 2000
         # in ~50 ms, inside the tunnel's sync-overhead noise band
         # (measured 44k-112k MLUPS run to run at 6000); the window must
         # dwarf the per-call sync jitter for the median to be stable
-        ("diffusion2d_mlups", diff2d, "iters", it(20000), B_DIFF2D),
+        ("diffusion2d_mlups", diff2d, "iters", it(20000), B_DIFF2D,
+         {"fused-whole-run"}),
         # 60 iters (~2.7 s window): at 20 the per-call dispatch overhead
         # still shaved ~1% off the steady-state rate
-        ("burgers3d_mlups", burg3d(False), "iters", it(60), B_BURG3D),
-        ("burgers3d_adaptive_mlups", burg3d(True), "iters", it(60), B_BURG3D),
+        ("burgers3d_mlups", burg3d(False), "iters", it(60), B_BURG3D,
+         SLAB_OR_STAGE),
+        ("burgers3d_adaptive_mlups", burg3d(True), "iters", it(60), B_BURG3D,
+         {"fused-stage"}),
         # the drivers' native t_end mode must run at the fused rate
         # (VERDICT r2 item 1) — captured, not claimed
-        ("burgers3d_tend_mlups", burg3d(False), "t_end", it(60), B_BURG3D),
+        ("burgers3d_tend_mlups", burg3d(False), "t_end", it(60), B_BURG3D,
+         {"fused-stage"}),
         ("burgers3d_slab_mlups", burg3d_grid(1601, 986, 35), "iters",
-         it(60), BASELINES_MLUPS["burgers3d_slab"][0]),
+         it(60), BASELINES_MLUPS["burgers3d_slab"][0], SLAB_OR_STAGE),
         ("burgers3d_wide_mlups", burg3d_grid(1000, 1000, 200), "iters",
-         it(30), BASELINES_MLUPS["burgers3d_wide"][0]),
+         it(30), BASELINES_MLUPS["burgers3d_wide"][0], SLAB_OR_STAGE),
         # 24000 iters: the 2-D whole-run stepper clears ~30k MLUPS, so
         # the 600-iter window was ~10 ms — pure sync-jitter; ~400 ms
         # makes the median trustworthy
-        ("burgers2d_mlups", burg2d, "iters", it(24000), B_BURG2D),
+        ("burgers2d_mlups", burg2d, "iters", it(24000), B_BURG2D,
+         {"fused-whole-run"}),
         # the reference's MultiGPU 3-D Burgers headline workload — the
         # last published config not driver-captured
         ("burgers3d_multigpu_mlups", burg3d_multigpu, "iters", it(60),
-         BASELINES_MLUPS["burgers3d_multigpu"][0]),
+         BASELINES_MLUPS["burgers3d_multigpu"][0], SLAB_OR_STAGE),
         # the reference's own precision (f64) on its literal grid, and
         # the per-axis ladder rung — previously measured but living only
         # in PARITY/README prose (VERDICT r3 item 3b): now driver-captured
         ("diffusion3d_f64_mlups", diff3d_f64, "iters", it(31),
-         BASELINES_MLUPS["diffusion3d_multigpu_f64"][0]),
+         BASELINES_MLUPS["diffusion3d_multigpu_f64"][0], SLAB_OR_STAGE),
         ("burgers3d_axis_mlups", burg3d_axis, "iters", it(15),
-         BASELINES_MLUPS["burgers3d_512_axis"][0]),
+         BASELINES_MLUPS["burgers3d_512_axis"][0], {"per-axis-pallas"}),
         # ~30 iters x 3 stages at ~4.7k MLUPS => ~2.5 s window
         ("burgers3d_weno7_mlups", burg3d_weno7, "iters", it(30),
-         BASELINES_MLUPS["burgers3d_512_weno7"][0]),
+         BASELINES_MLUPS["burgers3d_512_weno7"][0], SLAB_OR_STAGE),
         # 12000 iters (~0.9 s at ~6.2k MLUPS): the 2-D window rule —
         # whole-run calls must dwarf the per-call sync jitter
         ("burgers2d_weno7_mlups", burg2d_weno7, "iters", it(12000),
-         BASELINES_MLUPS["burgers2d_weno7"][0]),
+         BASELINES_MLUPS["burgers2d_weno7"][0], {"fused-whole-run"}),
     ]
 
 
@@ -277,11 +292,15 @@ def main() -> None:
     from multigpu_advectiondiffusion_tpu.timestepping.integrators import STAGES
     from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
 
+    from jax.experimental import enable_x64
+
     on_tpu = jax.default_backend() != "cpu"
-    for metric, make_solver, mode, work, baseline in _cases(on_tpu):
-        # x64 scoped per row: a process-wide flip would poison the f32
-        # Pallas rows' Mosaic lowering with i64 constants
-        with jax.enable_x64(metric.endswith("_f64_mlups")):
+    mismatches = []
+    for metric, make_solver, mode, work, baseline, expect in _cases(on_tpu):
+        # x64 scoped per row (jax.experimental.enable_x64 — the
+        # top-level alias was removed): a process-wide flip would poison
+        # the f32 Pallas rows' Mosaic lowering with i64 constants
+        with enable_x64(metric.endswith("_f64_mlups")):
             solver = make_solver()
             state = solver.initial_state()
             if mode == "t_end":
@@ -309,23 +328,28 @@ def main() -> None:
         engaged = solver.engaged_path(
             "t_end" if mode == "t_end" else "iters"
         )
-        print(
-            json.dumps(
-                {
-                    "metric": metric,
-                    "value": round(rate, 2),
-                    "unit": "MLUPS",
-                    "vs_baseline": round(rate / baseline, 3),
-                    "spread": round(timing.spread, 4),
-                    "outliers": timing.outliers,
-                    # pre-filter dispersion incl. discarded stalls, so
-                    # the artifact keeps the full evidence (ADVICE r4)
-                    "raw_spread": round(timing.raw_spread, 4),
-                    "engaged": engaged["stepper"],
-                }
-            ),
-            flush=True,
-        )
+        row = {
+            "metric": metric,
+            "value": round(rate, 2),
+            "unit": "MLUPS",
+            "vs_baseline": round(rate / baseline, 3),
+            "spread": round(timing.spread, 4),
+            "outliers": timing.outliers,
+            # pre-filter dispersion incl. discarded stalls, so
+            # the artifact keeps the full evidence (ADVICE r4)
+            "raw_spread": round(timing.raw_spread, 4),
+            "engaged": engaged["stepper"],
+        }
+        # engagement guard: a row running on an unexpected (slower)
+        # stepper is recorded AND fails the run — a silent fallback to
+        # generic-xla/per-axis-pallas must not just publish a slow rate
+        if engaged["stepper"] not in expect:
+            row["engagement_error"] = {
+                "expected": sorted(expect),
+                "fallback": engaged["fallback"],
+            }
+            mismatches.append(metric)
+        print(json.dumps(row), flush=True)
 
     # Multi-chip strong-scaling rows: engage automatically whenever the
     # live topology has > 1 device (the reference's headline artifact is
@@ -337,6 +361,12 @@ def main() -> None:
 
     for row in scaling_rows(on_tpu=on_tpu):
         print(json.dumps(row), flush=True)
+
+    if mismatches:
+        raise SystemExit(
+            "engagement guard: unexpected stepper for "
+            + ", ".join(mismatches)
+        )
 
 
 if __name__ == "__main__":

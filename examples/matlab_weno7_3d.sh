@@ -4,7 +4,13 @@
 # (the CLI's `gaussian` default), real adaptive dt (the MATLAB
 # prototypes never hard-code max|u|). Order 7 engages the halo-4 fused
 # stepper. The reference never ported WENO7 off MATLAB, so there is no
-# run.sh to mirror — this maps the .m driver itself.
+# run.sh to mirror — this maps the .m driver itself, with one
+# DELIBERATE deviation: LFWENO7FDM3d.m integrates with a 5-stage
+# low-storage RK4 (rk4a/rk4b), while this config runs the framework's
+# SSP-RK3 (the only integrator the fused steppers serve). Space
+# discretization and dt rule are the prototype's; trajectories agree to
+# the integrators' order, not bit-for-bit (recorded like the other
+# known deviations in PARITY.md).
 python -m multigpu_advectiondiffusion_tpu.cli burgers3d \
     --weno-order 7 --t-end 0.4 --cfl 0.4 --lengths 2 2 2 \
     --n 100 100 100 --impl pallas \

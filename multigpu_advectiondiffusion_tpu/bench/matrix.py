@@ -107,9 +107,19 @@ CASES = [
 
 def resolve_impl(case: BenchCase, dtype: str) -> str:
     """Kernel strategy actually benchmarked: the Pallas rungs' DMA tiling
-    is f32-calibrated, other dtypes run XLA. One definition — the JSON
-    'impl' field and the constructed solver must never diverge."""
-    return case.impl if dtype == "float32" else "xla"
+    is f32-calibrated, so non-f32 dtypes run XLA — EXCEPT 3-D diffusion
+    f64, which rides the fused f32 kernels through the
+    f64-storage/f32-compute convention (the solver's own eligibility
+    gate; non-eligible configs still land on the generic path and the
+    'engaged' field says so). One definition — the JSON 'impl' field and
+    the constructed solver must never diverge."""
+    if dtype == "float32":
+        return case.impl
+    if dtype == "float64" and case.kind == "diffusion" and len(
+        case.grid_xyz
+    ) == 3:
+        return case.impl
+    return "xla"
 
 
 def build_solver(case: BenchCase, dtype: str, grid_xyz, mesh_spec: Optional[str]):
@@ -185,6 +195,12 @@ def run_case(
         "iters": iters,
         "dtype": dtype,
         "impl": resolve_impl(case, dtype),
+        # which stepper rung actually executed (fused-whole-run-slab /
+        # fused-whole-run / fused-stage / ... / generic-xla) — a row
+        # that silently fell off the fused ladder is visible in the
+        # artifact, not just slow (bench.py's engagement guard is the
+        # hard-failing counterpart for the headline rows)
+        "engaged": solver.engaged_path()["stepper"],
         "seconds": round(best, 4),
         "compile_seconds": round(compile_s, 3),
         "mlups": round(rate, 1),
@@ -225,15 +241,17 @@ def main(argv=None):
         raise SystemExit(
             f"no case {args.name!r}; have {[c.name for c in CASES]}"
         )
-    import jax
+    from jax.experimental import enable_x64
 
     lines = []
     for case in cases:
-        # x64 scoped per case: a process-wide flip would poison the f32
-        # Pallas rows' Mosaic lowering with i64 constants. The resolved
-        # dtype is passed down so the scope and the solver can't diverge.
+        # x64 scoped per case (jax.experimental.enable_x64 — the
+        # top-level alias was removed): a process-wide flip would poison
+        # the f32 Pallas rows' Mosaic lowering with i64 constants. The
+        # resolved dtype is passed down so the scope and the solver
+        # can't diverge.
         dtype = args.dtype or case.dtype
-        with jax.enable_x64(dtype == "float64"):
+        with enable_x64(dtype == "float64"):
             res = run_case(case, dtype=dtype, quick=args.quick,
                            mesh_spec=args.mesh, repeats=args.repeats)
         line = json.dumps(res)

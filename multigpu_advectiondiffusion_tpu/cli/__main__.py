@@ -88,15 +88,18 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "solve into DIR (TensorBoard/Perfetto viewable) — "
                         "the nvprof wrapping of profile.sh, TPU-style")
     p.add_argument("--impl", default="xla",
-                   choices=["xla", "pallas", "pallas_axis", "pallas_step"],
+                   choices=["xla", "pallas", "pallas_axis", "pallas_step",
+                            "pallas_slab", "pallas_stage"],
                    help="kernel strategy (pallas = best available: fused/"
                         "VMEM-slab TPU kernels where eligible, XLA "
                         "otherwise — incl. for WENO7 and non-f32 dtypes, "
                         "where XLA measures faster / Pallas has no "
-                        "lowering; pallas_axis = pin the per-axis slab "
-                        "kernels; pallas_step = whole-step temporal "
-                        "blocking; the summary's 'kernel path' line "
-                        "reports what actually ran)")
+                        "lowering; pallas_slab = pin the 3-D whole-run "
+                        "slab stepper; pallas_stage = pin the 3-D "
+                        "per-stage stepper; pallas_axis = pin the "
+                        "per-axis slab kernels; pallas_step = whole-step "
+                        "temporal blocking; the summary's 'kernel path' "
+                        "line reports what actually ran)")
     p.add_argument("--overlap", default="padded",
                    choices=["padded", "split"],
                    help="sharded halo schedule: 'padded' exchanges before "

@@ -146,7 +146,19 @@ class SolverBase:
         params = {**defaults, **dict(self.cfg.ic_params)}
         u0 = initial_condition(name, self.grid, dtype=self.dtype, **params)
         if self.mesh is not None:
-            u0 = jax.device_put(u0, self.sharding())
+            sharding = self.sharding()
+            if jax.process_count() > 1:
+                # multi-process: device_put onto a global sharding runs a
+                # consistency collective some backends (CPU) can't host —
+                # assemble the global array from each process's
+                # addressable shards instead (the IC is computed globally
+                # on every host, as the reference computes its IC on
+                # every rank, main.c:112-130)
+                u0 = jax.make_array_from_callback(
+                    u0.shape, sharding, lambda idx: u0[idx]
+                )
+            else:
+                u0 = jax.device_put(u0, sharding)
         t0 = t if t is not None else getattr(self.cfg, "t0", 0.0)
         return SolverState.create(u0, t=t0)
 
@@ -188,17 +200,23 @@ class SolverBase:
     # ------------------------------------------------------------------ #
     # Execution: wrap a (u, t) -> (u, t) block program for this world
     # ------------------------------------------------------------------ #
-    def _wrap(self, fn, n_out_scalars: int = 1, n_in_scalars: int = 1):
+    def _wrap(self, fn, n_out_scalars: int = 1, n_in_scalars: int = 1,
+              check: bool | None = None):
         """Jit a block program ``(u, *scalars) -> (u, *scalars)``;
         sharded, the field follows the decomposition spec and scalars
         are replicated.
 
         The replication/vma checker stays on except for Pallas-flavored
-        configs, whose ``pallas_call`` outputs carry no vma typing."""
+        configs (whose ``pallas_call`` outputs carry no vma typing) and
+        blocks that force ``check=False`` — jax ships no replication
+        rule for ``lax.while_loop``, so the generic ``advance_to`` loop
+        cannot be checked on any impl."""
         from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
 
         if self.mesh is None:
             return jax.jit(fn)
+        if check is None:
+            check = not is_pallas_impl(getattr(self.cfg, "impl", ""))
         spec = self.decomp.partition_spec(self.grid.ndim)
         return jax.jit(
             shard_map(
@@ -206,7 +224,7 @@ class SolverBase:
                 mesh=self.mesh,
                 in_specs=(spec,) + (P(),) * n_in_scalars,
                 out_specs=(spec,) + (P(),) * n_out_scalars,
-                check=not is_pallas_impl(getattr(self.cfg, "impl", "")),
+                check=check,
             )
         )
 
@@ -223,9 +241,15 @@ class SolverBase:
         u, t = f(state.u, state.t)
         return SolverState(u=u, t=t, it=state.it + 1)
 
-    def _fused_stepper(self):
+    def _fused_stepper(self, mode: str = "iters"):
         """Solver-specific fully-fused fast path, or ``None`` (generic).
-        Overridden by solvers that have a fused Pallas stepper."""
+        Overridden by solvers that have a fused Pallas stepper.
+
+        ``mode`` mirrors the execution dispatch: the whole-run slab
+        stepper has no ``run_to`` (its grid bakes the step count), so
+        ``advance_to`` asks for the ``"t_end"`` selection and gets the
+        per-stage stepper instead of a dead-end slab instance."""
+        del mode
         return None
 
     def _decline(self, reason: str):
@@ -273,7 +297,7 @@ class SolverBase:
         )
 
         impl = getattr(self.cfg, "impl", "xla")
-        fused = self._fused_stepper()
+        fused = self._fused_stepper(mode="t_end" if mode == "t_end" else "iters")
         if fused is not None and mode == "t_end" and not hasattr(
             fused, "run_to"
         ):
@@ -472,7 +496,7 @@ class SolverBase:
         stepper's speed — the reference Burgers drivers' *only* execution
         mode is ``while (t < tEnd)`` over the tuned kernels
         (``MultiGPU/Burgers3d_Baseline/main.c:190-317``)."""
-        fused = self._fused_stepper()
+        fused = self._fused_stepper(mode="t_end")
         if fused is not None and hasattr(fused, "run_to"):
             refresh, offsets_fn, exch = self._fused_sharded_ctx(fused)
 
@@ -500,7 +524,9 @@ class SolverBase:
 
             return lax.while_loop(cond, body, (u, t, jnp.zeros((), jnp.int32)))
 
-        f = self._compiled("adv", lambda: self._wrap(block, 2, 2))
+        # check=False: no vma/replication rule exists for while_loop
+        f = self._compiled("adv", lambda: self._wrap(block, 2, 2,
+                                                     check=False))
         u, t, steps = f(
             state.u, state.t, jnp.asarray(t_end, state.t.dtype)
         )

@@ -149,7 +149,7 @@ class BurgersSolver(SolverBase):
     # ------------------------------------------------------------------ #
     # Fully-fused Pallas fast path (single chip, fixed dt, edge BCs)
     # ------------------------------------------------------------------ #
-    def _fused_stepper(self):
+    def _fused_stepper(self, mode: str = "iters"):
         """The fused SSP-RK3 stepper when this config is eligible, else
         ``None``. Eligibility mirrors the kernels' assumptions: 2-D/3-D
         cartesian WENO5-JS/Z or WENO7-JS, edge ghosts, f32. The 3-D per-stage kernel
@@ -162,7 +162,16 @@ class BurgersSolver(SolverBase):
         the single-chip path is the whole-run VMEM stepper (adaptive dt
         via an in-core reduction per step); under a mesh the per-stage
         whole-shard kernels take over with the same ghost-refresh
-        choreography (``MultiGPU/Burgers2d_Baseline/main.c:186+``)."""
+        choreography (``MultiGPU/Burgers2d_Baseline/main.c:186+``).
+
+        3-D *fixed-dt* ``impl='pallas'`` prefers the slab-pipelined
+        whole-run stepper where its model says it wins (the WENO stages
+        are VPU-bound, so the redundant-recompute tax usually loses at
+        depth — the model mostly keeps the per-stage path on large
+        grids); ``impl='pallas_slab'`` pins it, ``'pallas_stage'`` pins
+        per-stage. Adaptive dt needs a between-step global reduction the
+        whole-run grid cannot host, and ``mode="t_end"`` needs run_to —
+        both keep the per-stage stepper."""
         import jax.numpy as jnp
 
         from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
@@ -253,6 +262,9 @@ class BurgersSolver(SolverBase):
                 return self._decline(
                     "2-D shard exceeds the per-stage VMEM budget"
                 )
+        slab = self._select_slab(mode, lshape)
+        if slab is not None:
+            return slab
         if "fused" not in self._cache:
             spacing = self.grid.spacing
             kwargs = {}
@@ -316,3 +328,46 @@ class BurgersSolver(SolverBase):
                     cfg.weno_variant, cfg.nu, **kwargs,
                 )
         return self._cache["fused"]
+
+    def _select_slab(self, mode, lshape):
+        """The slab-pipelined whole-run stepper when this fixed-dt 3-D
+        config should engage it, else ``None`` (per-stage selection
+        proceeds). Shared eligibility (orders, BCs, dtype, halo checks)
+        has already passed when this runs."""
+        cfg = self.cfg
+        pinned = cfg.impl == "pallas_slab"
+        if self.grid.ndim != 3 or cfg.impl not in ("pallas", "pallas_slab"):
+            return None
+        if mode == "t_end" or cfg.adaptive_dt:
+            # no run_to, and adaptive dt needs the between-step global
+            # reduction only the per-stage loop hosts
+            return None
+        from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
+            SlabRunBurgersStepper as slab_cls,
+        )
+
+        if self.mesh is not None:
+            if not pinned:
+                return None
+            if any(ax != 0 for ax in self._sharded_axes()):
+                return None
+        if not slab_cls.supported(lshape, self.dtype, order=cfg.weno_order):
+            return None
+        if not pinned and not slab_cls.profitable(
+            lshape, self.dtype, order=cfg.weno_order
+        ):
+            return None
+        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+        if self.mesh is not None and lshape[0] < 3 * HALO[cfg.weno_order]:
+            return None  # shard too thin to serve the G-deep exchange
+        if "fused_slab" not in self._cache:
+            kwargs = {"order": cfg.weno_order}
+            if self.mesh is not None:
+                kwargs["global_shape"] = self.grid.shape
+                kwargs["overlap_split"] = self._split_overlap_requested()
+            self._cache["fused_slab"] = slab_cls(
+                lshape, self.dtype, self.grid.spacing, self.flux,
+                cfg.weno_variant, cfg.nu, dt=self.dt, **kwargs,
+            )
+        return self._cache["fused_slab"]

@@ -199,17 +199,28 @@ class DiffusionSolver(SolverBase):
     # Fully-fused Pallas fast path (single-chip or shard-local under a
     # mesh; reference-parity walls)
     # ------------------------------------------------------------------ #
-    def _fused_stepper(self):
+    def _fused_stepper(self, mode: str = "iters"):
         """The fused SSP-RK3 stepper when this config is eligible, else
         ``None`` (generic path). Eligibility mirrors the assumptions the
         kernel bakes in: frozen Dirichlet ghosts/boundary band, static dt,
-        2-D/3-D cartesian O4, f32. Under a mesh the per-stage kernels
-        (3-D z-slab grid; 2-D whole-shard) run shard-local — ghosts
-        ppermute-refreshed between stages, the tuned kernel under MPI
+        2-D/3-D cartesian O4, f32 (f64 states ride the f32 kernels
+        through the f64-storage/f32-compute convention, 3-D only). Under
+        a mesh the per-stage kernels (3-D z-slab grid; 2-D whole-shard)
+        run shard-local — ghosts ppermute-refreshed between stages, the
+        tuned kernel under MPI
         (``MultiGPU/Diffusion3d_Baseline/main.c:189-303``,
         ``Diffusion2d_Baseline/main.c:189-280``); the whole-step and
         whole-run variants stay single-chip (their temporal blocking
-        crosses the points where ghosts must refresh)."""
+        crosses the points where ghosts must refresh).
+
+        3-D ``impl='pallas'`` prefers the slab-pipelined whole-run
+        stepper (``fused-whole-run-slab``) where its VMEM/profitability
+        model says the one-HBM-round-trip-per-step schedule wins; it
+        declines cleanly to the per-stage ``fused-stage`` path
+        otherwise. ``impl='pallas_slab'`` pins the slab stepper (modulo
+        hard VMEM fit), ``'pallas_stage'`` pins per-stage. ``mode``:
+        the slab stepper has no ``run_to``, so the ``"t_end"`` selection
+        (advance_to) always takes per-stage."""
         cfg = self.cfg
         bcs = self.bcs
         from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
@@ -240,6 +251,10 @@ class DiffusionSolver(SolverBase):
             )
         if self.grid.ndim not in (2, 3):
             return self._decline("fused diffusion kernels are 2-D/3-D only")
+        # f64 states run the f32 kernels with f64 storage at the run
+        # boundary (Mosaic has no f64 vector path; accuracy is f32 —
+        # PARITY.md). Kernel buffers are f32 either way.
+        f64_storage = self.dtype == jnp.dtype("float64")
         if self.dtype == jnp.bfloat16:
             # bf16-storage/f32-compute rung: HBM bytes halved (the
             # ref-grid row is HBM-roof-bound) — 3-D per-stage only.
@@ -249,6 +264,16 @@ class DiffusionSolver(SolverBase):
             if self.grid.ndim != 3 or cfg.impl == "pallas_step":
                 return self._decline(
                     "bf16 storage exists only for the 3-D per-stage stepper"
+                )
+        elif f64_storage:
+            if (
+                self.grid.ndim != 3
+                or cfg.impl == "pallas_step"
+                or self.mesh is not None
+            ):
+                return self._decline(
+                    "f64 storage rides the 3-D fused steppers, "
+                    "single-chip only"
                 )
         elif self.dtype != jnp.float32:
             return self._decline("fused kernels are float32/bf16-storage only")
@@ -269,6 +294,10 @@ class DiffusionSolver(SolverBase):
                 return self._decline(
                     f"a sharded axis is thinner than the O4 halo ({R})"
                 )
+        kernel_dtype = jnp.float32 if f64_storage else self.dtype
+        slab = self._select_slab(mode, lshape, kernel_dtype, f64_storage)
+        if slab is not None:
+            return slab
         if "fused" not in self._cache:
             if self.grid.ndim == 3 and cfg.impl == "pallas_step":
                 from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion_step import (  # noqa: E501
@@ -306,9 +335,11 @@ class DiffusionSolver(SolverBase):
                 # schedule (they decline it themselves off-design)
                 kwargs["global_shape"] = self.grid.shape
                 kwargs["overlap_split"] = self._split_overlap_requested()
+            if f64_storage:
+                kwargs["storage_dtype"] = self.dtype
             self._cache["fused"] = cls(
                 lshape,
-                self.dtype,
+                kernel_dtype,
                 self.grid.spacing,
                 [cfg.diffusivity] * self.grid.ndim,
                 self.dt,
@@ -317,6 +348,63 @@ class DiffusionSolver(SolverBase):
                 **kwargs,
             )
         return self._cache["fused"]
+
+    def _select_slab(self, mode, lshape, kernel_dtype, f64_storage):
+        """The slab-pipelined whole-run stepper when this config should
+        engage it (the top rung of the 3-D ladder), else ``None`` and
+        the caller falls through to the per-stage selection. The
+        VMEM-budget block sizing and the traffic-vs-recompute
+        profitability model live in ``fused_slab_run``."""
+        cfg = self.cfg
+        pinned = cfg.impl == "pallas_slab"
+        if self.grid.ndim != 3 or cfg.impl not in ("pallas", "pallas_slab"):
+            return None
+        if mode == "t_end":
+            return None  # no run_to: advance_to keeps the per-stage path
+        if self.dtype == jnp.bfloat16:
+            return None  # bf16 storage rides the per-stage stepper
+        from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
+            SlabRunDiffusionStepper as slab_cls,
+        )
+
+        if self.mesh is not None:
+            # whole-run temporal blocking crosses ghost refreshes: under
+            # a mesh the slab stepper runs per-step calls with a G-deep
+            # z exchange per step — z-slab decompositions only, and a
+            # measured-unknown tradeoff vs per-stage, so it engages only
+            # when pinned
+            if not pinned:
+                return None
+            if any(ax != 0 for ax in self._sharded_axes()):
+                return None
+            if lshape[0] < slab_cls.halo:
+                return None
+        if not slab_cls.supported(
+            lshape, kernel_dtype, sharded=self.mesh is not None
+        ):
+            return None
+        if not pinned and not slab_cls.profitable(
+            lshape, kernel_dtype, sharded=self.mesh is not None
+        ):
+            return None
+        if "fused_slab" not in self._cache:
+            kwargs = {}
+            if self.mesh is not None:
+                kwargs["global_shape"] = self.grid.shape
+                kwargs["overlap_split"] = self._split_overlap_requested()
+            if f64_storage:
+                kwargs["storage_dtype"] = self.dtype
+            self._cache["fused_slab"] = slab_cls(
+                lshape,
+                kernel_dtype,
+                self.grid.spacing,
+                [cfg.diffusivity] * self.grid.ndim,
+                self.dt,
+                cfg.boundary_band,
+                self.bcs[0].value,
+                **kwargs,
+            )
+        return self._cache["fused_slab"]
 
     # ------------------------------------------------------------------ #
     # Analytic solution support (heat3d.m:36; heat2d_axisymmetric.m:39)

@@ -5,8 +5,11 @@ __all__ = ["flux", "laplacian", "weno", "stencils", "axisym"]
 
 def is_pallas_impl(impl: str) -> bool:
     """Whether a solver ``impl`` string selects a Pallas kernel flavor
-    ("pallas", "pallas_axis", "pallas_step", ...) — the single definition
-    both solvers' eligibility checks use."""
+    ("pallas", "pallas_axis", "pallas_step", "pallas_slab",
+    "pallas_stage", ...) — the single definition both solvers'
+    eligibility checks use. "pallas" promises best-available; the
+    suffixed flavors pin one rung of the stepper ladder (slab whole-run
+    / per-stage / whole-step / per-axis)."""
     return impl.startswith("pallas")
 
 

@@ -217,7 +217,7 @@ def _split(flux: Flux, v):
     return 0.5 * (fu + a * v), 0.5 * (fu - a * v)
 
 
-def _div_z(vp, vm, bz, by, inv_dx, variant, order=5, r=R):
+def _div_z(vp, vm, bz, by, inv_dx, variant, order=5, r=R, y0=MARGIN):
     """Flux divergence along z of the core box via slab row slices.
 
     Interface row ``s`` (0..bz) sits right of slab row ``r-1+s``; the
@@ -227,8 +227,12 @@ def _div_z(vp, vm, bz, by, inv_dx, variant, order=5, r=R):
     (``_curv``); order 7 uses the e-form per window (its betas are
     quadratic forms of the same shared first-difference arrays). Row
     slices of the leading axis are free.
+
+    ``y0``/``by`` select the output's y window (default: this module's
+    margin-carrying core); the slab whole-run stepper
+    (:mod:`fused_slab_run`) passes ``y0=0`` with the full padded width.
     """
-    yc = slice(MARGIN, MARGIN + by)
+    yc = slice(y0, y0 + by)
     p = vp[:, yc]
     m = vm[:, yc]
     ep = p[1:] - p[:-1]

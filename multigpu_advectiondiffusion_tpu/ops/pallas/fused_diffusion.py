@@ -413,12 +413,17 @@ class FusedDiffusionStepper(FusedStepperBase):
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value, block_z=None, global_shape=None,
-                 overlap_split: bool = False):
+                 overlap_split: bool = False, storage_dtype=None):
         nz, ny, nx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
         self.dtype = jnp.dtype(dtype)
+        # f64-storage/f32-compute rung: the *state* stays f64 between
+        # runs, the kernels (and every HBM-resident padded buffer) run
+        # ``dtype`` — embed downcasts, extract restores (Mosaic has no
+        # f64 vector path; accuracy is f32, priced in PARITY.md)
+        self._storage = jnp.dtype(storage_dtype or dtype)
         # bf16-storage rung: state/DMA at 2 B/cell (the ref-grid row is
         # measured at 85-92% of HBM pin bandwidth — bytes are the only
         # remaining lever, PARITY.md), arithmetic in f32
@@ -542,7 +547,8 @@ class FusedDiffusionStepper(FusedStepperBase):
 
     def extract(self, S):
         nz, ny, nx = self.interior_shape
-        return lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
+        out = lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
+        return out.astype(self._storage)
 
     def _dt_value(self, S):
         return jnp.asarray(self.dt, jnp.float32)

@@ -1,0 +1,830 @@
+"""Slab-pipelined whole-run SSP-RK3 stepping for 3-D (diffusion + Burgers).
+
+The 2-D solvers reach their 400-813x rates through the whole-run VMEM
+stepper (:mod:`whole_run`): state on-chip for the entire run, zero HBM
+traffic per step. A 3-D reference grid does not fit VMEM, so the 3-D
+fused path has been the per-stage stepper — three Pallas calls per step,
+each a full HBM round trip of the state (~9 array passes per step
+counting stage inputs, ``u`` reads and writes).
+
+This module is the 3-D rung between the two: ONE Pallas program whose
+grid is ``(timestep, z-slab)``. The TPU grid is a sequential loop, so the
+program streams z-slabs HBM->VMEM with double-buffered async copies,
+fuses all three RK stages of the step in VMEM while the next slab's DMA
+is in flight, and writes each slab's core back once — one HBM round trip
+per step (``1 + (bz + 2G)/bz`` array passes) instead of three.
+
+Slab independence comes from **redundant ghost-region recompute** (the
+reference's revolving-buffer idea, and the standard trapezoid rule of
+temporal blocking): each slab loads ``G = 3h`` extra rows per side
+(``h`` = per-stage stencil radius: 2 for the O4 Laplacian, 3/4 for
+WENO5/7), recomputes stage 1 on a ``bz + 4h``-row window and stage 2 on
+``bz + 2h``, so the stage-3 core needs nothing from neighboring slabs
+within the step. No slab ever reads another slab's output of the same
+step — which is what lets the whole step run inside one sequential grid
+with plain double-buffered DMA and no inter-slab synchronization.
+
+Step-level state ping-pong rides a single stacked ``(2,) + padded``
+buffer: step ``k`` reads ``buf[k % 2]`` and writes ``buf[1 - k % 2]``
+(slab ``j+1`` of step ``k`` still reads rows that slab ``j`` would
+overwrite in place). The buffer parity of the final state is
+``num_iters % 2``, known statically. Across the step boundary the
+prefetch of the next step's first slab reads rows this step already
+wrote; it is issued only when the write-drain schedule proves those
+writes have landed (``cross_ok``), else the first slab of each step
+loads synchronously.
+
+Redundant recompute is paid in VPU work: ``2h/bz`` extra rows per
+stage. The dispatch (``models/*._fused_stepper``) therefore engages
+this stepper only where the traffic saving can win — large-``bz`` slabs
+(HBM-bound diffusion) or grids whose z extent fits one or two slabs —
+and falls back to the per-stage ``fused-stage`` path otherwise;
+``impl='pallas_slab'`` pins it for measurement.
+
+Sharded mode (z-slab decomposition only, pinned): the whole-run grid
+cannot cross ghost refreshes, so each step runs as one slab-pipelined
+Pallas call per step under ``shard_map``, with a single ``G``-deep
+z-halo exchange per STEP (same bytes as the per-stage path's three
+``h``-deep exchanges, a third of the messages, and one kernel launch
+per step instead of three). With ``overlap='split'`` the step runs the
+familiar three-call schedule (interior slabs concurrent with the
+in-flight ``ppermute``; only the two edge slabs consume the exchanged
+``G``-deep slabs), mirroring :mod:`fused_diffusion`'s per-stage split.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multigpu_advectiondiffusion_tpu.ops.flux import Flux
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+    _div_roll,
+    _div_z,
+    _split,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+    _STAGES,
+    _shift,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion_step import (
+    _stage_rows,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    LANE,
+    O4_COEFFS,
+    R,
+    SUBLANE,
+    VMEM_LIMIT,
+    compiler_params,
+    interpret_mode,
+    round_up,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.whole_run import accumulate_t
+from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+# Conservative budget for the slab working set (the Mosaic scoped
+# ceiling is VMEM_LIMIT = 100 MiB; leave headroom for Mosaic's own
+# scheduling slack, as fused_burgers does).
+_VMEM_BUDGET = 72 * 1024 * 1024
+
+
+def _cross_ok(bz: int, G: int, n_slabs: int) -> bool:
+    """Whether the next step's first-slab prefetch may be issued at the
+    current step's last slab. The prefetch reads dst rows ``[0, bz+2G)``:
+    ghost rows (never written) plus the cores of slabs ``0..M``. At
+    prefetch time the drain schedule has waited writes through ``i-3``
+    (slab ``n_slabs-4``), so all read rows have landed iff
+    ``M <= n_slabs - 4`` — which also keeps the two still-in-flight
+    writes (slabs ``n_slabs-3``/``-2``) disjoint from the read."""
+    M = 1 + (G - 1) // bz
+    return M <= n_slabs - 4
+
+
+def _whole_run_kernel(s_in, ss, vs, res, sem_v, sem_w, *, step_fn, bz: int,
+                      G: int, n_slabs: int, n_iters: int, cross: bool):
+    """(timestep, z-slab) grid body; ``ss`` is the stacked (2, pz, Y, X)
+    state (output aliased onto the input — all access goes through the
+    out ref). ``step_fn(v, j) -> (bz, Y, X)`` fuses the three RK stages
+    of slab ``j`` on the ``(bz + 2G)``-row VMEM box ``v``."""
+    del s_in  # aliased with ss
+    # canonical i32 indices: interpret mode under x64 hands the two grid
+    # dimensions different integer widths
+    k = jnp.asarray(pl.program_id(0), jnp.int32)
+    j = jnp.asarray(pl.program_id(1), jnp.int32)
+    n = jnp.asarray(n_slabs, jnp.int32)
+    two = jnp.asarray(2, jnp.int32)
+    i = k * n + j
+    total = n_iters * n_slabs
+    slot = lax.rem(i, two)
+    nslot = lax.rem(i + 1, two)
+
+    def copy_in(kk, jj, s):
+        kk = jnp.asarray(kk, jnp.int32)  # literal 0s stay i32 under x64
+        jj = jnp.asarray(jj, jnp.int32)
+        return pltpu.make_async_copy(
+            ss.at[lax.rem(kk, two), pl.ds(jj * bz, bz + 2 * G)],
+            vs.at[s],
+            sem_v.at[s],
+        )
+
+    def copy_out(ii, s):
+        ii = jnp.asarray(ii, jnp.int32)
+        kk = lax.div(ii, n)
+        jj = lax.rem(ii, n)
+        return pltpu.make_async_copy(
+            res.at[s],
+            ss.at[1 - lax.rem(kk, two), pl.ds(G + jj * bz, bz)],
+            sem_w.at[s],
+        )
+
+    # ---- load schedule ----
+    if cross:
+        # steady 2-deep pipeline across step boundaries (see _cross_ok)
+        @pl.when(i == 0)
+        def _():
+            copy_in(0, 0, slot).start()
+
+        @pl.when(i + 1 < total)
+        def _():
+            wrap = j + 1 == n
+            kk = jnp.where(wrap, k + 1, k)
+            jj = jnp.where(wrap, jnp.asarray(0, jnp.int32), j + 1)
+            copy_in(kk, jj, nslot).start()
+
+    else:
+        # the next step's slab-0 read races this step's tail writes on
+        # thin slab counts: drain the outstanding writes of the previous
+        # step, then load slab 0 synchronously. With a single slab per
+        # step only one write is ever outstanding (the previous
+        # iteration drained i-2 as *its* i-1) — waiting it twice would
+        # hang the semaphore.
+        if n_slabs >= 2:
+            @pl.when((j == 0) & (i >= 2))
+            def _():
+                copy_out(i - 2, slot).wait()
+
+        @pl.when((j == 0) & (i >= 1))
+        def _():
+            copy_out(i - 1, nslot).wait()
+
+        @pl.when(j == 0)
+        def _():
+            copy_in(k, 0, slot).start()
+
+        @pl.when((i + 1 < total) & (j + 1 < n))
+        def _():
+            copy_in(k, j + 1, nslot).start()
+
+    copy_in(k, j, slot).wait()
+    out = step_fn(vs[slot], j)
+
+    # ---- write-drain schedule (invariant: writes <= i-3 have landed at
+    # iteration start; at j == 0 both outstanding writes are drained,
+    # at j >= 2 the slot's previous write) ----
+    if cross:
+        @pl.when((j == 0) & (i >= 2))
+        def _():
+            copy_out(i - 2, slot).wait()
+
+        @pl.when((j == 0) & (i >= 1))
+        def _():
+            copy_out(i - 1, nslot).wait()
+
+    @pl.when(j >= 2)
+    def _():
+        copy_out(i - 2, slot).wait()
+
+    res[slot] = out
+    copy_out(i, slot).start()
+
+    @pl.when(i == total - 1)
+    def _():
+        copy_out(i, slot).wait()
+        if n_slabs > 1:  # at the last iteration j >= 1, so i-1 is live
+            copy_out(i - 1, nslot).wait()
+
+
+def _step_call_kernel(*refs, step_fn, bz: int, G: int, n_slabs: int,
+                      kz_base: int, n_grid: int, ghost_src, sharded: bool):
+    """One sharded per-step call (grid = this call's slab range): reads
+    the padded state ``s_in``, writes the step result into a separate
+    ping-pong target (aliased out). Roles mirror the per-stage split
+    schedule: ``ghost_src`` = "lo"/"hi" DMAs the G-deep z-ghost rows
+    from the separately exchanged slab operand instead of the buffer
+    (whose z ghosts are stale in split mode)."""
+    offs = None
+    if sharded:
+        offs, *refs = refs
+    s_in, *refs = refs
+    g_hbm = None
+    if ghost_src is not None:
+        g_hbm, *refs = refs
+    _tgt, out, vs, res, sem_v, sem_w, *refs = refs
+    sem_g = refs[0] if refs else None
+
+    k = jnp.asarray(pl.program_id(0), jnp.int32)
+    slot = lax.rem(k, jnp.asarray(2, jnp.int32))
+    nslot = lax.rem(k + 1, jnp.asarray(2, jnp.int32))
+
+    def copy_in(kk, s):
+        z0 = (kk + kz_base) * bz
+        if ghost_src is None:
+            return [
+                pltpu.make_async_copy(
+                    s_in.at[pl.ds(z0, bz + 2 * G)], vs.at[s], sem_v.at[s]
+                )
+            ]
+        if ghost_src == "lo":
+            return [
+                pltpu.make_async_copy(
+                    g_hbm, vs.at[s, pl.ds(0, G)], sem_g.at[s]
+                ),
+                pltpu.make_async_copy(
+                    s_in.at[pl.ds(z0 + G, bz + G)],
+                    vs.at[s, pl.ds(G, bz + G)],
+                    sem_v.at[s],
+                ),
+            ]
+        return [
+            pltpu.make_async_copy(
+                s_in.at[pl.ds(z0, bz + G)],
+                vs.at[s, pl.ds(0, bz + G)],
+                sem_v.at[s],
+            ),
+            pltpu.make_async_copy(
+                g_hbm, vs.at[s, pl.ds(bz + G, G)], sem_g.at[s]
+            ),
+        ]
+
+    def copy_out(kk, s):
+        return pltpu.make_async_copy(
+            res.at[s],
+            out.at[pl.ds(G + (kk + kz_base) * bz, bz)],
+            sem_w.at[s],
+        )
+
+    @pl.when(k == 0)
+    def _():
+        for cp in copy_in(0, 0):
+            cp.start()
+
+    @pl.when(k + 1 < n_grid)
+    def _():
+        for cp in copy_in(k + 1, nslot):
+            cp.start()
+
+    for cp in copy_in(k, slot):
+        cp.wait()
+
+    oz = offs[0] if offs is not None else 0
+    out_rows = step_fn(vs[slot], k + kz_base, oz)
+
+    @pl.when(k >= 2)
+    def _():
+        copy_out(k - 2, slot).wait()
+
+    res[slot] = out_rows
+    copy_out(k, slot).start()
+
+    @pl.when(k == n_grid - 1)
+    def _():
+        copy_out(k, slot).wait()
+        if n_grid >= 2:
+            copy_out(k - 1, nslot).wait()
+
+
+class _SlabRunStepper:
+    """Shared driver for the two slab whole-run steppers.
+
+    Subclasses provide the layout (``padded_shape``, ``core_offsets``),
+    ``embed``/``extract``, and ``_step_fn(v, base_z) -> (bz, Y, X)``
+    (``base_z``: traced global z index of the box's first row). ``halo``
+    is the fused-step halo ``G`` — under a mesh the base class's ghost
+    machinery then exchanges G-deep slabs once per step."""
+
+    engaged_label = "fused-whole-run-slab"
+    needs_offsets = True  # global-coordinate masks / edge synthesis
+    overlap_split = False  # sharded split instances set True in __init__
+    # interface parity with the per-stage steppers (probed by callers):
+    # slab mode is fixed-dt only (no stage-emitted wave speed) and never
+    # runs the stored-x-ghost layout (z-slab decompositions only)
+    _emit_max = False
+    x_sharded = False
+
+    # populated by subclass __init__:
+    #   interior_shape, global_shape, sharded, overlap_split, halo (=G),
+    #   core_offsets, padded_shape, dtype (kernel), _storage, dt, bz,
+    #   n_slabs, _step_fn
+
+    def _scratch(self):
+        trailing = self.padded_shape[1:]
+        return [
+            pltpu.VMEM((2, self.bz + 2 * self.halo) + trailing, self.dtype),
+            pltpu.VMEM((2, self.bz) + trailing, self.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+
+    def _whole_run(self, P, num_iters: int):
+        G, bz, n_slabs = self.halo, self.bz, self.n_slabs
+        kern = functools.partial(
+            _whole_run_kernel,
+            step_fn=lambda v, j: self._step_fn(v, j * bz - G),
+            bz=bz, G=G, n_slabs=n_slabs, n_iters=num_iters,
+            cross=_cross_ok(bz, G, n_slabs),
+        )
+        SS = jnp.stack([P, P])
+        out = pl.pallas_call(
+            kern,
+            grid=(num_iters, n_slabs),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct(SS.shape, SS.dtype),
+            scratch_shapes=self._scratch(),
+            input_output_aliases={0: 0},
+            compiler_params=None if interpret_mode() else compiler_params(),
+            interpret=interpret_mode(),
+        )(SS)
+        return out[num_iters % 2]
+
+    def _make_step_call(self, role: str):
+        G, bz, n_slabs = self.halo, self.bz, self.n_slabs
+        if role == "full":
+            kz_base, n_grid, ghost_src = 0, n_slabs, None
+        elif role == "interior":
+            kz_base, n_grid, ghost_src = 1, n_slabs - 2, None
+        elif role == "bottom":
+            kz_base, n_grid, ghost_src = 0, 1, "lo"
+        elif role == "top":
+            kz_base, n_grid, ghost_src = n_slabs - 1, 1, "hi"
+        else:  # pragma: no cover - internal
+            raise ValueError(f"unknown role {role!r}")
+        use_g = ghost_src is not None
+
+        kern = functools.partial(
+            _step_call_kernel,
+            step_fn=lambda v, jj, oz: self._step_fn(v, jj * bz - G + oz),
+            bz=bz, G=G, n_slabs=n_slabs, kz_base=kz_base, n_grid=n_grid,
+            ghost_src=ghost_src, sharded=True,
+        )
+        n_in = 1 + 1 + (1 if use_g else 0) + 1  # offs, s_in, [g], tgt
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 1)
+        scratch = self._scratch()
+        if use_g:
+            scratch.append(pltpu.SemaphoreType.DMA((2,)))
+        return pl.pallas_call(
+            kern,
+            grid=(n_grid,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct(self.padded_shape, self.dtype),
+            scratch_shapes=scratch,
+            input_output_aliases={n_in - 1: 0},  # ping-pong target -> out
+            compiler_params=None if interpret_mode() else compiler_params(),
+            interpret=interpret_mode(),
+        )
+
+    def _build_sharded_calls(self):
+        if self.overlap_split:
+            self._calls = tuple(
+                self._make_step_call(r) for r in ("interior", "bottom", "top")
+            )
+        else:
+            self._calls = (self._make_step_call("full"),)
+
+    def run(self, u, t, num_iters: int, refresh=None, offsets=None,
+            exch=None):
+        """``num_iters`` fused steps; returns ``(u, t)``. Unsharded: one
+        whole-run Pallas program. Sharded (inside ``shard_map``): one
+        slab-pipelined call per step with a G-deep z-ghost ``refresh``
+        per step — or, in split mode, ``exch``'s exchanged G-slabs
+        consumed by the two edge calls while the interior call overlaps
+        the ppermute."""
+        if num_iters == 0:
+            return u, t
+        if not self.sharded:
+            S = self._whole_run(self.embed(u), num_iters)
+            return self.extract(S), accumulate_t(t, self.dt, num_iters)
+
+        if offsets is None:
+            raise ValueError("sharded slab stepper needs offsets")
+        if self.overlap_split:
+            if exch is None:
+                raise ValueError("split-overlap slab stepper needs exch")
+        elif refresh is None:
+            raise ValueError("sharded slab stepper needs a ghost refresh")
+
+        S = self.embed(u)
+        T = S
+        if self.overlap_split:
+            interior, bottom, top = self._calls
+
+            def body(it, carry):
+                S, T = carry
+                lo, hi = exch(S)
+                T = top(offsets, S, hi,
+                        bottom(offsets, S, lo, interior(offsets, S, T)))
+                return T, S
+
+        else:
+            (full,) = self._calls
+
+            def body(it, carry):
+                S, T = carry
+                S = refresh(S)
+                T = full(offsets, S, T)
+                return T, S
+
+        S, T = lax.fori_loop(0, num_iters, body, (S, T))
+        return self.extract(S), accumulate_t(t, self.dt, num_iters)
+
+
+# --------------------------------------------------------------------- #
+# Diffusion
+# --------------------------------------------------------------------- #
+
+_G_DIFF = 3 * R  # 6: three O4 stages of redundant recompute
+
+
+def _diff_row_bytes(interior_shape, itemsize: int) -> int:
+    ny, nx = interior_shape[1], interior_shape[2]
+    return (
+        round_up(ny + 2 * R, SUBLANE) * round_up(nx + 2 * R, LANE) * itemsize
+    )
+
+
+def _diff_budget_rows(row_bytes: int) -> int:
+    # the same calibrated shape as the whole-step stepper's picker (~8
+    # live row-sized buffers per block row + fixed overhead incl. the
+    # doubled slab/result slots), against the Mosaic scoped ceiling
+    return max(1, min(20, int((VMEM_LIMIT // row_bytes - 130) // 8)))
+
+
+def _split_block(nz: int, cap: int, G: int, viable) -> int | None:
+    """Largest viable divisor of ``nz`` that can host the three-call
+    split-overlap schedule: an interior band of >= 1 slab (n_slabs >= 3)
+    whose boxes never reach the stale ghost rows (bz >= G)."""
+    for b in range(min(cap, nz // 3), G - 1, -1):
+        if nz % b == 0 and viable(b):
+            return b
+    return None
+
+
+def _pick_bz_diffusion(nz: int, row_bytes: int, sharded: bool,
+                       G: int = _G_DIFF, want_split: bool = False):
+    cap = _diff_budget_rows(row_bytes)
+    if sharded:
+        if want_split:
+            b = _split_block(nz, cap, G, lambda b: True)
+            if b is not None:
+                return b
+        # exchanged cores forbid dead rows: largest divisor <= cap
+        for b in range(min(cap, nz), 0, -1):
+            if nz % b == 0:
+                return b
+        return 1
+    # unsharded: dead tail rows are legal — score the halo amortization
+    # bz/(bz+2G) against the wasted dead rows (as FusedDiffusionStepper)
+    def score(b):
+        blocks = -(-nz // b)
+        return (b / (b + 2 * G)) * (nz / (blocks * b))
+
+    return max(range(1, cap + 1), key=score)
+
+
+class SlabRunDiffusionStepper(_SlabRunStepper):
+    """Whole-run slab-pipelined diffusion stepper.
+
+    Constructor signature mirrors :class:`FusedDiffusionStepper` so the
+    two are interchangeable at the dispatch site. ``storage_dtype``
+    (e.g. f64) keeps the *state* at that precision while the kernels run
+    ``dtype`` (f32) — the f64-storage/f32-compute rung: Mosaic has no
+    f64 vector path, so TPU f64 configs ride the f32 kernels and pay
+    only the cast at the run boundary (accuracy priced in PARITY.md).
+    """
+
+    halo = _G_DIFF
+
+    def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
+                 band, bc_value, block_z=None, global_shape=None,
+                 overlap_split: bool = False, storage_dtype=None):
+        nz, ny, nx = interior_shape
+        G = _G_DIFF
+        self.interior_shape = tuple(interior_shape)
+        self.global_shape = tuple(global_shape or interior_shape)
+        self.sharded = self.global_shape != self.interior_shape
+        self.dtype = jnp.dtype(dtype)
+        self._storage = jnp.dtype(storage_dtype or dtype)
+        self.bc_value = float(bc_value)
+        row_bytes = _diff_row_bytes(interior_shape, self.dtype.itemsize)
+        if block_z is None:
+            block_z = _pick_bz_diffusion(
+                nz, row_bytes, self.sharded,
+                want_split=bool(overlap_split and self.sharded),
+            )
+        elif self.sharded and nz % block_z != 0:
+            raise ValueError(
+                f"block_z={block_z} must divide local nz={nz} when sharded"
+            )
+        bz = self.bz = block_z
+        nz_eff = nz if self.sharded else -(-nz // bz) * bz
+        self.n_slabs = nz_eff // bz
+        self.padded_shape = (
+            nz_eff + 2 * G,
+            round_up(ny + 2 * R, SUBLANE),
+            round_up(nx + 2 * R, LANE),
+        )
+        self.core_offsets = (G, R, R)
+        scales = tuple(
+            float(diffusivity[i]) / (12.0 * spacing[i] * spacing[i])
+            for i in range(3)
+        )
+        self.dt = float(dt)
+        # split-overlap needs interior slabs that never touch the stale
+        # z-ghost rows: bz >= G, and a non-degenerate interior band
+        self.overlap_split = bool(
+            overlap_split and self.sharded
+            and self.n_slabs >= 3 and bz >= G
+        )
+
+        stage = functools.partial(
+            _stage_rows, interior_shape=self.global_shape, scales=scales,
+            dt=self.dt, band=band, bc_value=float(bc_value),
+        )
+        (a1, b1), (a2, b2), (a3, b3) = _STAGES
+
+        def step_fn(v, base_z):
+            # the whole-step chain (fused_diffusion_step) on one slab:
+            # windows narrow by 2R per stage, masks at global z indices
+            t1 = stage(v, None, gz0=base_z + R, a=a1, b=b1)
+            t2 = stage(t1, v[2 * R: 2 * R + bz + 2 * R],
+                       gz0=base_z + 2 * R, a=a2, b=b2)
+            return stage(t2, v[3 * R: 3 * R + bz],
+                         gz0=base_z + 3 * R, a=a3, b=b3)
+
+        self._step_fn = step_fn
+        if self.sharded:
+            self._build_sharded_calls()
+
+    @staticmethod
+    def supported(interior_shape, dtype, sharded: bool = False) -> bool:
+        row = _diff_row_bytes(interior_shape, jnp.dtype(dtype).itemsize)
+        if _diff_budget_rows(row) < 1:
+            return False
+        if sharded:
+            return interior_shape[0] >= 1
+        return True
+
+    @staticmethod
+    def profitable(interior_shape, dtype, sharded: bool = False) -> bool:
+        """Where the slab schedule is modeled to beat the per-stage
+        path. Deliberately conservative: the whole-step rung — the same
+        fused-3-stages-with-redundant-recompute structure, minus the
+        multi-step grid — *measured slower* than per-stage on v5e
+        ("compute growth outweighs the HBM saving", PARITY.md), so deep
+        multi-slab grids keep the measured per-stage default until a
+        TPU session measures the whole-run variant
+        (``impl='pallas_slab'`` pins it for that). The structural wins
+        engage automatically: z extents served by one or two slabs
+        (near-whole-state-in-VMEM per step, minimal redundant rows),
+        and hypothetically slabs thick enough that the recompute tax is
+        noise (bz >= 4G — above today's VMEM-budget cap at bench-scale
+        rows, so effectively future-proofing)."""
+        nz = interior_shape[0]
+        row = _diff_row_bytes(interior_shape, jnp.dtype(dtype).itemsize)
+        bz = _pick_bz_diffusion(nz, row, sharded)
+        n_slabs = -(-nz // bz)
+        return bz >= 4 * _G_DIFF or n_slabs <= 2
+
+    def embed(self, u):
+        full = jnp.full(self.padded_shape, self.bc_value, self.dtype)
+        return lax.dynamic_update_slice(
+            full, u.astype(self.dtype), (self.halo, R, R)
+        )
+
+    def extract(self, S):
+        nz, ny, nx = self.interior_shape
+        G = self.halo
+        out = lax.slice(S, (G, R, R), (G + nz, R + ny, R + nx))
+        return out.astype(self._storage)
+
+
+# --------------------------------------------------------------------- #
+# Burgers / WENO
+# --------------------------------------------------------------------- #
+
+
+def _burg_row_bytes(interior_shape, itemsize: int, r: int) -> int:
+    ny, nx = interior_shape[1], interior_shape[2]
+    return (
+        round_up(ny + 2 * r, SUBLANE) * round_up(nx + 2 * r, LANE) * itemsize
+    )
+
+
+def _burg_live_rows(bz: int, r: int, order: int) -> int:
+    """Model of the live full-width row count: pipeline slots + stage
+    windows + the widest stage's sweep intermediates (as fused_burgers's
+    ``_live_bytes``, but on full-width rows)."""
+    G = 3 * r
+    k = 14 if order == 5 else 20
+    return 2 * (bz + 2 * G) + 2 * bz + (bz + 4 * r) + (bz + 2 * r) + k * (
+        bz + 4 * r
+    )
+
+
+def _pick_bz_burgers(nz: int, row_bytes: int, r: int, order: int,
+                     want_split: bool = False):
+    """Largest divisor of nz whose modeled working set fits the budget
+    (no dead z rows: edge replication indexes the last interior row at a
+    static slab-local position only when blocks tile nz exactly).
+    ``want_split``: prefer a block the split-overlap schedule can use
+    (n_slabs >= 3, bz >= G) when one fits."""
+    def fits(b):
+        return _burg_live_rows(b, r, order) * row_bytes <= _VMEM_BUDGET
+
+    if want_split:
+        b = _split_block(nz, nz, 3 * r, fits)
+        if b is not None:
+            return b
+    for b in range(nz, 0, -1):
+        if nz % b == 0 and fits(b):
+            return b
+    return None
+
+
+class SlabRunBurgersStepper(_SlabRunStepper):
+    """Whole-run slab-pipelined Burgers/WENO stepper (fixed dt).
+
+    Layout is the 2-D whole-run stepper's, extruded: trailing dims
+    ``(round8(ny+2r), round128(nx+2r))`` with inline edge-replicated
+    ghosts re-synthesized in VMEM after every stage (x/y always; z at
+    the global walls, keyed on global coordinates so sharded shards
+    leave their neighbor-filled ghost rows alone). Adaptive dt needs a
+    global reduction between steps, which the whole-run grid cannot
+    host — adaptive configs keep the per-stage stepper.
+    """
+
+    def __init__(self, interior_shape, dtype, spacing, flux: Flux,
+                 variant: str, nu: float, dt: float, block_z=None,
+                 global_shape=None, overlap_split: bool = False,
+                 order: int = 5):
+        if order not in HALO:
+            raise ValueError(f"unsupported WENO order {order}")
+        if order == 7 and variant != "js":
+            raise ValueError("WENO7 supports only the 'js' variant")
+        r = HALO[order]
+        G = 3 * r
+        self.order = order
+        self.halo = G
+        nz, ny, nx = interior_shape
+        self.interior_shape = tuple(interior_shape)
+        self.global_shape = tuple(global_shape or interior_shape)
+        self.sharded = self.global_shape != self.interior_shape
+        self.dtype = jnp.dtype(dtype)
+        self._storage = self.dtype
+        row_bytes = _burg_row_bytes(interior_shape, self.dtype.itemsize, r)
+        if block_z is None:
+            block_z = _pick_bz_burgers(
+                nz, row_bytes, r, order,
+                want_split=bool(overlap_split and self.sharded),
+            )
+            if block_z is None:
+                raise ValueError(
+                    f"no viable slab block for interior {interior_shape}"
+                )
+        elif nz % block_z != 0:
+            raise ValueError(f"block_z={block_z} must divide nz={nz}")
+        bz = self.bz = block_z
+        self.n_slabs = nz // bz
+        self.padded_shape = (
+            nz + 2 * G,
+            round_up(ny + 2 * r, SUBLANE),
+            round_up(nx + 2 * r, LANE),
+        )
+        self.r = r
+        self.core_offsets = (G, r, r)
+        self.dt = float(dt)
+        self.overlap_split = bool(
+            overlap_split and self.sharded
+            and self.n_slabs >= 3 and bz >= G
+        )
+        inv_dx = tuple(1.0 / spacing[i] for i in range(3))
+        nu_scales = None
+        if nu:
+            nu_scales = tuple(
+                float(nu) / (12.0 * spacing[i] * spacing[i])
+                for i in range(3)
+            )
+        NZ, NY, NX = self.global_shape
+
+        def fill(t, base, lo_src, hi_src):
+            """Edge-replicate ghost/slack cells (WENO5resAdv_X.m:53):
+            x/y from the static boundary columns; z keyed on *global*
+            row indices, so the masks are nonempty only on the slabs
+            (and shards) that actually touch a wall — where the replica
+            source row sits at the static index ``lo_src``/``hi_src``.
+            Elsewhere the mask is empty and the ghost rows keep their
+            loaded (neighbor/recomputed) values."""
+            gx = lax.broadcasted_iota(jnp.int32, t.shape, 2) - r
+            t = jnp.where(gx < 0, t[:, :, r: r + 1], t)
+            t = jnp.where(gx >= NX, t[:, :, r + NX - 1: r + NX], t)
+            gy = lax.broadcasted_iota(jnp.int32, t.shape, 1) - r
+            t = jnp.where(gy < 0, t[:, r: r + 1], t)
+            t = jnp.where(gy >= NY, t[:, r + NY - 1: r + NY], t)
+            if lo_src is not None:
+                gz = lax.broadcasted_iota(jnp.int32, t.shape, 0) + base
+                t = jnp.where(gz < 0, t[lo_src: lo_src + 1], t)
+                t = jnp.where(gz >= NZ, t[hi_src: hi_src + 1], t)
+            return t
+
+        def stage(u, vwin, a, b, w_out, base, lo_src, hi_src, dtv):
+            vc = vwin[r: r + w_out]
+            vp, vm = _split(flux, vwin)
+            Y = vwin.shape[1]
+            rhs = -(
+                _div_z(vp, vm, w_out, Y, inv_dx[0], variant, order, r, y0=0)
+                + _div_roll(vp[r: r + w_out], vm[r: r + w_out], 1,
+                            inv_dx[1], variant, order)
+                + _div_roll(vp[r: r + w_out], vm[r: r + w_out], 2,
+                            inv_dx[2], variant, order)
+            )
+            if nu_scales is not None:
+                acc = None
+                for axis in range(3):
+                    for jj, c in enumerate(O4_COEFFS):
+                        coef = jnp.asarray(c * nu_scales[axis], vwin.dtype)
+                        if axis == 0:
+                            term = vwin[r - 2 + jj: r - 2 + jj + w_out] * coef
+                        else:
+                            term = _shift(vc, jj - 2, axis) * coef
+                        acc = term if acc is None else acc + term
+                rhs = rhs + acc
+            rk = b * (vc + dtv * rhs) if a == 0.0 else (
+                a * u + b * (vc + dtv * rhs)
+            )
+            return fill(rk.astype(vwin.dtype), base, lo_src, hi_src)
+
+        (a1, b1), (a2, b2), (a3, b3) = _STAGES
+        w = bz + 2 * G
+        dt_f = self.dt  # python float: materialized in-kernel, not captured
+
+        def step_fn(v, base_z):
+            d = jnp.asarray(dt_f, v.dtype)
+            # step-input z ghosts are stale in HBM (never rewritten):
+            # re-synthesize at the global walls; shard-interior ghosts
+            # hold fresh neighbor rows (refresh/exch) and pass through
+            v = fill(v, base_z, G, bz + G - 1)
+            t1 = stage(None, v, a1, b1, w - 2 * r, base_z + r,
+                       G - r, bz + 2 * r - 1, d)
+            t2 = stage(v[2 * r: w - 2 * r], t1, a2, b2, w - 4 * r,
+                       base_z + 2 * r, G - 2 * r, bz + r - 1, d)
+            # stage-3 output is exactly the core: no z-ghost rows left
+            return stage(v[G: G + bz], t2, a3, b3, bz,
+                         base_z + G, None, None, d)
+
+        self._step_fn = step_fn
+        if self.sharded:
+            self._build_sharded_calls()
+
+    @staticmethod
+    def supported(interior_shape, dtype, order: int = 5) -> bool:
+        r = HALO[order]
+        row = _burg_row_bytes(interior_shape, jnp.dtype(dtype).itemsize, r)
+        return _pick_bz_burgers(interior_shape[0], row, r, order) is not None
+
+    @staticmethod
+    def profitable(interior_shape, dtype, order: int = 5) -> bool:
+        """The WENO stages are VPU-bound, so the 2r/bz redundant-compute
+        tax must stay small for the traffic cut to matter: engage only
+        with thick slabs or a one/two-slab z extent (where the per-call
+        overhead saving dominates anyway). ``impl='pallas_slab'``
+        overrides for measurement."""
+        r = HALO[order]
+        nz = interior_shape[0]
+        row = _burg_row_bytes(interior_shape, jnp.dtype(dtype).itemsize, r)
+        bz = _pick_bz_burgers(nz, row, r, order)
+        if bz is None:
+            return False
+        return bz >= 6 * r or nz // bz <= 2
+
+    def embed(self, u):
+        G, r = self.halo, self.r
+        nz, ny, nx = self.interior_shape
+        pz, py, px = self.padded_shape
+        return jnp.pad(
+            u.astype(self.dtype),
+            ((G, G), (r, py - ny - r), (r, px - nx - r)),
+            mode="edge",
+        )
+
+    def extract(self, S):
+        G, r = self.halo, self.r
+        nz, ny, nx = self.interior_shape
+        return lax.slice(S, (G, r, r), (G + nz, r + ny, r + nx))

@@ -31,7 +31,22 @@ def initialize(
 ) -> None:
     """Bring up the jax.distributed runtime (InitializeMPI analog,
     ``Tools.c:228-234``). On managed TPU pods all arguments auto-detect;
-    on hand-rolled clusters pass coordinator/process info explicitly."""
+    on hand-rolled clusters pass coordinator/process info explicitly.
+
+    On the CPU backend (the virtual-device demo/test world) JAX ships no
+    default cross-process collective transport — every multiprocess
+    computation fails with "not implemented" unless the gloo transport
+    is selected before the runtime comes up."""
+    import os
+
+    plats = (
+        os.environ.get("JAX_PLATFORMS", "") or jax.default_backend()
+    )
+    if "cpu" in plats:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jax: flag absent, gloo is the default
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -1,0 +1,80 @@
+"""Engaged-path regression guard for the benchmark configs.
+
+Every bench row promises a rung of the stepper ladder; a refactor that
+silently drops a config to generic-xla/per-axis-pallas would otherwise
+just publish a slow rate. bench.py enforces this at run time (the
+engagement guard fails the run); this test enforces it at suite time —
+WITHOUT timing anything, just by building each row's solver and asking
+``engaged_path``.
+"""
+
+import importlib.util
+import os
+
+from jax.experimental import enable_x64
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_module():
+    spec = importlib.util.spec_from_file_location(
+        "bench_artifact", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_rows_engage_expected_steppers():
+    """Each bench.py row's solver must engage a stepper from its
+    expected set (CPU grids here; the TPU grids may legitimately sit on
+    the other member of a {slab, stage} pair, never below it)."""
+    bench = _bench_module()
+    rows = bench._cases(on_tpu=False)
+    assert len(rows) >= 13
+    seen = {}
+    for metric, make_solver, mode, work, baseline, expect in rows:
+        with enable_x64(metric.endswith("_f64_mlups")):
+            solver = make_solver()
+            engaged = solver.engaged_path(
+                "t_end" if mode == "t_end" else "iters"
+            )
+        assert engaged["stepper"] in expect, (
+            metric, engaged["stepper"], engaged["fallback"]
+        )
+        seen[metric] = engaged["stepper"]
+    # the slab-run round's acceptance rows: the 3-D headline Burgers
+    # config and the f64 diffusion row must ride a fused path on the
+    # CPU grids — specifically the new slab whole-run stepper
+    assert seen["burgers3d_mlups"] == "fused-whole-run-slab"
+    assert seen["diffusion3d_f64_mlups"] == "fused-whole-run-slab"
+    # the pinned explicit rungs stay pinned
+    assert seen["burgers3d_axis_mlups"] == "per-axis-pallas"
+
+
+def test_bench_matrix_cases_report_engaged():
+    """bench/matrix.py rows carry the engaged stepper in the artifact;
+    the fused-impl cases must sit on the fused ladder (CPU-quick
+    grids), and the f64 diffusion case must no longer report
+    generic-xla."""
+    from multigpu_advectiondiffusion_tpu.bench.matrix import (
+        CASES,
+        build_solver,
+        resolve_impl,
+    )
+
+    for case in CASES:
+        dtype = case.dtype
+        grid_xyz = tuple(
+            max(16, g // case.quick_scale) for g in case.grid_xyz
+        )
+        with enable_x64(dtype == "float64"):
+            solver = build_solver(case, dtype, grid_xyz, None)
+            engaged = solver.engaged_path()["stepper"]
+        impl = resolve_impl(case, dtype)
+        if impl == "pallas":
+            assert engaged.startswith("fused-"), (case.name, engaged)
+        elif impl == "pallas_axis":
+            assert engaged == "per-axis-pallas", (case.name, engaged)
+        if case.name == "diffusion3d_multigpu_f64":
+            assert engaged != "generic-xla", engaged

@@ -31,6 +31,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import enable_x64
 
 from multigpu_advectiondiffusion_tpu import (
     DiffusionConfig,
@@ -96,6 +97,7 @@ def _count_in_windows(events, kind):
     return n, bool(starts and dones)
 
 
+@pytest.mark.slow
 def test_split_overlap_tpu_schedule_hides_collectives():
     """AOT-compile the sharded ``overlap='split'`` diffusion step for a
     4-chip v5e topology and read the overlap out of the compiled
@@ -134,6 +136,7 @@ def test_split_overlap_tpu_schedule_hides_collectives():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model", ["burgers", "diffusion",
                                    "burgers-pencil", "burgers-xghost"])
 def test_fused_split_overlap_tpu_schedule_hides_collectives(
@@ -178,7 +181,7 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
         mesh = Mesh(devs, ("dz",))
     # x64 (the suite default) poisons Mosaic verification with i64
     # constants — the kernels are f32/i32 by design
-    with jax.enable_x64(False):
+    with enable_x64(False):
         if model == "burgers":
             # local lz = 32 -> bz=8 -> n_bz=4: a real interior band
             grid = Grid.make(128, 16, 128, lengths=2.0)
@@ -268,6 +271,7 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("overlap", ["padded", "split"])
 @pytest.mark.parametrize("model", ["burgers", "diffusion",
                                    "burgers-weno7"])
@@ -302,7 +306,7 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
 
     devs = np.asarray(topo.devices[:4])
     mesh = Mesh(devs, ("dy",))
-    with jax.enable_x64(False):
+    with enable_x64(False):
         grid = Grid.make(256, 256, lengths=2.0)
         if model == "burgers":
             solver = BurgersSolver(
@@ -359,3 +363,63 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
             "no stage kernel scheduled inside a collective-permute "
             "window — the 2-D split overlap is not being hidden"
         )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", ["diffusion", "burgers"])
+def test_fused_slab_run_mosaic_aot_compiles(monkeypatch, model):
+    """The slab-pipelined whole-run stepper (single Pallas program over
+    a (timestep, z-slab) grid with the stacked ping-pong state) must
+    compile through the real Mosaic pipeline for a v5e target — the
+    interpret-mode suite can't catch Mosaic-only rejections of the
+    dynamically-indexed stacked-buffer DMAs."""
+    try:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    except Exception as e:  # no TPU compiler plugin in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {type(e).__name__}")
+
+    from multigpu_advectiondiffusion_tpu import BurgersConfig, BurgersSolver
+    from multigpu_advectiondiffusion_tpu.ops.pallas import (
+        fused_burgers as fb,
+        fused_diffusion as fd,
+        fused_slab_run as fsr,
+        laplacian as lap,
+    )
+
+    for mod in (fsr, fb, fd, lap):
+        monkeypatch.setattr(mod, "interpret_mode", lambda: False)
+
+    with enable_x64(False):
+        if model == "diffusion":
+            grid = Grid.make(128, 128, 64, lengths=2.0)
+            solver = DiffusionSolver(
+                DiffusionConfig(grid=grid, dtype="float32",
+                                impl="pallas_slab")
+            )
+        else:
+            grid = Grid.make(128, 64, 64, lengths=2.0)
+            solver = BurgersSolver(
+                BurgersConfig(grid=grid, nu=1e-5, dtype="float32",
+                              adaptive_dt=False, impl="pallas_slab")
+            )
+        fused = solver._fused_stepper()
+        assert fused is not None, getattr(solver, "_fused_fallback", None)
+        assert fused.engaged_label == "fused-whole-run-slab"
+        assert fused.n_slabs >= 2, "want a multi-slab pipeline"
+
+        def block(u, t):
+            return fused.run(u, t, 3)
+
+        # unsharded: pin the AOT lowering to one device of the TPU
+        # topology via the operands' sharding
+        sharding = jax.sharding.SingleDeviceSharding(topo.devices[0])
+        u = jax.ShapeDtypeStruct(grid.shape, jnp.float32, sharding=sharding)
+        t = jax.ShapeDtypeStruct((), jnp.float32, sharding=sharding)
+        try:
+            txt = jax.jit(block).lower(u, t).compile().as_text()
+        except Exception as e:  # Mosaic AOT unavailable on this rig
+            pytest.skip(f"Mosaic AOT compile unavailable: {type(e).__name__}")
+
+    assert "tpu_custom_call" in txt, "slab kernel did not lower via Mosaic"

@@ -67,7 +67,12 @@ def test_burgers3d_sharded_bit_identical(devices, variant):
     )
     out = solver.run(solver.initial_state(), 5)
     assert _max_abs_diff(ref.u, out.u) <= _WENO_ULPS
-    assert float(ref.t) == float(out.t)
+    # adaptive dt inherits the state's few-ulp freedom through the CFL
+    # max, so the accumulated t may differ in the last ulp or two —
+    # demand ulp-level, not bit-level, agreement
+    assert abs(float(ref.t) - float(out.t)) <= (
+        8 * np.finfo(np.float64).eps * max(1.0, abs(float(ref.t)))
+    )
 
 
 def test_burgers3d_weno7_sharded(devices):
@@ -83,7 +88,12 @@ def test_burgers3d_weno7_sharded(devices):
     )
     out = solver.run(solver.initial_state(), 5)
     assert _max_abs_diff(ref.u, out.u) <= _WENO_ULPS
-    assert float(ref.t) == float(out.t)
+    # adaptive dt inherits the state's few-ulp freedom through the CFL
+    # max, so the accumulated t may differ in the last ulp or two —
+    # demand ulp-level, not bit-level, agreement
+    assert abs(float(ref.t) - float(out.t)) <= (
+        8 * np.finfo(np.float64).eps * max(1.0, abs(float(ref.t)))
+    )
 
 
 def test_burgers2d_sharded_innermost_axis(devices):
