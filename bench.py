@@ -342,11 +342,17 @@ def main() -> None:
         }
         # engagement guard: a row running on an unexpected (slower)
         # stepper is recorded AND fails the run — a silent fallback to
-        # generic-xla/per-axis-pallas must not just publish a slow rate
-        if engaged["stepper"] not in expect:
+        # generic-xla/per-axis-pallas must not just publish a slow rate.
+        # A run that DEGRADED off its requested rung mid-measurement
+        # (resilience ladder: Mosaic failure -> lower rung) fails the
+        # bench the same way even when the landing rung is in `expect`:
+        # the row would otherwise silently record the slower rung's rate
+        # under the headline metric name.
+        if engaged["stepper"] not in expect or engaged.get("degraded"):
             row["engagement_error"] = {
                 "expected": sorted(expect),
                 "fallback": engaged["fallback"],
+                "degraded": engaged.get("degraded"),
             }
             mismatches.append(metric)
         print(json.dumps(row), flush=True)

@@ -181,6 +181,7 @@ def run_case(
 
     timed = timed_run(solver, state, iters, reps=repeats)
     best = timed.seconds
+    engaged = solver.engaged_path()
     # warm-up = compile + one full execution of the benchmarked program
     compile_s = max(timed.warmup_seconds - best, 0.0)
 
@@ -200,13 +201,18 @@ def run_case(
         # that silently fell off the fused ladder is visible in the
         # artifact, not just slow (bench.py's engagement guard is the
         # hard-failing counterpart for the headline rows)
-        "engaged": solver.engaged_path()["stepper"],
+        "engaged": engaged["stepper"],
         "seconds": round(best, 4),
         "compile_seconds": round(compile_s, 3),
         "mlups": round(rate, 1),
         "quick": quick,
         "mesh": mesh_spec,
     }
+    if engaged.get("degraded"):
+        # a mid-measurement kernel-ladder downgrade (Mosaic failure ->
+        # slower rung) is recorded in the artifact; bench.py's guard is
+        # the hard-failing counterpart
+        result["degraded"] = engaged["degraded"]
     if base and not quick:
         result["reference_mlups"] = base
         result["vs_reference"] = round(rate / base, 3)

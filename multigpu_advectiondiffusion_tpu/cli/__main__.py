@@ -14,6 +14,10 @@ harness (``./Diffusion3d.run K L W H Nx Ny Nz iters bX bY bZ``,
 
 Block sizes (bX/bY/bZ) have no TPU meaning and are not taken; XLA/Pallas
 choose tiling.
+
+Exit codes: 0 success, 1 failure, 75 preempted (SIGTERM/SIGINT landed; a
+final CRC-valid checkpoint + ``preempt.json`` manifest were written to
+``--save DIR`` — rerun the same command with ``--resume auto``).
 """
 
 from __future__ import annotations
@@ -81,8 +85,27 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "a layout manifest — no gather to one host; resume "
                         "reassembles onto any mesh)")
     p.add_argument("--resume", default=None, metavar="CKPT",
-                   help="resume from a .ckpt/.npz checkpoint instead of "
-                        "the initial condition")
+                   help="resume from a .ckpt/.npz/.ckptd checkpoint "
+                        "instead of the initial condition; 'auto' scans "
+                        "--save DIR for the newest CRC-valid checkpoint, "
+                        "skipping corrupt/truncated ones")
+    p.add_argument("--sentinel-every", type=int, default=0, metavar="N",
+                   help="divergence-sentinel cadence: a mesh-aware "
+                        "all-finite + norm-growth probe every N steps "
+                        "between fused-run calls; on divergence the run "
+                        "rolls back to the last good checkpoint and "
+                        "retries with dt scaled by --dt-backoff "
+                        "(0 = unsupervised)")
+    p.add_argument("--sentinel-growth", type=float, default=1e3,
+                   metavar="G",
+                   help="sentinel norm bound: max|u| may not exceed G x "
+                        "max(1, initial max|u|)")
+    p.add_argument("--max-retries", type=int, default=3, metavar="N",
+                   help="rollback-and-retry budget before the "
+                        "divergence error propagates")
+    p.add_argument("--dt-backoff", type=float, default=0.5, metavar="F",
+                   help="dt (fixed-dt solvers) or CFL (adaptive) "
+                        "multiplier applied per rollback retry")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace of the timed "
                         "solve into DIR (TensorBoard/Perfetto viewable) — "
@@ -164,7 +187,11 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_keep=args.checkpoint_keep,
                       checkpoint_sharded=args.checkpoint_sharded,
-                      resume=args.resume, profile_dir=args.profile)
+                      resume=args.resume, profile_dir=args.profile,
+                      sentinel_every=args.sentinel_every,
+                      sentinel_growth=args.sentinel_growth,
+                      max_retries=args.max_retries,
+                      dt_backoff=args.dt_backoff)
 
 
 def _run_burgers(args, ndim):
@@ -201,7 +228,11 @@ def _run_burgers(args, ndim):
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_keep=args.checkpoint_keep,
                       checkpoint_sharded=args.checkpoint_sharded,
-                      resume=args.resume, profile_dir=args.profile)
+                      resume=args.resume, profile_dir=args.profile,
+                      sentinel_every=args.sentinel_every,
+                      sentinel_growth=args.sentinel_growth,
+                      max_retries=args.max_retries,
+                      dt_backoff=args.dt_backoff)
 
 
 def _run_convergence(args):

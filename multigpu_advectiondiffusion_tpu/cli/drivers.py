@@ -108,6 +108,10 @@ def run_solver(
     checkpoint_sharded: bool = False,
     resume: Optional[str] = None,
     profile_dir: Optional[str] = None,
+    sentinel_every: int = 0,
+    sentinel_growth: float = 1e3,
+    max_retries: int = 3,
+    dt_backoff: float = 0.5,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
@@ -118,10 +122,31 @@ def run_solver(
     CRC-verified ``.ckpt`` checkpoints every N iterations — the restart
     capability the reference lacks (SURVEY §5). ``checkpoint_keep``
     bounds disk use by deleting all but the newest N checkpoints.
+
+    Resilience (README/PARITY "Failure modes & resilience"):
+    ``sentinel_every`` > 0 supervises the run — a mesh-aware health
+    probe every N steps, rollback to the last good checkpoint and a
+    ``dt_backoff`` retry schedule on divergence (at most
+    ``max_retries``). ``resume='auto'`` scans ``save_dir`` for the
+    newest CRC-valid checkpoint, skipping corrupt ones. SIGTERM/SIGINT
+    end the run at the next chunk boundary with a final atomic
+    checkpoint + ``preempt.json`` manifest and exit code 75
+    (``resilience.EXIT_PREEMPTED``).
     """
     if (iters is None) == (t_end is None):
         raise ValueError("provide exactly one of iters/t_end")
     import jax
+
+    from multigpu_advectiondiffusion_tpu.resilience.preemption import (
+        PreemptionExit,
+        PreemptionGuard,
+    )
+    from multigpu_advectiondiffusion_tpu.resilience.recovery import (
+        find_latest_checkpoint,
+    )
+    from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+        supervise_run,
+    )
 
     # Multi-process runs (the mpirun analog, --coordinator): file output
     # happens once, on the coordinator; shards living on other processes
@@ -135,6 +160,19 @@ def run_solver(
         from jax.experimental import multihost_utils
 
         return multihost_utils.process_allgather(u, tiled=True)
+
+    if resume == "auto":
+        # newest CRC-valid checkpoint in the run directory; corrupt/
+        # truncated candidates are reported and skipped (selection rules
+        # in resilience/recovery.py). Nothing valid -> fresh start.
+        if not save_dir:
+            raise ValueError("--resume auto needs --save DIR to scan")
+        resume = find_latest_checkpoint(save_dir)
+        if resume is None and is_coord:
+            print(
+                f"--resume auto: no valid checkpoint under {save_dir}; "
+                "starting from the initial condition"
+            )
 
     if resume:
         import jax.numpy as jnp
@@ -205,12 +243,46 @@ def run_solver(
     sync(out.u)
     compile_s = time.perf_counter() - t0
 
-    periodic = (snapshot_every or checkpoint_every) and iters is not None
-    if periodic and not save_dir:
+    supervised = sentinel_every > 0
+    periodic = (
+        (snapshot_every or checkpoint_every)
+        and iters is not None
+        and not supervised
+    )
+    if supervised and snapshot_every:
+        raise ValueError(
+            "--sentinel-every supervises checkpoint-grain chunks; "
+            "combine it with --checkpoint-every, not --snapshot-every"
+        )
+    if (periodic or (supervised and checkpoint_every)) and not save_dir:
         raise ValueError("snapshot/checkpoint output needs save_dir")
+
+    def _write_checkpoint(st):
+        """One restartable checkpoint named by global iteration (atomic,
+        CRC-verified; sharded -> per-shard .ckptd directory). Collective
+        when sharded across processes."""
+        glob_it = int(st.it)
+        if checkpoint_sharded:
+            path = os.path.join(save_dir, f"checkpoint_{glob_it:06d}.ckptd")
+            io_utils.save_checkpoint_sharded(
+                path, st, grid=solver.grid, physics=physics_meta(solver)
+            )
+        else:
+            path = os.path.join(save_dir, f"checkpoint_{glob_it:06d}.ckpt")
+            u_host = _fetch(st.u)
+            if is_coord:
+                io_utils.save_checkpoint(
+                    path,
+                    type(st)(u=u_host, t=st.t, it=st.it),
+                    grid=solver.grid,
+                    physics=physics_meta(solver),
+                )
+        io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
+        return path
 
     best = float("inf")
     io_s = None
+    sup_report = None
     # the trace context closes on every exit path, including exceptions
     # raised inside the timed solve (a leaked jax.profiler trace poisons
     # every later start_trace in the process)
@@ -227,8 +299,38 @@ def run_solver(
                 profile_dir, f"rank{jax.process_index()}"
             )
         profiled.enter_context(trace(profile_dir))
-    with profiled:
-        if periodic:
+    guard = PreemptionGuard()
+    with profiled, guard:
+        if supervised:
+            # supervised chunked loop: sentinel probes at cadence,
+            # rollback + dt-backoff retries on divergence; the disk
+            # checkpoints (when requested) are the rollback grain
+            io_acc = [0.0]
+
+            def save_ckpt(st):
+                sync(st.u)  # don't book device compute as I/O
+                io_t0 = time.perf_counter()
+                _write_checkpoint(st)
+                io_acc[0] += time.perf_counter() - io_t0
+
+            t0 = time.perf_counter()
+            out, sup_report = supervise_run(
+                solver,
+                state,
+                iters=iters,
+                t_end=t_end,
+                sentinel_every=sentinel_every,
+                growth=sentinel_growth,
+                max_retries=max_retries,
+                dt_backoff=dt_backoff,
+                checkpoint_every=checkpoint_every,
+                save_checkpoint=save_ckpt if checkpoint_every else None,
+                should_stop=lambda: guard.should_stop,
+            )
+            sync(out.u)
+            io_s = io_acc[0] if checkpoint_every else None
+            best = time.perf_counter() - t0 - (io_s or 0.0)
+        elif periodic:
             chunk = min(x for x in (snapshot_every, checkpoint_every) if x)
             io_s = 0.0  # shadows the outer None: periodic runs report it
             with io_utils.AsyncBinaryWriter() as writer:
@@ -295,6 +397,8 @@ def run_solver(
                                 )
                         io_utils.rotate_checkpoints(save_dir, checkpoint_keep)
                     io_s += time.perf_counter() - io_t0
+                    if guard.should_stop:
+                        break  # preemption: finalize below with what ran
                 sync(out.u)
                 best = time.perf_counter() - t0 - io_s
         else:
@@ -306,6 +410,42 @@ def run_solver(
                     out = solver.advance_to(state, t_end)
                 sync(out.u)
                 best = min(best, time.perf_counter() - t0)
+                if guard.should_stop:
+                    break  # preemption between repeats
+
+    if guard.should_stop:
+        # preemption-safe exit: final atomic checkpoint + manifest, then
+        # the documented exit code (resume with --resume auto). A
+        # multi-process run must receive the signal on every process
+        # (sharded checkpoint saves are collective).
+        from multigpu_advectiondiffusion_tpu.resilience.preemption import (
+            EXIT_PREEMPTED,
+        )
+
+        ckpt_path = None
+        if save_dir:
+            sync(out.u)
+            ckpt_path = _write_checkpoint(out)
+            if is_coord:
+                manifest = {
+                    "signal": int(guard.signum),
+                    "iteration": int(out.it),
+                    "t": float(out.t),
+                    "checkpoint": ckpt_path,
+                    "exit_code": EXIT_PREEMPTED,
+                    "resume": "--resume auto",
+                }
+                tmp = os.path.join(save_dir, "preempt.json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f, indent=2)
+                os.replace(tmp, os.path.join(save_dir, "preempt.json"))
+        if is_coord:
+            where = f"; checkpoint: {ckpt_path}" if ckpt_path else ""
+            print(
+                f"preempted by signal {guard.signum} at iteration "
+                f"{int(out.it)}{where}; exiting {EXIT_PREEMPTED}"
+            )
+        raise PreemptionExit(guard.signum, ckpt_path)
 
     # iterations executed THIS run — a resumed state's it starts at the
     # checkpoint's cumulative count, which must not inflate the summary
@@ -328,6 +468,7 @@ def run_solver(
         engaged=solver.engaged_path(
             mode="iters" if iters is not None else "t_end"
         ),
+        resilience=sup_report.to_dict() if sup_report is not None else None,
     )
 
     if check_error and hasattr(solver, "error_norms"):

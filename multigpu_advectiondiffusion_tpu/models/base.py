@@ -85,6 +85,11 @@ class SolverBase:
             self.decomp.validate(mesh, cfg.grid.shape)
         self.dtype = canonicalize(cfg.dtype)
         self._cache = {}
+        # kernel-ladder degradation bookkeeping: the impl the user asked
+        # for (engaged_path reports it even after a downgrade swapped
+        # cfg.impl) and the downgrade events themselves
+        self._requested_impl = getattr(cfg, "impl", "xla")
+        self._degrade_events = []
 
     # ------------------------------------------------------------------ #
     # To be provided by subclasses
@@ -234,12 +239,67 @@ class SolverBase:
         return self._cache[key]
 
     # ------------------------------------------------------------------ #
+    # Graceful kernel-ladder degradation
+    # ------------------------------------------------------------------ #
+    def _with_ladder(self, call, mode: str = "iters"):
+        """Execute ``call()`` (a public driver's body), falling down the
+        kernel ladder on a Pallas/Mosaic compile or launch failure at
+        dispatch: ``pallas_slab -> pallas_stage -> xla``.
+
+        Only ``impl='pallas'`` (the best-*available* promise) degrades;
+        an explicit rung pin (``pallas_slab``/``pallas_stage``/...) fails
+        loudly — the user asked for that kernel, not a slower answer.
+        Failures surfacing asynchronously after dispatch (a launch fault
+        found at a later sync) propagate to the caller; the ladder
+        guards the dispatch/compile point, where Mosaic rejections
+        actually appear."""
+        while True:
+            try:
+                return call()
+            except Exception as exc:  # noqa: BLE001 — classifier filters
+                if not self._degrade_after(exc, mode):
+                    raise
+
+    def _degrade_after(self, exc, mode: str) -> bool:
+        """Record a downgrade and retarget ``cfg.impl`` one rung down;
+        True if the caller should retry. The classifier keeps this
+        narrow: only kernel-infrastructure failures under an auto
+        (``impl='pallas'``) config degrade."""
+        from multigpu_advectiondiffusion_tpu.resilience.errors import (
+            is_kernel_failure,
+        )
+
+        if self._requested_impl != "pallas" or not is_kernel_failure(exc):
+            return False
+        engaged = self.engaged_path(mode=mode)["stepper"]
+        if engaged in ("generic-xla", "per-axis-pallas") and getattr(
+            self.cfg, "impl", "xla"
+        ) == "xla":
+            return False  # already at the bottom of the ladder
+        nxt = (
+            "pallas_stage"
+            if engaged == "fused-whole-run-slab"
+            else "xla"
+        )
+        self._degrade_events.append({
+            "from": engaged,
+            "to": nxt,
+            "reason": f"{type(exc).__name__}: {exc}"[:300],
+        })
+        self.cfg = dataclasses.replace(self.cfg, impl=nxt)
+        self._cache.clear()
+        return True
+
+    # ------------------------------------------------------------------ #
     # Public drivers
     # ------------------------------------------------------------------ #
     def step(self, state: SolverState) -> SolverState:
-        f = self._compiled("step", lambda: self._wrap(self._local_step))
-        u, t = f(state.u, state.t)
-        return SolverState(u=u, t=t, it=state.it + 1)
+        def call():
+            f = self._compiled("step", lambda: self._wrap(self._local_step))
+            u, t = f(state.u, state.t)
+            return SolverState(u=u, t=t, it=state.it + 1)
+
+        return self._with_ladder(call)
 
     def _fused_stepper(self, mode: str = "iters"):
         """Solver-specific fully-fused fast path, or ``None`` (generic).
@@ -283,8 +343,11 @@ class SolverBase:
         silently benchmark the generic path. Keys: ``impl`` (requested),
         ``stepper`` (what executes: ``fused-stage`` / ``fused-whole-run``
         / ``fused-step`` / ``per-axis-pallas`` / ``generic-xla``),
-        ``overlap`` (sharded halo schedule actually in effect), and
-        ``fallback`` (reason the fused stepper was declined, or None).
+        ``overlap`` (sharded halo schedule actually in effect),
+        ``fallback`` (reason the fused stepper was declined, or None),
+        and — when the kernel ladder degraded after a Mosaic/Pallas
+        dispatch failure — ``degraded``, the downgrade event list
+        (from/to rung + failure text); absent on healthy runs.
 
         ``mode`` mirrors the execution dispatch: ``"t_end"`` engages the
         fused stepper only when it has ``run_to`` (``advance_to``'s extra
@@ -296,7 +359,9 @@ class SolverBase:
             is_pallas_impl,
         )
 
-        impl = getattr(self.cfg, "impl", "xla")
+        impl = getattr(self, "_requested_impl", None) or getattr(
+            self.cfg, "impl", "xla"
+        )
         fused = self._fused_stepper(mode="t_end" if mode == "t_end" else "iters")
         if fused is not None and mode == "t_end" and not hasattr(
             fused, "run_to"
@@ -314,12 +379,15 @@ class SolverBase:
                     if getattr(fused, "overlap_split", False)
                     else "serialized-refresh"
                 )
-            return {
+            out = {
                 "impl": impl,
                 "stepper": fused.engaged_label,
                 "overlap": overlap,
                 "fallback": None,
             }
+            if self._degrade_events:
+                out["degraded"] = list(self._degrade_events)
+            return out
         # honor solver-level per-op dispatch rules (e.g. Burgers keeps
         # XLA for WENO7 under impl="pallas" — measured faster)
         op = (
@@ -344,12 +412,15 @@ class SolverBase:
             if self.mesh is not None
             else None
         )
-        return {
+        out = {
             "impl": impl,
             "stepper": stepper,
             "overlap": overlap,
             "fallback": fallback,
         }
+        if self._degrade_events:
+            out["degraded"] = list(self._degrade_events)
+        return out
 
     def _sharded_axes(self):
         """Array axes that are *actually* decomposed: listed in the
@@ -452,7 +523,12 @@ class SolverBase:
 
     def run(self, state: SolverState, num_iters: int) -> SolverState:
         """Fixed-count loop (the CUDA drivers' ``max_iters`` mode,
-        ``MultiGPU/Diffusion3d_Baseline/main.c:189``)."""
+        ``MultiGPU/Diffusion3d_Baseline/main.c:189``). A Mosaic/Pallas
+        failure at dispatch under ``impl='pallas'`` retries one kernel-
+        ladder rung down (:meth:`_with_ladder`)."""
+        return self._with_ladder(lambda: self._run_impl(state, num_iters))
+
+    def _run_impl(self, state: SolverState, num_iters: int) -> SolverState:
         fused = self._fused_stepper()
         if fused is not None:
             refresh, offsets_fn, exch = self._fused_sharded_ctx(fused)
@@ -496,6 +572,11 @@ class SolverBase:
         stepper's speed — the reference Burgers drivers' *only* execution
         mode is ``while (t < tEnd)`` over the tuned kernels
         (``MultiGPU/Burgers3d_Baseline/main.c:190-317``)."""
+        return self._with_ladder(
+            lambda: self._advance_impl(state, t_end), mode="t_end"
+        )
+
+    def _advance_impl(self, state: SolverState, t_end: float) -> SolverState:
         fused = self._fused_stepper(mode="t_end")
         if fused is not None and hasattr(fused, "run_to"):
             refresh, offsets_fn, exch = self._fused_sharded_ctx(fused)

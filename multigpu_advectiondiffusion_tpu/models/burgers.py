@@ -61,8 +61,14 @@ class BurgersConfig:
     overlap: str = "padded"
 
     def __post_init__(self):
+        from multigpu_advectiondiffusion_tpu.ops import IMPLS
+
         if self.overlap not in ("padded", "split"):
             raise ValueError(f"unknown overlap {self.overlap!r}")
+        if self.impl not in IMPLS:
+            raise ValueError(
+                f"unknown impl {self.impl!r}; ladder rungs: {IMPLS}"
+            )
 
 
 class BurgersSolver(SolverBase):

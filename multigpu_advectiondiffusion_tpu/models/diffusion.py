@@ -71,10 +71,16 @@ class DiffusionConfig:
     overlap: str = "padded"
 
     def __post_init__(self):
+        from multigpu_advectiondiffusion_tpu.ops import IMPLS
+
         if self.geometry not in ("cartesian", "axisymmetric"):
             raise ValueError(f"unknown geometry {self.geometry!r}")
         if self.overlap not in ("padded", "split"):
             raise ValueError(f"unknown overlap {self.overlap!r}")
+        if self.impl not in IMPLS:
+            raise ValueError(
+                f"unknown impl {self.impl!r}; ladder rungs: {IMPLS}"
+            )
         if self.geometry == "axisymmetric" and self.grid.ndim != 2:
             raise ValueError("axisymmetric geometry requires a 2-D (y, r) grid")
 

@@ -2,6 +2,16 @@ from multigpu_advectiondiffusion_tpu.ops import flux, laplacian, weno, stencils,
 
 __all__ = ["flux", "laplacian", "weno", "stencils", "axisym"]
 
+# Every kernel-strategy rung a config may request ("pallas" = best
+# available, suffixed flavors pin one rung). The configs validate
+# against this so a typo'd impl fails at construction instead of
+# silently benchmarking the generic path — and the resilience ladder's
+# degradation targets are guaranteed members.
+IMPLS = (
+    "xla", "pallas", "pallas_axis", "pallas_step", "pallas_slab",
+    "pallas_stage",
+)
+
 
 def is_pallas_impl(impl: str) -> bool:
     """Whether a solver ``impl`` string selects a Pallas kernel flavor

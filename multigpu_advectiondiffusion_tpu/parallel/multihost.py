@@ -28,16 +28,31 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    attempts: Optional[int] = None,
+    backoff_seconds: Optional[float] = None,
+    timeout_seconds: Optional[float] = None,
 ) -> None:
     """Bring up the jax.distributed runtime (InitializeMPI analog,
     ``Tools.c:228-234``). On managed TPU pods all arguments auto-detect;
     on hand-rolled clusters pass coordinator/process info explicitly.
+
+    Coordinator join is retried under exponential backoff: on a real
+    cluster the coordinator process routinely comes up seconds after the
+    workers (restart/preemption races), and a single failed dial must
+    not kill a rank that a 2-second wait would have saved. Defaults —
+    3 ``attempts``, ``backoff_seconds`` 2.0 doubling per retry — are
+    overridable per call or via ``TPUCFD_DIST_ATTEMPTS`` /
+    ``TPUCFD_DIST_BACKOFF`` / ``TPUCFD_DIST_TIMEOUT`` (the last maps to
+    jax's ``initialization_timeout`` where supported). A runtime that is
+    already initialized is success, not an error (idempotent under the
+    supervisor's retry paths).
 
     On the CPU backend (the virtual-device demo/test world) JAX ships no
     default cross-process collective transport — every multiprocess
     computation fails with "not implemented" unless the gloo transport
     is selected before the runtime comes up."""
     import os
+    import time
 
     plats = (
         os.environ.get("JAX_PLATFORMS", "") or jax.default_backend()
@@ -47,11 +62,47 @@ def initialize(
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:  # older jax: flag absent, gloo is the default
             pass
-    jax.distributed.initialize(
+
+    if attempts is None:
+        attempts = int(os.environ.get("TPUCFD_DIST_ATTEMPTS", "3"))
+    if backoff_seconds is None:
+        backoff_seconds = float(os.environ.get("TPUCFD_DIST_BACKOFF", "2.0"))
+    if timeout_seconds is None:
+        env = os.environ.get("TPUCFD_DIST_TIMEOUT")
+        timeout_seconds = float(env) if env else None
+
+    kwargs = dict(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+    if timeout_seconds is not None:
+        import inspect
+
+        try:
+            params = inspect.signature(jax.distributed.initialize).parameters
+            if "initialization_timeout" in params:
+                kwargs["initialization_timeout"] = int(timeout_seconds)
+        except (TypeError, ValueError):
+            pass  # unsignaturable wrapper: retry loop carries the policy
+
+    last_exc = None
+    for attempt in range(max(1, attempts)):
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except RuntimeError as exc:
+            if "already initialized" in str(exc).lower():
+                return  # idempotent re-entry (supervisor retry paths)
+            last_exc = exc
+        except Exception as exc:  # transient dial/handshake failures
+            last_exc = exc
+        if attempt + 1 < attempts:
+            time.sleep(backoff_seconds * (2 ** attempt))
+    raise RuntimeError(
+        f"jax.distributed.initialize failed after {attempts} attempt(s) "
+        f"(coordinator={coordinator_address!r}): {last_exc}"
+    ) from last_exc
 
 
 def hybrid_mesh(

@@ -1,0 +1,63 @@
+"""Structured resilience errors + the kernel-failure classifier.
+
+Kept dependency-free (no jax import at module level) so the dispatch
+layer (``models/base.py``) can import it without cycles.
+"""
+
+from __future__ import annotations
+
+
+class SolverDivergedError(RuntimeError):
+    """The divergence sentinel found a non-finite field or a norm past
+    the growth bound. Carries the structured facts a supervisor needs to
+    roll back and retry: the global step, the simulated time, and the
+    offending max-norm."""
+
+    def __init__(self, step: int, t: float, norm: float,
+                 reason: str = "non-finite field"):
+        self.step = int(step)
+        self.t = float(t)
+        self.norm = float(norm)
+        self.reason = reason
+        super().__init__(
+            f"solver diverged at step {self.step} (t={self.t:.6g}): "
+            f"{reason} (max|u| = {self.norm:.6g})"
+        )
+
+
+class SimulatedMosaicError(RuntimeError):
+    """Fault-injection stand-in for a Mosaic compile/launch failure.
+
+    The message carries the same markers the classifier keys on, so the
+    dispatch layer's ladder degradation treats it exactly like the real
+    thing (``resilience/faults.py`` raises it from a stepper's dispatch
+    point)."""
+
+    def __init__(self, detail: str = "injected fault"):
+        super().__init__(
+            f"Mosaic failed to compile the Pallas kernel: {detail}"
+        )
+
+
+# Substrings (lowercased) identifying a Pallas/Mosaic compile or launch
+# failure in an exception's type name or message. Deliberately narrow:
+# a generic numerical error must NOT be retried on a slower rung — only
+# kernel-infrastructure failures are recoverable by changing kernels.
+_KERNEL_FAILURE_MARKERS = (
+    "mosaic",
+    "pallas",
+    "tpu_custom_call",
+    "vmem",  # scoped-VMEM / VMEM-limit compile rejections
+    "xla tpu compile",
+)
+
+
+def is_kernel_failure(exc: BaseException) -> bool:
+    """Whether ``exc`` looks like a Pallas/Mosaic compile or launch
+    failure that a lower kernel-ladder rung could avoid."""
+    if isinstance(exc, SolverDivergedError):
+        return False  # physics, not kernels — handled by the supervisor
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, MemoryError)):
+        return False
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(marker in text for marker in _KERNEL_FAILURE_MARKERS)

@@ -1,0 +1,161 @@
+"""Fault-injection harness driving ``tests/test_resilience.py``.
+
+Every fault this framework claims to survive is injectable on a CPU-only
+rig, so the recovery paths are tier-1-testable without hardware or an
+actual preemption:
+
+* :func:`nan_at_step` — poison the state with a NaN once the run crosses
+  a global iteration (a transient numerical blow-up);
+* :func:`mosaic_failure` — make fused-stepper dispatch raise a
+  :class:`SimulatedMosaicError` whose message carries the real markers,
+  exercising the kernel-ladder degradation exactly where a Mosaic
+  compile/launch failure would surface;
+* :func:`corrupt_checkpoint` / :func:`truncate_checkpoint` — bit-flip or
+  tear a checkpoint file so CRC verification must catch it;
+* :func:`send_signal` — deliver a real SIGTERM/SIGINT to a process (the
+  scheduler-preemption stand-in).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal as _signal
+from typing import Optional
+
+from multigpu_advectiondiffusion_tpu.resilience.errors import (
+    SimulatedMosaicError,
+)
+
+
+@contextlib.contextmanager
+def nan_at_step(solver, step: int, once: bool = True):
+    """Within the context, the first state ``solver`` produces at or
+    after global iteration ``step`` gets one NaN cell (at the block
+    center). ``once=True`` models a transient fault — after a rollback
+    the same injection does not re-fire; ``once=False`` a persistent
+    one, which must exhaust the supervisor's retries."""
+    import jax.numpy as jnp
+
+    orig = (solver.run, solver.step, solver.advance_to)
+    fired = {"count": 0}
+
+    def poison(out):
+        if (once and fired["count"]) or int(out.it) < step:
+            return out
+        fired["count"] += 1
+        idx = tuple(s // 2 for s in out.u.shape)
+        return type(out)(
+            u=out.u.at[idx].set(jnp.nan), t=out.t, it=out.it
+        )
+
+    solver.run = lambda st, n: poison(orig[0](st, n))
+    solver.step = lambda st: poison(orig[1](st))
+    solver.advance_to = lambda st, te: poison(orig[2](st, te))
+    try:
+        yield fired
+    finally:
+        solver.run, solver.step, solver.advance_to = orig
+
+
+def _stepper_classes():
+    """engaged_label -> fused stepper classes, imported lazily (the
+    Pallas modules are heavyweight and the harness must import clean on
+    rigs without them)."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused2d_sharded import (
+        ShardedFusedBurgers2DStepper,
+        ShardedFusedDiffusion2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+        FusedBurgersStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (
+        FusedBurgers2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+        FusedDiffusionStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion2d import (
+        FusedDiffusion2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion_step import (  # noqa: E501
+        StepFusedDiffusionStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
+        SlabRunBurgersStepper,
+        SlabRunDiffusionStepper,
+    )
+
+    return {
+        "fused-whole-run-slab": (
+            SlabRunDiffusionStepper, SlabRunBurgersStepper,
+        ),
+        "fused-stage": (
+            FusedDiffusionStepper, FusedBurgersStepper,
+            ShardedFusedDiffusion2DStepper, ShardedFusedBurgers2DStepper,
+        ),
+        "fused-whole-run": (
+            FusedDiffusion2DStepper, FusedBurgers2DStepper,
+        ),
+        "fused-step": (StepFusedDiffusionStepper,),
+    }
+
+
+@contextlib.contextmanager
+def mosaic_failure(rungs=None, detail: str = "fault injection"):
+    """Within the context, dispatching any fused stepper whose
+    ``engaged_label`` is in ``rungs`` (default: every fused rung) raises
+    :class:`SimulatedMosaicError` — from ``run``/``run_to``, i.e. inside
+    the jit trace, exactly where a real Mosaic rejection surfaces. The
+    generic XLA path is untouched, so auto configs degrade and complete
+    while pinned configs fail loudly."""
+    classes = _stepper_classes()
+    if rungs is None:
+        rungs = tuple(classes)
+    saved = []
+
+    def _raiser(label):
+        def run(self, *a, **kw):
+            del a, kw
+            raise SimulatedMosaicError(f"{detail} [{label}]")
+        return run
+
+    try:
+        for label in rungs:
+            for cls in classes[label]:
+                for meth in ("run", "run_to"):
+                    if hasattr(cls, meth):
+                        saved.append((cls, meth, getattr(cls, meth)))
+                        setattr(cls, meth, _raiser(label))
+        yield
+    finally:
+        for cls, meth, fn in saved:
+            setattr(cls, meth, fn)
+
+
+def corrupt_checkpoint(path: str, nbytes: int = 8,
+                       offset: Optional[int] = None) -> None:
+    """Flip ``nbytes`` payload bytes in a ``.ckpt`` file (default: right
+    after the 64-byte header) so the stored CRC32 no longer matches. For
+    a ``.ckptd`` directory pass one of its shard files."""
+    with open(path, "r+b") as f:
+        f.seek(64 if offset is None else offset)
+        data = f.read(nbytes)
+        if not data:
+            raise ValueError(f"nothing to corrupt at offset in {path}")
+        f.seek(-len(data), os.SEEK_CUR)
+        f.write(bytes(b ^ 0xFF for b in data))
+
+
+def truncate_checkpoint(path: str, keep_bytes: int = 48) -> None:
+    """Tear a checkpoint mid-write: keep only the first ``keep_bytes``
+    (48 < the 64-byte header tears even the header)."""
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)
+
+
+def send_signal(pid: Optional[int] = None, signum=_signal.SIGTERM) -> None:
+    """Deliver a real signal (default SIGTERM to this process) — the
+    scheduler-preemption stand-in for in-process tests; subprocess tests
+    use ``Popen.send_signal`` directly."""
+    os.kill(os.getpid() if pid is None else pid, signum)

@@ -1,0 +1,79 @@
+"""Preemption-safe exit: SIGTERM/SIGINT -> final checkpoint -> exit 75.
+
+Cluster schedulers announce preemption by signal. The guard converts the
+first SIGTERM/SIGINT into a flag the chunked run loops poll between
+device calls; the CLI then writes a final atomic checkpoint plus a
+``preempt.json`` manifest and exits with :data:`EXIT_PREEMPTED` (75,
+``EX_TEMPFAIL`` — "try again later", i.e. resume with ``--resume
+auto``). A second signal restores the default handler and re-raises it,
+so a wedged run can still be killed.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+#: Documented CLI exit code for a preempted-but-checkpointed run
+#: (os.EX_TEMPFAIL: rerun the same command with ``--resume auto``).
+EXIT_PREEMPTED = 75
+
+
+class PreemptionExit(SystemExit):
+    """Raised by the run driver after the final checkpoint landed;
+    carries :data:`EXIT_PREEMPTED` so the CLI process exits with the
+    documented code."""
+
+    def __init__(self, signum: int, checkpoint: str | None):
+        self.signum = signum
+        self.checkpoint = checkpoint
+        super().__init__(EXIT_PREEMPTED)
+
+
+class PreemptionGuard:
+    """Context manager installing latch-style SIGTERM/SIGINT handlers.
+
+    ``should_stop`` turns True at the first signal; handlers are
+    restored on exit. Only the main thread of the main interpreter may
+    install signal handlers — anywhere else (or under a test harness
+    that already owns the signals) the guard degrades to an inert
+    always-False flag rather than failing the run.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._old = {}
+        self.signum = None
+        self.active = False
+
+    @property
+    def should_stop(self) -> bool:
+        return self.signum is not None
+
+    def _handler(self, signum, frame):
+        del frame
+        if self.signum is not None:
+            # second signal: stop politely waiting — die the default way
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self.signum = signum
+
+    def __enter__(self):
+        try:
+            for s in self._signals:
+                self._old[s] = signal.signal(s, self._handler)
+            self.active = True
+        except ValueError:
+            # not the main thread: handlers cannot install (the first
+            # signal.signal call raises before any handler changed) —
+            # degrade to an inert always-False flag
+            self._old = {}
+        return self
+
+    def __exit__(self, *exc):
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        self._old = {}
+        self.active = False
+        return False

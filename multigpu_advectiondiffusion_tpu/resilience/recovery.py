@@ -1,0 +1,73 @@
+"""``--resume auto``: newest CRC-valid checkpoint in a run directory.
+
+Selection rules (documented in README "Failure modes & resilience"):
+
+1. candidates are rotation-managed names —
+   ``checkpoint_<iteration>.{ckpt,npz,ckptd}`` with a purely numeric
+   iteration stem (the same filter ``rotate_checkpoints`` applies, so a
+   user file like ``checkpoint_best.ckpt`` is never auto-selected);
+2. newest first by iteration number (name order == write order);
+3. the first candidate that passes full integrity verification wins —
+   header parse, payload CRC32, and for ``.ckptd`` directories the
+   manifest tiling check plus every shard's CRC;
+4. corrupt/truncated candidates are reported to stderr and skipped —
+   the exact behavior a preempted run needs when it died mid-write
+   (the atomic rename makes that window tiny but a torn disk is not).
+
+Returns ``None`` when nothing valid exists — the caller starts from the
+initial condition.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+from multigpu_advectiondiffusion_tpu.utils import io as io_utils
+
+_CKPT_SUFFIXES = (".ckpt", ".npz", ".ckptd")
+
+
+def _iteration(name: str, prefix: str) -> Optional[int]:
+    stem = name[len(prefix):].rsplit(".", 1)[0]
+    return int(stem) if stem.isdigit() else None
+
+
+def scan_checkpoints(directory: str, prefix: str = "checkpoint_"):
+    """Rotation-managed checkpoint names in ``directory``, newest first
+    (by iteration number, then name — same ordering the rotator uses)."""
+    if not os.path.isdir(directory):
+        return []
+    names = [
+        name
+        for name in os.listdir(directory)
+        if name.startswith(prefix)
+        and name.endswith(_CKPT_SUFFIXES)
+        and _iteration(name, prefix) is not None
+    ]
+    names.sort(key=lambda n: (_iteration(n, prefix), n), reverse=True)
+    return names
+
+
+def find_latest_checkpoint(
+    directory: str, prefix: str = "checkpoint_", report=None
+) -> Optional[str]:
+    """Path of the newest checkpoint in ``directory`` that passes CRC
+    verification, or ``None``. ``report`` (default: stderr print)
+    receives one message per skipped corrupt candidate."""
+    if report is None:
+        def report(msg):
+            print(msg, file=sys.stderr)
+
+    for name in scan_checkpoints(directory, prefix):
+        path = os.path.join(directory, name)
+        try:
+            io_utils.verify_checkpoint(path)
+        except (IOError, OSError, ValueError) as err:
+            report(
+                f"--resume auto: skipping corrupt checkpoint {path}: {err}"
+            )
+            continue
+        return path
+    return None

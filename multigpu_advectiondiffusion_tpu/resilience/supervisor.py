@@ -1,0 +1,212 @@
+"""Supervised run loop: chunked execution with divergence sentinels,
+rollback-and-retry under a dt/CFL backoff schedule, periodic
+checkpointing and preemption-aware early exit.
+
+The loop wraps the solvers' own ``run``/``advance_to`` drivers in
+cadence-sized chunks, so every chunk still executes at the engaged
+rung's full speed (the whole-run slab stepper runs one Pallas program
+per chunk); the supervisor adds one health probe per cadence and a
+host-side copy of the last known-good state for rollback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+from multigpu_advectiondiffusion_tpu.resilience.errors import (
+    SolverDivergedError,
+)
+from multigpu_advectiondiffusion_tpu.resilience.sentinel import (
+    DivergenceSentinel,
+)
+
+
+@dataclasses.dataclass
+class SupervisorReport:
+    """What happened while supervising — lands in ``RunSummary``."""
+
+    sentinel_every: int = 0
+    probes: int = 0
+    retries: int = 0
+    events: List[dict] = dataclasses.field(default_factory=list)
+    preempted: bool = False
+    final_norm: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def scale_dt(solver, factor: float) -> str:
+    """Back off the solver's time step by ``factor``: the fixed ``dt``
+    when the solver has one, else the CFL number of an adaptive-dt
+    config. Compiled programs and fused-stepper instances bake dt in, so
+    the solver's cache is dropped — the next chunk recompiles at the
+    reduced step. Returns a description of what changed."""
+    if getattr(solver, "dt", None) is not None:
+        solver.dt = float(solver.dt) * factor
+        what = f"dt -> {solver.dt:.6g}"
+    elif hasattr(solver.cfg, "cfl"):
+        solver.cfg = dataclasses.replace(
+            solver.cfg, cfl=float(solver.cfg.cfl) * factor
+        )
+        what = f"cfl -> {solver.cfg.cfl:.6g}"
+    else:
+        raise ValueError(
+            "solver exposes neither a fixed dt nor a cfl to back off"
+        )
+    solver._cache.clear()
+    return what
+
+
+def supervise_run(
+    solver,
+    state,
+    iters: Optional[int] = None,
+    t_end: Optional[float] = None,
+    sentinel_every: int = 0,
+    growth: float = 1e3,
+    max_retries: int = 3,
+    dt_backoff: float = 0.5,
+    checkpoint_every: int = 0,
+    save_checkpoint: Optional[Callable] = None,
+    should_stop: Optional[Callable[[], bool]] = None,
+):
+    """Run to ``iters`` steps or simulated time ``t_end`` under
+    supervision; returns ``(final_state, SupervisorReport)``.
+
+    * every ``sentinel_every`` steps the health probe runs; a non-finite
+      field or norm-growth violation raises
+      :class:`SolverDivergedError`, the loop rolls the state back to the
+      last good checkpoint and retries with dt (or CFL) scaled by
+      ``dt_backoff`` — at most ``max_retries`` times, every event
+      recorded in the report;
+    * every ``checkpoint_every`` steps ``save_checkpoint(state)`` runs
+      (disk persistence is the caller's policy) and the in-memory
+      rollback point advances;
+    * ``should_stop()`` (the preemption guard) is consulted between
+      chunks; a True ends the loop early with ``report.preempted``.
+
+    ``iters`` mode executes exactly ``iters`` steps regardless of
+    backoffs (the reference drivers' fixed-count mode); ``t_end`` mode
+    lands on the same simulated time whatever dt the backoff schedule
+    settled on — the mode to use when a retried run must reproduce the
+    un-faulted answer.
+    """
+    if (iters is None) == (t_end is None):
+        raise ValueError("provide exactly one of iters/t_end")
+    report = SupervisorReport(sentinel_every=int(sentinel_every))
+    sentinel = None
+    if sentinel_every:
+        sentinel = DivergenceSentinel(solver, growth=growth)
+        sentinel.arm(state)
+
+    last_good = state
+    start_it = int(state.it)
+    last_ckpt_it = start_it
+
+    def _after_chunk(nxt, probe_due: bool):
+        """Sentinel + checkpoint bookkeeping; returns the accepted state
+        or raises SolverDivergedError for the retry handler."""
+        nonlocal last_good, last_ckpt_it
+        if sentinel is not None and probe_due:
+            report.probes += 1
+            report.final_norm = sentinel.check(nxt)
+        if checkpoint_every and (
+            int(nxt.it) - last_ckpt_it >= checkpoint_every
+        ):
+            if save_checkpoint is not None:
+                save_checkpoint(nxt)
+            last_ckpt_it = int(nxt.it)
+            last_good = nxt
+        elif sentinel is not None and probe_due and not checkpoint_every:
+            # no checkpoint cadence: every probed-good state is the
+            # rollback point (in-memory checkpointing)
+            last_good = nxt
+        return nxt
+
+    def _recover(err: SolverDivergedError):
+        nonlocal last_good
+        report.retries += 1
+        if report.retries > max_retries:
+            raise err
+        action = scale_dt(solver, dt_backoff)
+        report.events.append({
+            "step": err.step,
+            "t": err.t,
+            "norm": err.norm,
+            "reason": err.reason,
+            "rollback_to_it": int(last_good.it),
+            "action": action,
+        })
+        if sentinel is not None:
+            sentinel.arm(last_good)
+        return last_good
+
+    cadences = [c for c in (sentinel_every, checkpoint_every) if c]
+    if iters is not None:
+        target_it = start_it + int(iters)
+        chunk = min(cadences) if cadences else int(iters)
+        while int(state.it) < target_it:
+            if should_stop is not None and should_stop():
+                report.preempted = True
+                break
+            n = min(chunk, target_it - int(state.it))
+            try:
+                nxt = solver.run(state, n)
+                done = int(nxt.it) - start_it
+                probe_due = bool(sentinel_every) and (
+                    done % sentinel_every == 0 or int(nxt.it) >= target_it
+                )
+                state = _after_chunk(nxt, probe_due=probe_due)
+            except SolverDivergedError as err:
+                state = _recover(err)
+        return state, report
+
+    import jax.numpy as jnp
+
+    te = float(t_end)
+    # termination tolerance at the STATE's time resolution: state.t is
+    # often f32, and an eps below its ulp would spin this (host-side)
+    # loop forever on the final sub-ulp residual the trimmed device
+    # loop cannot represent
+    res = (
+        float(jnp.finfo(state.t.dtype).eps)
+        if jnp.issubdtype(state.t.dtype, jnp.floating)
+        else 0.0
+    )
+    eps = max(1e-12, 4.0 * res) * max(1.0, abs(te))
+    dt_est = getattr(solver, "dt", None)
+    while float(state.t) < te - eps:
+        if should_stop is not None and should_stop():
+            report.preempted = True
+            break
+        if dt_est is None:
+            # adaptive dt with no estimate yet: one step calibrates the
+            # probe window (its cost is one generic step)
+            try:
+                nxt = solver.step(state)
+                dt_est = max(float(nxt.t) - float(state.t), 0.0) or None
+                state = _after_chunk(nxt, probe_due=bool(sentinel_every))
+            except SolverDivergedError as err:
+                state = _recover(err)
+                dt_est = None
+            continue
+        if sentinel_every:
+            tk = min(float(state.t) + sentinel_every * float(dt_est), te)
+        else:
+            tk = te
+        try:
+            nxt = solver.advance_to(state, tk)
+            steps = int(nxt.it) - int(state.it)
+            if steps > 0:
+                dt_est = (float(nxt.t) - float(state.t)) / steps
+            state = _after_chunk(nxt, probe_due=bool(sentinel_every))
+            if steps == 0 and tk >= te:
+                # the device loop can no longer advance toward te (the
+                # remainder is below the time dtype's resolution): done
+                break
+        except SolverDivergedError as err:
+            state = _recover(err)
+            dt_est = getattr(solver, "dt", None)
+    return state, report

@@ -529,10 +529,24 @@ def save_checkpoint_sharded(
         multihost_utils.sync_global_devices(f"ckptd-commit:{directory}")
 
 
+def _shard_desc(e: dict) -> str:
+    """Human identification of one manifest shard entry: file name plus
+    the global index region it covers — every sharded-checkpoint error
+    names the exact shard and offsets so a multi-TB resume failure is
+    actionable without forensics."""
+    stop = [s + n for s, n in zip(e["start"], e["shape"])]
+    region = "x".join(
+        f"[{s}:{t})" for s, t in zip(e["start"], stop)
+    )
+    return f"{e['file']} (global offsets {region})"
+
+
 def _sharded_manifest(directory: str):
     """(meta, entries): the global manifest plus the union of every
     process manifest's shard entries, deduplicated by start offset and
-    validated to tile the global array exactly."""
+    validated to tile the global array exactly. A shard listed by a
+    manifest but absent on disk raises an error naming the missing
+    file(s) and the global offsets they should cover."""
     import glob as _glob
 
     with open(os.path.join(directory, "manifest.json")) as f:
@@ -545,12 +559,24 @@ def _sharded_manifest(directory: str):
                 if key not in seen:
                     seen.add(key)
                     entries.append(e)
+    missing = [
+        e for e in entries
+        if not os.path.exists(os.path.join(directory, e["file"]))
+    ]
+    if missing:
+        raise IOError(
+            f"sharded checkpoint {directory} is missing "
+            f"{len(missing)} shard file(s): "
+            + "; ".join(_shard_desc(e) for e in missing)
+        )
     gshape = tuple(meta["global_shape"])
     cells = sum(int(np.prod(e["shape"])) for e in entries)
     if cells != int(np.prod(gshape)):
         raise IOError(
             f"sharded checkpoint {directory} does not tile the global "
-            f"array: shards cover {cells} cells of {int(np.prod(gshape))}"
+            f"array: shards cover {cells} cells of {int(np.prod(gshape))};"
+            " present shards: "
+            + "; ".join(_shard_desc(e) for e in entries)
         )
     return meta, entries
 
@@ -574,9 +600,18 @@ def _assemble_block(directory, entries, dtype, start, shape, cache=None):
         if cache is not None and e["file"] in cache:
             src_arr = cache[e["file"]]
         else:
-            src_arr = np.asarray(
-                _load_ckpt(os.path.join(directory, e["file"])).u
-            )
+            try:
+                src_arr = np.asarray(
+                    _load_ckpt(os.path.join(directory, e["file"])).u
+                )
+            except (IOError, OSError) as err:
+                # name the exact shard + its global offsets, not a bare
+                # "CRC mismatch" — the one unreadable file of a multi-TB
+                # directory must be identifiable from the error alone
+                raise IOError(
+                    f"sharded checkpoint {directory}: shard "
+                    f"{_shard_desc(e)} is unreadable: {err}"
+                ) from err
             if cache is not None:
                 cache[e["file"]] = src_arr
         src_sl = tuple(
@@ -634,6 +669,55 @@ def load_checkpoint_sharded(directory: str, sharding=None) -> SolverState:
         arrays.append(jax.device_put(block_cache[(start, shape)], dev))
     u = jax.make_array_from_single_device_arrays(gshape, sharding, arrays)
     return SolverState(u=u, t=t, it=it)
+
+
+def verify_checkpoint(path: str) -> None:
+    """Full integrity check without constructing device arrays: header
+    parse + payload CRC32 for ``.ckpt``, archive read for ``.npz``, and
+    for a ``.ckptd`` directory the manifest tiling check plus every
+    shard's CRC (errors name the exact shard file and its global
+    offsets). Raises ``IOError``/``ValueError`` on any defect; the
+    ``--resume auto`` scan (``resilience/recovery.py``) uses this to
+    skip corrupt candidates."""
+    import struct
+    import zlib
+
+    if os.path.isdir(path):
+        _, entries = _sharded_manifest(path)
+        for e in entries:
+            try:
+                verify_checkpoint(os.path.join(path, e["file"]))
+            except (IOError, OSError) as err:
+                raise IOError(
+                    f"sharded checkpoint {path}: shard {_shard_desc(e)} "
+                    f"failed verification: {err}"
+                ) from err
+        return
+    if path.endswith(".npz"):
+        with np.load(path, allow_pickle=False) as z:
+            for key in ("u", "t", "it"):
+                if key not in z:
+                    raise IOError(f"npz checkpoint missing {key!r}: {path}")
+                z[key]  # zip-member CRC is checked on read
+        return
+    with open(path, "rb") as f:
+        header = f.read(64)
+        if len(header) != 64:
+            raise IOError(f"truncated checkpoint header: {path}")
+        (magic, version, code, ndim, s0, s1, s2, s3, _t, _it, crc) = (
+            struct.unpack(_CKPT_STRUCT, header)
+        )
+        if magic != _CKPT_MAGIC or version != _CKPT_VERSION:
+            raise IOError(f"not a framework checkpoint: {path}")
+        if code not in _CKPT_DTYPES or not 1 <= ndim <= 4:
+            raise IOError(f"corrupt checkpoint header: {path}")
+        shape = (s0, s1, s2, s3)[:ndim]
+        nbytes = int(np.prod(shape)) * np.dtype(_CKPT_DTYPES[code]).itemsize
+        payload = f.read(nbytes)
+    if len(payload) != nbytes:
+        raise IOError(f"truncated checkpoint payload: {path}")
+    if zlib.crc32(payload) != crc:
+        raise IOError(f"checkpoint CRC mismatch (corrupt file): {path}")
 
 
 def read_checkpoint_meta(path: str) -> Optional[dict]:

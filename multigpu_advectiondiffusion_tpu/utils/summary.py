@@ -45,6 +45,10 @@ class RunSummary:
     # the what-ran contract of the reference's PrintSummary
     # (MultiGPU/Diffusion3d_Baseline/Tools.c:255-269)
     engaged: Optional[dict] = None
+    # supervised-run facts (resilience.SupervisorReport.to_dict): sentinel
+    # cadence/probes, rollback-retry events, preemption — absent on
+    # unsupervised runs
+    resilience: Optional[dict] = None
 
     @property
     def num_cells(self) -> int:
@@ -89,6 +93,11 @@ class RunSummary:
             print(f" kernel path        : {line}")
             if e.get("fallback"):
                 print(f" fused fallback     : {e['fallback']}")
+            for ev in e.get("degraded") or ():
+                print(
+                    f" ladder degraded    : {ev['from']} -> {ev['to']} "
+                    f"({ev['reason']})"
+                )
         print(f" iterations         : {self.iters} x {self.stages} RK stages")
         print(f" dt (last)          : {self.dt:.6e}")
         print(f" simulated time     : {self.t_final:.6f}")
@@ -97,6 +106,22 @@ class RunSummary:
         print(f" wall time          : {self.seconds:.4f} s")
         if self.io_seconds is not None:
             print(f" I/O time (excl.)   : {self.io_seconds:.4f} s")
+        if self.resilience is not None:
+            r = self.resilience
+            line = (
+                f"probes={r.get('probes', 0)} "
+                f"(every {r.get('sentinel_every', 0)} steps), "
+                f"retries={r.get('retries', 0)}"
+            )
+            if r.get("preempted"):
+                line += ", PREEMPTED"
+            print(f" resilience         : {line}")
+            for ev in r.get("events") or ():
+                print(
+                    f"   rollback         : step {ev['step']} "
+                    f"({ev['reason']}) -> it={ev['rollback_to_it']}, "
+                    f"{ev['action']}"
+                )
         print(f" MLUPS              : {self.mlups:.1f}")
         print(f" GFLOPS (ref conv.) : {self.gflops:.3f}")
         if self.error_l1 is not None:

@@ -1,0 +1,92 @@
+"""Shared TPU-AOT plumbing for the Mosaic schedule proofs.
+
+The AOT tests (``test_overlap_schedule.py``) compile against a virtual
+v5e topology — no chips needed, but the TPU compiler plugin must
+initialize, and it serializes on ``/tmp/libtpu_lockfile``. A previous
+process that died holding the lock leaves a *stale* lockfile behind;
+libtpu then fails to initialize and the proofs used to silently skip —
+the flake VERDICT weak #7 called out. Two fixes here:
+
+* **repair**: before giving up, probe the lockfile with a non-blocking
+  ``flock`` — if no live process holds it, the file is stale; remove it
+  and retry the topology fetch once;
+* **strict mode**: ``TPUCFD_STRICT_AOT=1`` turns every remaining skip
+  into a hard failure — the env flag TPU sessions set to assert zero
+  AOT skips (a skipped schedule proof on a rig that *should* compile is
+  a regression, not an environment quirk).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+LIBTPU_LOCKFILE = "/tmp/libtpu_lockfile"
+STRICT_ENV = "TPUCFD_STRICT_AOT"
+
+
+def strict_aot() -> bool:
+    return os.environ.get(STRICT_ENV, "") == "1"
+
+
+def aot_unavailable(reason: str):
+    """Skip the test — or, under ``TPUCFD_STRICT_AOT=1``, fail it."""
+    if strict_aot():
+        pytest.fail(
+            f"{STRICT_ENV}=1 forbids AOT skips, but: {reason}"
+        )
+    pytest.skip(reason)
+
+
+def _lockfile_is_stale(path: str = LIBTPU_LOCKFILE) -> bool:
+    """True when the libtpu lockfile exists but no live process holds
+    its flock (the holder died) — safe to remove and retry."""
+    import fcntl
+
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError:
+        return False
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            return False  # a live process holds the lock: not stale
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return True
+    finally:
+        os.close(fd)
+
+
+def repair_stale_libtpu_lock(path: str = LIBTPU_LOCKFILE) -> bool:
+    """Remove a stale libtpu lockfile; True when a repair happened."""
+    if os.path.exists(path) and _lockfile_is_stale(path):
+        try:
+            os.remove(path)
+            return True
+        except OSError:
+            pass
+    return False
+
+
+def get_aot_topology(name: str = "v5e:2x2"):
+    """The AOT topology descriptor, with one stale-lockfile repair +
+    retry. Skips (or fails, under strict mode) when the TPU compiler
+    plugin is genuinely unavailable in this environment."""
+    try:
+        from jax.experimental import topologies
+    except ImportError as e:
+        aot_unavailable(f"TPU AOT topology unavailable: {type(e).__name__}")
+    err = None
+    for attempt in (0, 1):
+        try:
+            return topologies.get_topology_desc(name, "tpu")
+        except Exception as e:  # no plugin, or a poisoned lockfile
+            err = e
+            if attempt == 0 and repair_stale_libtpu_lock():
+                continue  # repaired: one retry
+            break
+    aot_unavailable(
+        f"TPU AOT topology unavailable: {type(err).__name__}: {err}"
+    )

@@ -29,6 +29,8 @@ import re
 import numpy as np
 import pytest
 
+import aot_utils
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import enable_x64
@@ -103,12 +105,7 @@ def test_split_overlap_tpu_schedule_hides_collectives():
     4-chip v5e topology and read the overlap out of the compiled
     module's schedule: compute fusions must sit between a
     ``collective-permute-start`` and its ``collective-permute-done``."""
-    try:
-        from jax.experimental import topologies
-
-        topo = topologies.get_topology_desc("v5e:2x2", "tpu")
-    except Exception as e:  # no TPU compiler plugin in this environment
-        pytest.skip(f"TPU AOT topology unavailable: {type(e).__name__}")
+    topo = aot_utils.get_aot_topology("v5e:2x2")
 
     from jax.sharding import Mesh
 
@@ -150,12 +147,7 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
     what the reference's five-stream choreography exists for
     (MultiGPU/Diffusion3d_Baseline/main.c:203-260, Kernels.cu:207-261).
     """
-    try:
-        from jax.experimental import topologies
-
-        topo = topologies.get_topology_desc("v5e:2x2", "tpu")
-    except Exception as e:  # no TPU compiler plugin in this environment
-        pytest.skip(f"TPU AOT topology unavailable: {type(e).__name__}")
+    topo = aot_utils.get_aot_topology("v5e:2x2")
 
     from jax.sharding import Mesh
 
@@ -251,7 +243,9 @@ def test_fused_split_overlap_tpu_schedule_hides_collectives(
         try:
             txt = f.lower(u, t).compile().as_text()
         except Exception as e:  # Mosaic AOT unavailable on this rig
-            pytest.skip(f"Mosaic AOT compile unavailable: {type(e).__name__}")
+            aot_utils.aot_unavailable(
+                f"Mosaic AOT compile unavailable: {type(e).__name__}: {e}"
+            )
 
     events = _schedule_events(
         txt, extra=[(r"= .*custom-call.*tpu_custom_call", "kernel")]
@@ -284,12 +278,7 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
     overlap='split' the compiled schedule must place a stage kernel
     inside a collective-permute window — the ghost-independent interior
     band actually hides the exchange."""
-    try:
-        from jax.experimental import topologies
-
-        topo = topologies.get_topology_desc("v5e:2x2", "tpu")
-    except Exception as e:  # no TPU compiler plugin in this environment
-        pytest.skip(f"TPU AOT topology unavailable: {type(e).__name__}")
+    topo = aot_utils.get_aot_topology("v5e:2x2")
 
     from jax.sharding import Mesh
 
@@ -349,7 +338,9 @@ def test_fused2d_sharded_mosaic_aot_compiles(monkeypatch, model, overlap):
         try:
             txt = f.lower(u, t).compile().as_text()
         except Exception as e:  # Mosaic AOT unavailable on this rig
-            pytest.skip(f"Mosaic AOT compile unavailable: {type(e).__name__}")
+            aot_utils.aot_unavailable(
+                f"Mosaic AOT compile unavailable: {type(e).__name__}: {e}"
+            )
 
     assert "tpu_custom_call" in txt, "stage kernels did not lower via Mosaic"
     assert "collective-permute" in txt, "ghost refresh lost its ppermute"
@@ -373,12 +364,7 @@ def test_fused_slab_run_mosaic_aot_compiles(monkeypatch, model):
     compile through the real Mosaic pipeline for a v5e target — the
     interpret-mode suite can't catch Mosaic-only rejections of the
     dynamically-indexed stacked-buffer DMAs."""
-    try:
-        from jax.experimental import topologies
-
-        topo = topologies.get_topology_desc("v5e:2x2", "tpu")
-    except Exception as e:  # no TPU compiler plugin in this environment
-        pytest.skip(f"TPU AOT topology unavailable: {type(e).__name__}")
+    topo = aot_utils.get_aot_topology("v5e:2x2")
 
     from multigpu_advectiondiffusion_tpu import BurgersConfig, BurgersSolver
     from multigpu_advectiondiffusion_tpu.ops.pallas import (
@@ -420,6 +406,8 @@ def test_fused_slab_run_mosaic_aot_compiles(monkeypatch, model):
         try:
             txt = jax.jit(block).lower(u, t).compile().as_text()
         except Exception as e:  # Mosaic AOT unavailable on this rig
-            pytest.skip(f"Mosaic AOT compile unavailable: {type(e).__name__}")
+            aot_utils.aot_unavailable(
+                f"Mosaic AOT compile unavailable: {type(e).__name__}: {e}"
+            )
 
     assert "tpu_custom_call" in txt, "slab kernel did not lower via Mosaic"

@@ -1,0 +1,463 @@
+"""Fault-injection resilience suite (`faults` marker, tier-1, CPU-only).
+
+Every failure mode the resilience subsystem claims to survive is
+injected here through ``resilience/faults.py`` and the recovery proven
+end-to-end: NaN divergence -> rollback + dt-backoff retry reproducing
+the un-faulted answer; Mosaic dispatch failure -> kernel-ladder
+degradation (auto completes on XLA with the downgrade recorded, pins
+fail loudly); checkpoint corruption/truncation -> ``--resume auto``
+skips to the previous CRC-valid file; shard-level corruption -> errors
+naming the exact shard and global offsets; SIGTERM -> final CRC-valid
+checkpoint + manifest + exit code 75 (subprocess-tested).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu import (
+    BurgersConfig,
+    BurgersSolver,
+    DiffusionConfig,
+    DiffusionSolver,
+    Grid,
+)
+from multigpu_advectiondiffusion_tpu.cli.__main__ import main as cli_main
+from multigpu_advectiondiffusion_tpu.parallel.mesh import Decomposition
+from multigpu_advectiondiffusion_tpu.resilience import (
+    EXIT_PREEMPTED,
+    DivergenceSentinel,
+    PreemptionGuard,
+    SimulatedMosaicError,
+    SolverDivergedError,
+    faults,
+    find_latest_checkpoint,
+    supervise_run,
+)
+from multigpu_advectiondiffusion_tpu.utils import io as io_utils
+from multigpu_advectiondiffusion_tpu.utils.io import load_binary
+
+pytestmark = pytest.mark.faults
+
+
+def _diffusion2d(**kw):
+    cfg = DiffusionConfig(
+        grid=Grid.make(16, 12, lengths=4.0), dtype="float32", **kw
+    )
+    return DiffusionSolver(cfg)
+
+
+# --------------------------------------------------------------------- #
+# Divergence sentinel
+# --------------------------------------------------------------------- #
+def test_sentinel_raises_structured_error():
+    solver = _diffusion2d()
+    state = solver.initial_state()
+    sentinel = DivergenceSentinel(solver, growth=1e3)
+    sentinel.arm(state)
+    assert sentinel.check(state) > 0.0  # healthy state passes
+
+    bad = type(state)(
+        u=state.u.at[4, 4].set(jnp.nan), t=state.t, it=state.it
+    )
+    with pytest.raises(SolverDivergedError) as ei:
+        sentinel.check(bad)
+    err = ei.value
+    assert err.step == int(state.it)
+    assert err.t == pytest.approx(float(state.t))
+    assert not np.isfinite(err.norm)
+    assert "diverged" in str(err)
+
+
+def test_sentinel_norm_growth_bound():
+    solver = _diffusion2d()
+    state = solver.initial_state()
+    sentinel = DivergenceSentinel(solver, growth=2.0)
+    sentinel.arm(state)
+    grown = type(state)(u=state.u * 100.0, t=state.t, it=state.it)
+    with pytest.raises(SolverDivergedError, match="growth bound"):
+        sentinel.check(grown)
+
+
+def test_sentinel_is_mesh_aware(devices):
+    """The probe's pmax rides the solver's own mesh machinery: a NaN on
+    ONE shard must surface in the replicated probe value."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices[:2]), ("dy",))
+    cfg = DiffusionConfig(grid=Grid.make(16, 12, lengths=4.0),
+                          dtype="float32")
+    solver = DiffusionSolver(cfg, mesh=mesh,
+                             decomp=Decomposition.of({0: "dy"}))
+    state = solver.initial_state()
+    sentinel = DivergenceSentinel(solver)
+    sentinel.arm(state)
+    bad_u = state.u.at[1, 1].set(jnp.nan)  # lives on the first shard
+    with pytest.raises(SolverDivergedError):
+        sentinel.check(type(state)(u=bad_u, t=state.t, it=state.it))
+
+
+# --------------------------------------------------------------------- #
+# Rollback-and-retry (acceptance a)
+# --------------------------------------------------------------------- #
+def test_nan_rollback_retry_matches_unfaulted_diffusion():
+    baseline = _diffusion2d()
+    st = baseline.initial_state()
+    t_end = 30 * baseline.dt
+    ref = baseline.advance_to(st, t_end)
+
+    solver = _diffusion2d()
+    state = solver.initial_state()
+    with faults.nan_at_step(solver, 6):  # transient blow-up at step 6
+        out, report = supervise_run(
+            solver, state, t_end=t_end, sentinel_every=3,
+            max_retries=2, dt_backoff=0.5,
+        )
+    assert report.retries == 1
+    assert report.events and report.events[0]["reason"] == "non-finite field"
+    assert "dt" in report.events[0]["action"]
+    assert float(out.t) == pytest.approx(float(ref.t), rel=1e-6)
+    assert bool(jnp.isfinite(out.u).all())
+    # halved dt after the rollback: same physics to temporal-error tol
+    np.testing.assert_allclose(
+        np.asarray(out.u), np.asarray(ref.u), atol=2e-3
+    )
+
+
+def test_nan_rollback_retry_shock_oracle():
+    """The shock-physics gate as recovery oracle: after a NaN fault,
+    rollback + dt backoff must still land the 1-D Burgers Riemann shock
+    within one cell of the exact speed (uL+uR)/2 (same tolerance as
+    tests/test_shock.py)."""
+    grid = Grid.make(200, lengths=2.0)
+    cfg = BurgersConfig(grid=grid, ic="riemann", bc="edge", weno_order=5,
+                        adaptive_dt=False, cfl=0.4, dtype="float32")
+    solver = BurgersSolver(cfg)
+    state = solver.initial_state()
+    t_end = 100 * solver.dt
+    with faults.nan_at_step(solver, 30):
+        out, report = supervise_run(
+            solver, state, t_end=t_end, sentinel_every=10,
+            max_retries=3, dt_backoff=0.5,
+        )
+    assert report.retries == 1
+    x = np.asarray(grid.coords(0, jnp.float32))
+    u = np.asarray(out.u)
+    j = int(np.argmax(u < 1.5))
+    frac = (u[j - 1] - 1.5) / max(u[j - 1] - u[j], 1e-12)
+    x_shock = x[j - 1] + frac * (x[j] - x[j - 1])
+    exact = 1.5 * float(out.t)  # (uL+uR)/2 with uL=2, uR=1, x0=0
+    assert abs(x_shock - exact) <= grid.spacing[0]
+
+
+def test_persistent_fault_exhausts_retries():
+    solver = _diffusion2d()
+    state = solver.initial_state()
+    with faults.nan_at_step(solver, 4, once=False):
+        with pytest.raises(SolverDivergedError):
+            supervise_run(
+                solver, state, iters=20, sentinel_every=2,
+                max_retries=2, dt_backoff=0.5,
+            )
+
+
+def test_supervised_iters_mode_executes_exact_count():
+    solver = _diffusion2d()
+    state = solver.initial_state()
+    with faults.nan_at_step(solver, 4):
+        out, report = supervise_run(
+            solver, state, iters=12, sentinel_every=2,
+            max_retries=2, dt_backoff=0.5,
+        )
+    assert int(out.it) == 12
+    assert report.retries == 1
+    assert bool(jnp.isfinite(out.u).all())
+
+
+# --------------------------------------------------------------------- #
+# Kernel-ladder degradation (acceptance c)
+# --------------------------------------------------------------------- #
+def test_mosaic_failure_auto_degrades_to_xla():
+    """impl='pallas' + simulated Mosaic failure at every fused rung:
+    the run completes on XLA and the downgrade chain is recorded in
+    engaged_path()['degraded'] (slab -> stage -> xla)."""
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    )
+    assert solver.engaged_path()["stepper"].startswith("fused")
+    state = solver.initial_state()
+    with faults.mosaic_failure():
+        out = solver.run(state, 2)
+    assert bool(jnp.isfinite(out.u).all())
+    engaged = solver.engaged_path()
+    assert engaged["stepper"] == "generic-xla"
+    assert engaged["impl"] == "pallas"  # the REQUESTED impl is reported
+    chain = [(e["from"], e["to"]) for e in engaged["degraded"]]
+    assert chain[-1][1] == "xla"
+    assert all("Mosaic" in e["reason"] for e in engaged["degraded"])
+
+
+def test_mosaic_failure_explicit_pin_raises():
+    """An explicit rung pin must fail loudly, not degrade."""
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    for impl in ("pallas_stage", "pallas_slab"):
+        solver = DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype="float32", impl=impl)
+        )
+        state = solver.initial_state()
+        with faults.mosaic_failure():
+            with pytest.raises(SimulatedMosaicError):
+                solver.run(state, 2)
+        assert not solver._degrade_events
+
+
+def test_degradation_matches_unfaulted_answer():
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    ref_solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="xla")
+    )
+    ref = ref_solver.run(ref_solver.initial_state(), 3)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    )
+    with faults.mosaic_failure():
+        out = solver.run(solver.initial_state(), 3)
+    np.testing.assert_allclose(
+        np.asarray(out.u), np.asarray(ref.u), atol=1e-6
+    )
+
+
+def test_unknown_impl_rejected_at_construction():
+    with pytest.raises(ValueError, match="unknown impl"):
+        DiffusionConfig(grid=Grid.make(8, 8, lengths=2.0), impl="palas")
+    with pytest.raises(ValueError, match="unknown impl"):
+        BurgersConfig(grid=Grid.make(8, lengths=2.0), impl="cuda")
+
+
+# --------------------------------------------------------------------- #
+# Checkpoint corruption + --resume auto (acceptance b)
+# --------------------------------------------------------------------- #
+def test_resume_auto_skips_corrupt_newest(tmp_path):
+    full = tmp_path / "full"
+    run = tmp_path / "run"
+    args = ["diffusion2d", "--n", "16", "12"]
+    cli_main(args + ["--iters", "12", "--save", str(full)])
+    cli_main(args + ["--iters", "8", "--save", str(run),
+                     "--checkpoint-every", "2"])
+    faults.corrupt_checkpoint(str(run / "checkpoint_000008.ckpt"))
+    picked = find_latest_checkpoint(str(run))
+    assert picked == str(run / "checkpoint_000006.ckpt")
+    # resume auto continues from it=6 -> 6 more iters reproduces the
+    # uninterrupted 12-iter run exactly (same fixed-dt trajectory)
+    cli_main(args + ["--iters", "6", "--save", str(run),
+                     "--resume", "auto"])
+    a = load_binary(str(full / "result.bin"), (12, 16))
+    b = load_binary(str(run / "result.bin"), (12, 16))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_resume_auto_skips_truncated_and_nonnumeric(tmp_path):
+    run = tmp_path / "run"
+    cli_main(["diffusion2d", "--n", "16", "12", "--iters", "4",
+              "--save", str(run), "--checkpoint-every", "2"])
+    faults.truncate_checkpoint(str(run / "checkpoint_000004.ckpt"))
+    # a user file must never be auto-selected even when newest
+    (run / "checkpoint_best.ckpt").write_bytes(b"not a checkpoint")
+    picked = find_latest_checkpoint(str(run))
+    assert picked == str(run / "checkpoint_000002.ckpt")
+
+
+def test_resume_auto_empty_dir_starts_fresh(tmp_path):
+    run = tmp_path / "run"
+    cli_main(["diffusion2d", "--n", "16", "12", "--iters", "2",
+              "--save", str(run), "--resume", "auto"])
+    summary = json.loads((run / "summary.json").read_text())
+    assert summary["iters"] == 2
+
+
+def test_verify_checkpoint_catches_truncation(tmp_path):
+    run = tmp_path / "run"
+    cli_main(["diffusion2d", "--n", "16", "12", "--iters", "2",
+              "--save", str(run), "--checkpoint-every", "2"])
+    path = str(run / "checkpoint_000002.ckpt")
+    io_utils.verify_checkpoint(path)  # pristine passes
+    faults.truncate_checkpoint(path, keep_bytes=48)
+    with pytest.raises(IOError, match="truncated"):
+        io_utils.verify_checkpoint(path)
+
+
+# --------------------------------------------------------------------- #
+# Sharded-checkpoint error reporting (satellite)
+# --------------------------------------------------------------------- #
+def _sharded_state(devices, tmp_path):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from multigpu_advectiondiffusion_tpu.models.state import SolverState
+
+    mesh = Mesh(np.asarray(devices[:2]), ("dy",))
+    sharding = NamedSharding(mesh, P("dy", None))
+    u = jax.device_put(
+        jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8), sharding
+    )
+    state = SolverState(u=u, t=jnp.asarray(0.5), it=jnp.asarray(4))
+    d = str(tmp_path / "state.ckptd")
+    io_utils.save_checkpoint_sharded(d, state)
+    shard_files = sorted(
+        n for n in os.listdir(d) if n.startswith("shard_")
+    )
+    assert len(shard_files) == 2
+    return d, shard_files
+
+
+def test_ckptd_corrupt_shard_names_file_and_offsets(devices, tmp_path):
+    d, shard_files = _sharded_state(devices, tmp_path)
+    victim = shard_files[-1]  # the z>=8 block
+    faults.corrupt_checkpoint(os.path.join(d, victim))
+    with pytest.raises(IOError) as ei:
+        io_utils.load_checkpoint(d)
+    msg = str(ei.value)
+    assert victim in msg, "error must name the exact shard file"
+    assert "global offsets" in msg and "[8:16)" in msg
+    with pytest.raises(IOError, match="global offsets"):
+        io_utils.verify_checkpoint(d)
+
+
+def test_ckptd_missing_shard_lists_absent_offsets(devices, tmp_path):
+    d, shard_files = _sharded_state(devices, tmp_path)
+    victim = shard_files[0]
+    os.remove(os.path.join(d, victim))
+    with pytest.raises(IOError) as ei:
+        io_utils.load_checkpoint(d)
+    msg = str(ei.value)
+    assert "missing" in msg and victim in msg
+    assert "[0:8)" in msg, "error must list the absent global offsets"
+
+
+# --------------------------------------------------------------------- #
+# Preemption (acceptance d)
+# --------------------------------------------------------------------- #
+def test_preemption_guard_latches_signal():
+    with PreemptionGuard(signals=(signal.SIGTERM,)) as guard:
+        assert not guard.should_stop
+        faults.send_signal()  # SIGTERM to self; handler latches it
+        time.sleep(0.01)
+        assert guard.should_stop
+        assert guard.signum == signal.SIGTERM
+    # handlers restored on exit: a fresh guard starts clean
+    with PreemptionGuard(signals=(signal.SIGTERM,)) as guard2:
+        assert not guard2.should_stop
+
+
+def test_sigterm_mid_run_checkpoints_and_exits_75(tmp_path):
+    """A SIGTERM sent to the CLI mid-run must produce a loadable,
+    CRC-valid final checkpoint, a preempt.json manifest, and the
+    documented exit code (75) — driven through a real subprocess so the
+    whole signal -> chunk-boundary -> atomic-write -> exit path runs."""
+    out_dir = tmp_path / "run"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multigpu_advectiondiffusion_tpu.cli",
+         "diffusion2d", "--n", "16", "12", "--iters", "2000000",
+         "--save", str(out_dir), "--checkpoint-every", "50",
+         "--checkpoint-keep", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if out_dir.is_dir() and any(
+                n.endswith(".ckpt") for n in os.listdir(out_dir)
+            ):
+                break  # compile finished, chunked loop is running
+            if proc.poll() is not None:
+                pytest.fail(
+                    "CLI exited before any checkpoint: "
+                    + proc.stdout.read()[-2000:]
+                )
+            time.sleep(0.2)
+        else:
+            pytest.fail("no checkpoint appeared within 120 s")
+        proc.send_signal(signal.SIGTERM)
+        stdout, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == EXIT_PREEMPTED, stdout[-2000:]
+
+    manifest = json.loads((out_dir / "preempt.json").read_text())
+    assert manifest["signal"] == int(signal.SIGTERM)
+    assert manifest["exit_code"] == EXIT_PREEMPTED
+    ckpt = manifest["checkpoint"]
+    io_utils.verify_checkpoint(ckpt)  # CRC-valid
+    st = io_utils.load_checkpoint(ckpt)  # and loadable
+    assert int(st.it) == manifest["iteration"] > 0
+    # the preemption checkpoint is what --resume auto picks up
+    assert find_latest_checkpoint(str(out_dir)) == ckpt
+
+
+# --------------------------------------------------------------------- #
+# Supervised CLI summary + distributed-init retry (satellites)
+# --------------------------------------------------------------------- #
+def test_cli_sentinel_records_resilience_in_summary(tmp_path):
+    run = tmp_path / "run"
+    cli_main(["diffusion2d", "--n", "16", "12", "--iters", "6",
+              "--save", str(run), "--sentinel-every", "2"])
+    summary = json.loads((run / "summary.json").read_text())
+    res = summary["resilience"]
+    assert res["sentinel_every"] == 2
+    assert res["probes"] >= 3
+    assert res["retries"] == 0 and not res["preempted"]
+
+
+def test_multihost_initialize_retries_with_backoff(monkeypatch):
+    from multigpu_advectiondiffusion_tpu.parallel import multihost
+
+    calls = {"n": 0}
+
+    def flaky(**kwargs):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("coordinator not reachable yet")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    multihost.initialize(
+        coordinator_address="localhost:1234", num_processes=1,
+        process_id=0, attempts=3, backoff_seconds=0.0,
+    )
+    assert calls["n"] == 3
+
+    def always_down(**kwargs):
+        calls["n"] += 1
+        raise RuntimeError("connection refused")
+
+    calls["n"] = 0
+    monkeypatch.setattr(jax.distributed, "initialize", always_down)
+    with pytest.raises(RuntimeError, match="after 2 attempt"):
+        multihost.initialize(
+            coordinator_address="localhost:1234", num_processes=1,
+            process_id=0, attempts=2, backoff_seconds=0.0,
+        )
+    assert calls["n"] == 2
+
+    def already(**kwargs):
+        raise RuntimeError("jax.distributed is already initialized")
+
+    monkeypatch.setattr(jax.distributed, "initialize", already)
+    multihost.initialize(attempts=1)  # idempotent success, no raise

@@ -1,0 +1,95 @@
+"""Shock-physics golden gate (VERDICT next-round #6).
+
+The accuracy suite checks smooth-solution convergence orders; nothing
+so far pinned the *nonlinear* physics. This gate does: a 1-D inviscid
+Burgers Riemann problem (uL=2, uR=1, jump at x=0 — the `riemann` IC's
+defaults) has the exact entropy solution of a single shock travelling
+at s = (uL+uR)/2 = 1.5. After O(100) fixed-dt SSP-RK3 steps the
+numerically-located shock must sit within ONE CELL of x = s*t, at WENO5
+and WENO7, on the generic XLA path and on the fused Pallas steppers
+(whole-run slab and per-stage — run pseudo-1-D on a 3-D grid, the only
+world the fused kernels serve). A conservation bug, a flux-splitting
+sign error, or a WENO-weight regression moves the shock speed and fails
+this gate even when smooth-case OOA stays intact.
+
+``tests/test_resilience.py`` reuses the same tolerance as the
+"correct answer after rollback-retry" oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu import BurgersConfig, BurgersSolver, Grid
+
+UL, UR = 2.0, 1.0  # `riemann` IC defaults: shock speed (uL+uR)/2 = 1.5
+SPEED = 0.5 * (UL + UR)
+MID = SPEED  # u crosses (uL+uR)/2 inside the shock transition
+
+
+def _shock_position(x: np.ndarray, u: np.ndarray) -> float:
+    """x where u crosses the Rankine-Hugoniot midpoint, sub-cell via
+    linear interpolation across the first downward crossing."""
+    j = int(np.argmax(u < MID))
+    assert j > 0, "no shock transition found in the profile"
+    frac = (u[j - 1] - MID) / max(u[j - 1] - u[j], 1e-12)
+    return float(x[j - 1] + frac * (x[j] - x[j - 1]))
+
+
+def _assert_shock_within_one_cell(grid, out, x_axis: int, profile):
+    x = np.asarray(grid.coords(x_axis, jnp.float32))
+    x_shock = _shock_position(x, profile)
+    exact = SPEED * float(out.t)  # jump starts at the domain midpoint 0
+    dx = grid.spacing[x_axis]
+    assert abs(x_shock - exact) <= dx, (
+        f"shock at {x_shock:.5f}, exact {exact:.5f}: off by "
+        f"{abs(x_shock - exact) / dx:.2f} cells"
+    )
+
+
+@pytest.mark.parametrize("order", [5, 7])
+def test_shock_speed_1d_generic(order):
+    grid = Grid.make(200, lengths=2.0)
+    solver = BurgersSolver(
+        BurgersConfig(grid=grid, ic="riemann", bc="edge",
+                      weno_order=order, adaptive_dt=False, cfl=0.4,
+                      dtype="float32")
+    )
+    state = solver.initial_state()
+    out = solver.run(state, 100)  # O(100) steps, t = 100 * 0.4 * dx
+    assert solver.engaged_path()["stepper"] == "generic-xla"
+    _assert_shock_within_one_cell(grid, out, 0, np.asarray(out.u))
+
+
+@pytest.mark.parametrize("order,impl", [(5, "pallas"), (7, "pallas_stage")])
+def test_shock_speed_3d_fused(order, impl):
+    """The fused rungs (whole-run slab via impl='pallas', per-stage via
+    the 'pallas_stage' pin) on a pseudo-1-D 3-D grid: uniform in y/z,
+    Riemann along x — the engaged stepper must be fused (a silent fall
+    to the generic path would void the gate) and the shock speed exact
+    to one cell. Both orders and both fused rungs are covered across
+    the two parametrizations (kept to two so the gate stays cheap in
+    tier-1)."""
+    grid = Grid.make(200, 16, 16, lengths=[2.0, 2.0, 2.0])
+    solver = BurgersSolver(
+        BurgersConfig(grid=grid, ic="riemann", bc="edge",
+                      weno_order=order, adaptive_dt=False, cfl=0.4,
+                      dtype="float32", impl=impl)
+    )
+    engaged = solver.engaged_path()["stepper"]
+    assert engaged.startswith("fused"), (
+        f"expected a fused rung, got {engaged} "
+        f"({getattr(solver, '_fused_fallback', None)})"
+    )
+    state = solver.initial_state()
+    out = solver.run(state, 100)
+    u = np.asarray(out.u)
+    # y/z-uniformity must survive 100 fused steps (edge ghosts + no
+    # transverse flux), so the centerline profile IS the 1-D solution
+    np.testing.assert_allclose(
+        u, np.broadcast_to(u[:1, :1, :], u.shape), atol=1e-5
+    )
+    _assert_shock_within_one_cell(grid, out, 2, u[8, 8, :])
