@@ -278,12 +278,27 @@ def _cases(on_tpu: bool):
 
 
 def main() -> None:
+    import os
+    import sys
+    import tempfile
+
     from multigpu_advectiondiffusion_tpu.utils.platform_env import (
         honor_platform_env,
     )
 
     honor_platform_env()
     import jax
+
+    # telemetry rides every bench run: the stream is the forensic record
+    # an engagement-guard failure prints (see the tail dump below) — a
+    # degraded/fell-back row is diagnosable from the bench output alone.
+    # TPUCFD_BENCH_METRICS overrides the default tempfile destination.
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    metrics_path = os.environ.get("TPUCFD_BENCH_METRICS") or os.path.join(
+        tempfile.gettempdir(), f"bench_telemetry_{os.getpid()}.jsonl"
+    )
+    sink = telemetry.install(metrics_path)
 
     from multigpu_advectiondiffusion_tpu.bench.timing import (
         timed_advance,
@@ -328,6 +343,14 @@ def main() -> None:
         engaged = solver.engaged_path(
             "t_end" if mode == "t_end" else "iters"
         )
+        # roofline efficiency of the measured rate on the engaged rung's
+        # static bytes/FLOPs model (telemetry/costmodel): the row says
+        # how close to the hardware roof it ran, not just how fast
+        from multigpu_advectiondiffusion_tpu.telemetry import costmodel
+
+        cost = costmodel.summarize_run(
+            solver, engaged["stepper"], iters, timing.median_seconds
+        )
         row = {
             "metric": metric,
             "value": round(rate, 2),
@@ -339,6 +362,7 @@ def main() -> None:
             # the artifact keeps the full evidence (ADVICE r4)
             "raw_spread": round(timing.raw_spread, 4),
             "engaged": engaged["stepper"],
+            "roofline_pct": (cost or {}).get("roofline_pct"),
         }
         # engagement guard: a row running on an unexpected (slower)
         # stepper is recorded AND fails the run — a silent fallback to
@@ -369,6 +393,16 @@ def main() -> None:
         print(json.dumps(row), flush=True)
 
     if mismatches:
+        # forensic dump: the tail of the run's telemetry event stream
+        # (dispatch builds, ladder degrades, spans) so a degraded or
+        # fell-back row is diagnosable from the bench artifact alone
+        print(
+            f"engagement guard tripped; last telemetry events "
+            f"(full stream: {metrics_path}):",
+            file=sys.stderr,
+        )
+        for ev in sink.tail(30):
+            print(json.dumps(ev), file=sys.stderr)
         raise SystemExit(
             "engagement guard: unexpected stepper for "
             + ", ".join(mismatches)

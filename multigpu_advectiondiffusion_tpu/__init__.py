@@ -30,6 +30,7 @@ from multigpu_advectiondiffusion_tpu.models.burgers import (
     BurgersSolver,
 )
 from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu import telemetry
 
 __version__ = "0.1.0"
 
@@ -41,5 +42,6 @@ __all__ = [
     "BurgersConfig",
     "BurgersSolver",
     "SolverState",
+    "telemetry",
     "__version__",
 ]

@@ -190,6 +190,10 @@ def run_case(
         cells *= g
     rate = mlups(cells, iters, STAGES[solver.cfg.integrator], best)
     base, src = BASELINES_MLUPS.get(case.name, (None, None))
+    # roofline efficiency on the engaged rung's static bytes/FLOPs model
+    from multigpu_advectiondiffusion_tpu.telemetry import costmodel
+
+    cost = costmodel.summarize_run(solver, engaged["stepper"], iters, best)
     result = {
         "name": case.name,
         "grid": "x".join(map(str, grid_xyz)),
@@ -205,6 +209,7 @@ def run_case(
         "seconds": round(best, 4),
         "compile_seconds": round(compile_s, 3),
         "mlups": round(rate, 1),
+        "roofline_pct": (cost or {}).get("roofline_pct"),
         "quick": quick,
         "mesh": mesh_spec,
     }

@@ -110,6 +110,19 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                    help="capture a jax.profiler device trace of the timed "
                         "solve into DIR (TensorBoard/Perfetto viewable) — "
                         "the nvprof wrapping of profile.sh, TPU-style")
+    p.add_argument("--trace", dest="profile", metavar="DIR",
+                   help="alias for --profile: the captured trace carries "
+                        "the whole rung hierarchy as labeled spans "
+                        "(tpucfd.run[<stepper>], tpucfd.halo_exchange_*, "
+                        "tpucfd.<rung> step bodies) viewable in Perfetto")
+    p.add_argument("--metrics", default=None, metavar="PATH",
+                   help="stream structured telemetry to PATH as JSONL: "
+                        "span/counter events from dispatch and halo "
+                        "exchanges, chunk-cadence physics probes "
+                        "(min/max/L2/mass drift, supervised runs), "
+                        "resilience events (rollbacks, retries, "
+                        "preemption), checkpoint writes — see README "
+                        "'Observability' for the event schema")
     p.add_argument("--impl", default="xla",
                    choices=["xla", "pallas", "pallas_axis", "pallas_step",
                             "pallas_slab", "pallas_stage"],
@@ -191,7 +204,8 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       sentinel_every=args.sentinel_every,
                       sentinel_growth=args.sentinel_growth,
                       max_retries=args.max_retries,
-                      dt_backoff=args.dt_backoff)
+                      dt_backoff=args.dt_backoff,
+                      metrics_path=getattr(args, "metrics", None))
 
 
 def _run_burgers(args, ndim):
@@ -232,7 +246,8 @@ def _run_burgers(args, ndim):
                       sentinel_every=args.sentinel_every,
                       sentinel_growth=args.sentinel_growth,
                       max_retries=args.max_retries,
-                      dt_backoff=args.dt_backoff)
+                      dt_backoff=args.dt_backoff,
+                      metrics_path=getattr(args, "metrics", None))
 
 
 def _run_convergence(args):
@@ -370,6 +385,14 @@ def main(argv=None):
 
     honor_platform_env()
     args = build_parser().parse_args(argv)
+    # telemetry sink BEFORE any distributed/backend work, so the
+    # multihost join's retry loop and every later subsystem stream into
+    # the same --metrics file
+    owned_sink = None
+    if getattr(args, "metrics", None):
+        from multigpu_advectiondiffusion_tpu import telemetry
+
+        owned_sink = telemetry.install(args.metrics)
     if getattr(args, "num_processes", None) is not None or getattr(
         args, "process_id", None
     ) is not None:
@@ -398,7 +421,13 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_enable_x64", True)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    finally:
+        if owned_sink is not None:
+            from multigpu_advectiondiffusion_tpu import telemetry
+
+            telemetry.uninstall(owned_sink)
 
 
 if __name__ == "__main__":

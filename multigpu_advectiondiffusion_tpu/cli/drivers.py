@@ -96,6 +96,33 @@ def physics_meta(solver: SolverBase) -> dict:
 def run_solver(
     solver: SolverBase,
     name: str,
+    *args,
+    metrics_path: Optional[str] = None,
+    **kwargs,
+) -> RunSummary:
+    """Public run driver; see :func:`_run_solver` for the full contract.
+
+    ``metrics_path`` opens a structured-telemetry JSONL sink for the
+    run's duration (the CLI's ``--metrics``); when a sink is already
+    installed (e.g. by ``cli.main`` before the multihost join) it is
+    reused and left alone. The whole run executes under a top-level
+    ``run_solver`` span so every dispatch/physics/resilience/io event is
+    attributable to this run."""
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    with contextlib.ExitStack() as scope:
+        if metrics_path and not telemetry.get_sink().active:
+            sink = telemetry.install(metrics_path)
+            scope.callback(telemetry.uninstall, sink)
+        t_sink = telemetry.get_sink()
+        if t_sink.active:
+            scope.enter_context(t_sink.span("run_solver", run=name))
+        return _run_solver(solver, name, *args, **kwargs)
+
+
+def _run_solver(
+    solver: SolverBase,
+    name: str,
     iters: Optional[int] = None,
     t_end: Optional[float] = None,
     save_dir: Optional[str] = None,
@@ -470,6 +497,29 @@ def run_solver(
         ),
         resilience=sup_report.to_dict() if sup_report is not None else None,
     )
+    # static cost model for the ENGAGED rung: bytes/FLOPs per step and
+    # the roofline efficiency of the measured rate (telemetry/costmodel)
+    from multigpu_advectiondiffusion_tpu.telemetry import costmodel
+
+    summary.cost_model = costmodel.summarize_run(
+        solver, summary.engaged["stepper"], n_iters, best
+    )
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    t_sink = telemetry.get_sink()
+    if t_sink.active:
+        t_sink.event(
+            "summary", name,
+            seconds=round(best, 6),
+            mlups=round(summary.mlups, 3),
+            stepper=summary.engaged["stepper"],
+            roofline_pct=(summary.cost_model or {}).get("roofline_pct"),
+            mass_drift=(
+                summary.resilience.get("mass_drift")
+                if summary.resilience
+                else None
+            ),
+        )
 
     if check_error and hasattr(solver, "error_norms"):
         # gathered first: eager norm arithmetic mixes the state with a

@@ -19,6 +19,7 @@ overlap by hand with five CUDA streams, ``main.c:189-303``).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Callable, Optional, Sequence, Tuple
 
@@ -138,6 +139,21 @@ class SolverBase:
             return None
         return lambda x: lax.pmax(x, names)
 
+    def mesh_reduce_sum(self):
+        """Cross-device sum reduction over the same axis-name set as
+        :meth:`mesh_reduce_max` (the physics probe's mass/L2 integrals
+        must span exactly the shards the divergence probe spans). Must
+        run inside ``shard_map``; ``None`` when unsharded."""
+        if self.mesh is None:
+            return None
+        sizes = dict(self.mesh.shape)
+        names = tuple(
+            n for n in self.decomp.mesh_axis_names() if sizes.get(n, 1) > 1
+        )
+        if not names:
+            return None
+        return lambda x: lax.psum(x, names)
+
     # ------------------------------------------------------------------ #
     # State creation
     # ------------------------------------------------------------------ #
@@ -194,13 +210,16 @@ class SolverBase:
 
     def _local_step(self, u, t, t_end=None):
         """One time step on a (possibly shard-local) block."""
-        phys = self.build_local(self._context(u))
-        dt = phys.dt_fn(u) if phys.dt_fn is not None else phys.static_dt
-        if t_end is not None:
-            dt = jnp.minimum(dt, t_end - t)
-        dt = jnp.asarray(dt, dtype=t.dtype)
-        u = self.integrator(phys.rhs, u, dt.astype(u.dtype), phys.post)
-        return u, t + dt
+        # named_scope: the generic step shows up as one labeled region
+        # in --trace captures, matching the fused steppers' spans
+        with jax.named_scope("tpucfd.step"):
+            phys = self.build_local(self._context(u))
+            dt = phys.dt_fn(u) if phys.dt_fn is not None else phys.static_dt
+            if t_end is not None:
+                dt = jnp.minimum(dt, t_end - t)
+            dt = jnp.asarray(dt, dtype=t.dtype)
+            u = self.integrator(phys.rhs, u, dt.astype(u.dtype), phys.post)
+            return u, t + dt
 
     # ------------------------------------------------------------------ #
     # Execution: wrap a (u, t) -> (u, t) block program for this world
@@ -235,8 +254,47 @@ class SolverBase:
 
     def _compiled(self, key, builder):
         if key not in self._cache:
+            from multigpu_advectiondiffusion_tpu import telemetry
+
+            sink = telemetry.get_sink()
+            if sink.active:
+                # rung-selection record: one event per program the
+                # dispatch layer builds (the compile itself happens at
+                # first call, inside the caller's span)
+                sink.event(
+                    "dispatch", "build",
+                    key=str(key),
+                    impl=getattr(self.cfg, "impl", "xla"),
+                    requested_impl=self._requested_impl,
+                )
             self._cache[key] = builder()
         return self._cache[key]
+
+    def _dispatch_span(self, op: str, mode: str = "iters", **fields):
+        """Context labeling one public driver call with the engaged
+        rung: a ``jax.profiler.TraceAnnotation`` (so ``--trace``
+        captures show ``tpucfd.run[fused-whole-run-slab]``-style spans
+        over the whole rung hierarchy) plus, when a telemetry sink is
+        installed, a structured ``solver.<op>`` span carrying the
+        engaged stepper/impl/overlap."""
+        from multigpu_advectiondiffusion_tpu import telemetry
+        from multigpu_advectiondiffusion_tpu.utils.profiling import annotate
+
+        eng = self.engaged_path(mode=mode)
+        stack = contextlib.ExitStack()
+        stack.enter_context(annotate(f"tpucfd.{op}[{eng['stepper']}]"))
+        sink = telemetry.get_sink()
+        if sink.active:
+            stack.enter_context(
+                sink.span(
+                    f"solver.{op}",
+                    stepper=eng["stepper"],
+                    impl=eng["impl"],
+                    overlap=eng.get("overlap"),
+                    **fields,
+                )
+            )
+        return stack
 
     # ------------------------------------------------------------------ #
     # Graceful kernel-ladder degradation
@@ -281,11 +339,21 @@ class SolverBase:
             if engaged == "fused-whole-run-slab"
             else "xla"
         )
-        self._degrade_events.append({
+        ev = {
             "from": engaged,
             "to": nxt,
             "reason": f"{type(exc).__name__}: {exc}"[:300],
-        })
+        }
+        self._degrade_events.append(ev)
+        from multigpu_advectiondiffusion_tpu import telemetry
+
+        # the downgrade is an attributable event, not just a summary
+        # footnote: the stream shows WHEN the ladder fell and under what
+        # error, ordered against the chunks around it
+        telemetry.event(
+            "ladder", "degrade",
+            **{"from": ev["from"], "to": ev["to"], "reason": ev["reason"]},
+        )
         self.cfg = dataclasses.replace(self.cfg, impl=nxt)
         self._cache.clear()
         return True
@@ -295,9 +363,12 @@ class SolverBase:
     # ------------------------------------------------------------------ #
     def step(self, state: SolverState) -> SolverState:
         def call():
-            f = self._compiled("step", lambda: self._wrap(self._local_step))
-            u, t = f(state.u, state.t)
-            return SolverState(u=u, t=t, it=state.it + 1)
+            with self._dispatch_span("step"):
+                f = self._compiled(
+                    "step", lambda: self._wrap(self._local_step)
+                )
+                u, t = f(state.u, state.t)
+                return SolverState(u=u, t=t, it=state.it + 1)
 
         return self._with_ladder(call)
 
@@ -526,7 +597,11 @@ class SolverBase:
         ``MultiGPU/Diffusion3d_Baseline/main.c:189``). A Mosaic/Pallas
         failure at dispatch under ``impl='pallas'`` retries one kernel-
         ladder rung down (:meth:`_with_ladder`)."""
-        return self._with_ladder(lambda: self._run_impl(state, num_iters))
+        def call():
+            with self._dispatch_span("run", iters=int(num_iters)):
+                return self._run_impl(state, num_iters)
+
+        return self._with_ladder(call)
 
     def _run_impl(self, state: SolverState, num_iters: int) -> SolverState:
         fused = self._fused_stepper()
@@ -572,9 +647,12 @@ class SolverBase:
         stepper's speed — the reference Burgers drivers' *only* execution
         mode is ``while (t < tEnd)`` over the tuned kernels
         (``MultiGPU/Burgers3d_Baseline/main.c:190-317``)."""
-        return self._with_ladder(
-            lambda: self._advance_impl(state, t_end), mode="t_end"
-        )
+        def call():
+            with self._dispatch_span("advance_to", mode="t_end",
+                                     t_end=float(t_end)):
+                return self._advance_impl(state, t_end)
+
+        return self._with_ladder(call, mode="t_end")
 
     def _advance_impl(self, state: SolverState, t_end: float) -> SolverState:
         fused = self._fused_stepper(mode="t_end")

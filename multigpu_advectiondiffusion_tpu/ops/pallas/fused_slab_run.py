@@ -410,7 +410,8 @@ class _SlabRunStepper:
         if num_iters == 0:
             return u, t
         if not self.sharded:
-            S = self._whole_run(self.embed(u), num_iters)
+            with jax.named_scope(f"tpucfd.{self.engaged_label}"):
+                S = self._whole_run(self.embed(u), num_iters)
             return self.extract(S), accumulate_t(t, self.dt, num_iters)
 
         if offsets is None:
@@ -427,10 +428,17 @@ class _SlabRunStepper:
             interior, bottom, top = self._calls
 
             def body(it, carry):
+                # named_scope: the split-overlap schedule's pieces are
+                # separately labeled in --trace captures — the exchanged
+                # G-slabs next to the interior call they overlap with
                 S, T = carry
-                lo, hi = exch(S)
-                T = top(offsets, S, hi,
-                        bottom(offsets, S, lo, interior(offsets, S, T)))
+                with jax.named_scope("tpucfd.slab_split_exchange"):
+                    lo, hi = exch(S)
+                with jax.named_scope(
+                    f"tpucfd.{self.engaged_label}[split]"
+                ):
+                    T = top(offsets, S, hi,
+                            bottom(offsets, S, lo, interior(offsets, S, T)))
                 return T, S
 
         else:
@@ -438,8 +446,10 @@ class _SlabRunStepper:
 
             def body(it, carry):
                 S, T = carry
-                S = refresh(S)
-                T = full(offsets, S, T)
+                with jax.named_scope("tpucfd.slab_ghost_refresh"):
+                    S = refresh(S)
+                with jax.named_scope(f"tpucfd.{self.engaged_label}"):
+                    T = full(offsets, S, T)
                 return T, S
 
         S, T = lax.fori_loop(0, num_iters, body, (S, T))

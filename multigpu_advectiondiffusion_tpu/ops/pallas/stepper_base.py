@@ -24,6 +24,7 @@ whose kernels take a global-offset SMEM operand.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -90,8 +91,11 @@ class FusedStepperBase:
 
         def body(i, carry):
             S, T1, T2, t, m = carry
-            dt = dt_of(S, m)
-            S, T1, T2, m = step_of(S, T1, T2, dt, m)
+            # named_scope: the fused step body shows as one labeled
+            # region per rung in --trace captures
+            with jax.named_scope(f"tpucfd.{self.engaged_label}"):
+                dt = dt_of(S, m)
+                S, T1, T2, m = step_of(S, T1, T2, dt, m)
             return S, T1, T2, t + dt.astype(t.dtype), m
 
         S, T1, T2, t, _ = lax.fori_loop(0, num_iters, body, (S, S, S, t, m0))
@@ -120,8 +124,9 @@ class FusedStepperBase:
 
         def body(carry):
             S, T1, T2, t, it, m = carry
-            dt = jnp.minimum(dt_of(S, m), (te - t).astype(jnp.float32))
-            S, T1, T2, m = step_of(S, T1, T2, dt, m)
+            with jax.named_scope(f"tpucfd.{self.engaged_label}"):
+                dt = jnp.minimum(dt_of(S, m), (te - t).astype(jnp.float32))
+                S, T1, T2, m = step_of(S, T1, T2, dt, m)
             return S, T1, T2, t + dt.astype(t.dtype), it + 1, m
 
         S, T1, T2, t, steps, _ = lax.while_loop(

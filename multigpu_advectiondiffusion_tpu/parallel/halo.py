@@ -54,25 +54,54 @@ def exchange_ghosts(
         raise ValueError(
             f"shard of {n_local} cells can't serve a halo of {halo} on axis {axis}"
         )
+    _record_exchange(u, axis, halo, mesh_axis)
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [((i + 1) % num_shards, i) for i in range(num_shards)]
     # left halo <- left neighbor's rightmost cells; right halo <- right
     # neighbor's leftmost cells (tags 1/5 pair messaging in main.c:218,234).
-    from_left = lax.ppermute(
-        slice_axis(u, axis, n_local - halo, n_local), mesh_axis, fwd
+    # named_scope: the two shifts appear as one labeled region per axis
+    # in --trace captures, under the enclosing stepper span
+    with jax.named_scope(f"tpucfd.halo_exchange_ax{axis}"):
+        from_left = lax.ppermute(
+            slice_axis(u, axis, n_local - halo, n_local), mesh_axis, fwd
+        )
+        from_right = lax.ppermute(slice_axis(u, axis, 0, halo), mesh_axis, bwd)
+        if bc.kind != "periodic":
+            idx = lax.axis_index(mesh_axis)
+            from_left = jnp.where(
+                idx == 0, boundary_halo(u, axis, halo, bc, "left"), from_left
+            )
+            from_right = jnp.where(
+                idx == num_shards - 1,
+                boundary_halo(u, axis, halo, bc, "right"),
+                from_right,
+            )
+        return from_left, from_right
+
+
+def _record_exchange(u, axis: int, halo: int, mesh_axis: str) -> None:
+    """Telemetry record of one halo exchange *site*.
+
+    Runs at TRACE time (``exchange_ghosts`` executes under ``jit``), so
+    each counter increment describes one exchange **per execution of the
+    compiled program** — e.g. a fused 3-step chunk that exchanges per RK
+    stage traces 3 sites; multiply by executed chunks for run totals.
+    ``bytes`` is the per-execution ICI/DCN payload of the site: two
+    ``halo``-deep slabs (lo + hi) of the shard-local block."""
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    sink = telemetry.get_sink()
+    if not sink.active:
+        return
+    slab = 1
+    for ax, n in enumerate(u.shape):
+        slab *= halo if ax == axis else int(n)
+    nbytes = 2 * slab * jnp.dtype(u.dtype).itemsize
+    sink.counter("halo.exchanges_traced", 1, axis=axis, mesh_axis=mesh_axis)
+    sink.counter(
+        "halo.bytes_per_execution", nbytes,
+        axis=axis, mesh_axis=mesh_axis, halo=halo,
     )
-    from_right = lax.ppermute(slice_axis(u, axis, 0, halo), mesh_axis, bwd)
-    if bc.kind != "periodic":
-        idx = lax.axis_index(mesh_axis)
-        from_left = jnp.where(
-            idx == 0, boundary_halo(u, axis, halo, bc, "left"), from_left
-        )
-        from_right = jnp.where(
-            idx == num_shards - 1,
-            boundary_halo(u, axis, halo, bc, "right"),
-            from_right,
-        )
-    return from_left, from_right
 
 
 def exchange_axis(

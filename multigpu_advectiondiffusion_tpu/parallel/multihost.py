@@ -86,19 +86,47 @@ def initialize(
         except (TypeError, ValueError):
             pass  # unsignaturable wrapper: retry loop carries the policy
 
+    # retry telemetry: every attempt/backoff/outcome is an ordered event
+    # in the --metrics stream (the CLI installs the sink BEFORE joining
+    # the distributed runtime), so a rank that spun on a dead
+    # coordinator is diagnosable from its artifact instead of silent
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    attempts = max(1, attempts)
     last_exc = None
-    for attempt in range(max(1, attempts)):
+    for attempt in range(attempts):
+        telemetry.event(
+            "dist_init", "attempt",
+            attempt=attempt + 1, attempts=attempts,
+            coordinator=coordinator_address, process_id=process_id,
+        )
         try:
             jax.distributed.initialize(**kwargs)
+            telemetry.event("dist_init", "ok", attempt=attempt + 1)
             return
         except RuntimeError as exc:
             if "already initialized" in str(exc).lower():
+                telemetry.event(
+                    "dist_init", "ok", attempt=attempt + 1,
+                    already_initialized=True,
+                )
                 return  # idempotent re-entry (supervisor retry paths)
             last_exc = exc
         except Exception as exc:  # transient dial/handshake failures
             last_exc = exc
         if attempt + 1 < attempts:
-            time.sleep(backoff_seconds * (2 ** attempt))
+            delay = backoff_seconds * (2 ** attempt)
+            telemetry.event(
+                "dist_init", "retry",
+                attempt=attempt + 1, backoff_seconds=delay,
+                error=f"{type(last_exc).__name__}: {last_exc}"[:300],
+            )
+            time.sleep(delay)
+    telemetry.event(
+        "dist_init", "failed",
+        attempts=attempts,
+        error=f"{type(last_exc).__name__}: {last_exc}"[:300],
+    )
     raise RuntimeError(
         f"jax.distributed.initialize failed after {attempts} attempt(s) "
         f"(coordinator={coordinator_address!r}): {last_exc}"

@@ -13,9 +13,18 @@ reduce-max combiner does not reliably propagate NaN, notably across
 shard boundaries), so a single NaN/Inf cell anywhere in the global
 field makes the replicated probe value ``+inf`` on every process —
 all-finite and norm-growth checks ride one scalar.
+
+The same jitted program also carries the *physics* probe the telemetry
+stream consumes (one fused reduction pass, no extra dispatch): min/max
+of ``u``, the L2 norm and the mass integral ``vol * sum(u)`` — both
+model families conserve/decay mass, so the mass-integral drift against
+the armed baseline is the cheapest global correctness signal a long
+run can stream (``physics`` events; drift line in ``RunSummary``).
 """
 
 from __future__ import annotations
+
+import math
 
 import jax.numpy as jnp
 
@@ -25,13 +34,20 @@ from multigpu_advectiondiffusion_tpu.resilience.errors import (
 
 
 def make_health_probe(solver):
-    """``state -> float max|u|`` as one jitted (and, under a mesh,
-    shard_mapped) call; the reduction is replicated so every process
-    reads the same scalar."""
-    reduce = solver.mesh_reduce_max() if solver.mesh is not None else None
+    """``state -> dict`` of replicated global scalars as one jitted
+    (and, under a mesh, shard_mapped) call: ``max_abs`` (non-finite
+    mapped to +inf), ``min``, ``max``, ``l2`` and ``mass`` (both
+    volume-weighted, matching ``utils.metrics`` conventions)."""
+    reduce_max = (
+        solver.mesh_reduce_max() if solver.mesh is not None else None
+    )
+    reduce_sum = (
+        solver.mesh_reduce_sum() if solver.mesh is not None else None
+    )
+    vol = math.prod(solver.grid.spacing)
 
-    def block(u, m0):
-        del m0
+    def block(u, z):
+        del z
         a = jnp.abs(u).astype(jnp.float32)
         # NaN -> +inf BEFORE reducing: XLA's reduce-max combiner does
         # not reliably propagate NaN (observed dropped across shard
@@ -39,15 +55,32 @@ def make_health_probe(solver):
         # non-finite cell anywhere makes the replicated probe +inf
         a = jnp.where(jnp.isnan(a), jnp.inf, a)
         m = jnp.max(a)
-        if reduce is not None:
-            m = reduce(m)
-        return u, m
+        uf = u.astype(jnp.float32)
+        umin = jnp.min(uf)
+        umax = jnp.max(uf)
+        s = jnp.sum(uf)
+        s2 = jnp.sum(uf * uf)
+        if reduce_max is not None:
+            m = reduce_max(m)
+            umax = reduce_max(umax)
+            umin = -reduce_max(-umin)
+        if reduce_sum is not None:
+            s = reduce_sum(s)
+            s2 = reduce_sum(s2)
+        return u, jnp.stack([m, umin, umax, s, s2])
 
     f = solver._wrap(block)
 
-    def probe(state) -> float:
-        _, m = f(state.u, jnp.zeros((), jnp.float32))
-        return float(m)
+    def probe(state) -> dict:
+        _, v = f(state.u, jnp.zeros((5,), jnp.float32))
+        m, umin, umax, s, s2 = (float(x) for x in v)
+        return {
+            "max_abs": m,
+            "min": umin,
+            "max": umax,
+            "l2": math.sqrt(max(vol * s2, 0.0)) if math.isfinite(s2) else s2,
+            "mass": vol * s,
+        }
 
     return probe
 
@@ -59,34 +92,58 @@ class DivergenceSentinel:
     model families are max-norm non-increasing (diffusion decays, the
     WENO Burgers schemes are essentially non-oscillatory), so real
     growth past a generous factor means the integration left physics.
+
+    Every probe also refreshes :attr:`stats` — the physics scalars of
+    the last checked state (min/max/l2/mass plus ``mass_drift``, the
+    relative drift of the mass integral against the armed baseline) —
+    which the supervisor streams as ``physics`` telemetry events.
     """
 
     def __init__(self, solver, growth: float = 1e3):
         self._probe = make_health_probe(solver)
         self.growth = float(growth)
         self.bound = None
+        self.mass0 = None
+        self.stats = None
+
+    def _stats_with_drift(self, stats: dict) -> dict:
+        if self.mass0 is not None:
+            stats["mass_drift"] = (stats["mass"] - self.mass0) / max(
+                abs(self.mass0), 1e-30
+            )
+        self.stats = stats
+        return stats
 
     def arm(self, state) -> float:
-        """Record the healthy baseline norm (call once on the initial
-        state; re-arm after a rollback changes the reference)."""
-        norm0 = self._probe(state)
+        """Record the healthy baseline norm and mass integral (call once
+        on the initial state; re-arm after a rollback changes the
+        reference)."""
+        stats = self._probe(state)
+        norm0 = stats["max_abs"]
         if not jnp.isfinite(norm0):
             raise SolverDivergedError(
                 int(state.it), float(state.t), norm0,
                 reason="non-finite initial state",
             )
         self.bound = self.growth * max(1.0, norm0)
+        # the baseline survives re-arming after a rollback ONLY if unset:
+        # drift is always reported against the run's initial state
+        if self.mass0 is None:
+            self.mass0 = stats["mass"]
+        self._stats_with_drift(stats)
         return norm0
 
     def check(self, state) -> float:
         """One probe; raises :class:`SolverDivergedError` on a
         non-finite field or a norm past the growth bound."""
-        norm = self._probe(state)
+        stats = self._probe(state)
+        norm = stats["max_abs"]
         if not jnp.isfinite(norm):
             raise SolverDivergedError(
                 int(state.it), float(state.t), norm,
                 reason="non-finite field",
             )
+        self._stats_with_drift(stats)
         if self.bound is not None and norm > self.bound:
             raise SolverDivergedError(
                 int(state.it), float(state.t), norm,

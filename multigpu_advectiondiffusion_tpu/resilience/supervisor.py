@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, List, Optional
 
+from multigpu_advectiondiffusion_tpu import telemetry
 from multigpu_advectiondiffusion_tpu.resilience.errors import (
     SolverDivergedError,
 )
@@ -32,6 +33,11 @@ class SupervisorReport:
     events: List[dict] = dataclasses.field(default_factory=list)
     preempted: bool = False
     final_norm: Optional[float] = None
+    # physics-probe facts of the LAST probe (chunk cadence): relative
+    # mass-integral drift vs the armed initial state, plus the full
+    # min/max/L2/mass scalars — the drift line in RunSummary.print_block
+    mass_drift: Optional[float] = None
+    physics: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -99,7 +105,16 @@ def supervise_run(
     sentinel = None
     if sentinel_every:
         sentinel = DivergenceSentinel(solver, growth=growth)
-        sentinel.arm(state)
+        norm0 = sentinel.arm(state)
+        # every supervised run opens with one resilience event: the
+        # armed sentinel's cadence/bound baseline (healthy runs are
+        # attributable too, not only failing ones)
+        telemetry.event(
+            "resilience", "sentinel_armed",
+            cadence=int(sentinel_every), growth=float(growth),
+            norm0=norm0, mass0=sentinel.mass0,
+            max_retries=int(max_retries), dt_backoff=float(dt_backoff),
+        )
 
     last_good = state
     start_it = int(state.it)
@@ -112,6 +127,15 @@ def supervise_run(
         if sentinel is not None and probe_due:
             report.probes += 1
             report.final_norm = sentinel.check(nxt)
+            stats = sentinel.stats or {}
+            report.physics = dict(stats)
+            report.mass_drift = stats.get("mass_drift")
+            # chunk-cadence physics stream, piggybacked on the jitted
+            # probe the divergence check already paid for
+            telemetry.event(
+                "physics", "probe",
+                step=int(nxt.it), time=float(nxt.t), **stats,
+            )
         if checkpoint_every and (
             int(nxt.it) - last_ckpt_it >= checkpoint_every
         ):
@@ -129,16 +153,29 @@ def supervise_run(
         nonlocal last_good
         report.retries += 1
         if report.retries > max_retries:
+            telemetry.event(
+                "resilience", "retries_exhausted",
+                step=err.step, time=err.t, retries=report.retries - 1,
+                reason=err.reason,
+            )
             raise err
         action = scale_dt(solver, dt_backoff)
-        report.events.append({
+        ev = {
             "step": err.step,
             "t": err.t,
             "norm": err.norm,
             "reason": err.reason,
             "rollback_to_it": int(last_good.it),
             "action": action,
-        })
+        }
+        report.events.append(ev)
+        # "time" (not "t"): the sink's own key "t" is the event timestamp
+        telemetry.event(
+            "resilience", "rollback", retry=report.retries,
+            step=ev["step"], time=ev["t"], norm=ev["norm"],
+            reason=ev["reason"], rollback_to_it=ev["rollback_to_it"],
+            action=ev["action"],
+        )
         if sentinel is not None:
             sentinel.arm(last_good)
         return last_good
@@ -150,6 +187,10 @@ def supervise_run(
         while int(state.it) < target_it:
             if should_stop is not None and should_stop():
                 report.preempted = True
+                telemetry.event(
+                    "resilience", "preempt", step=int(state.it),
+                    time=float(state.t),
+                )
                 break
             n = min(chunk, target_it - int(state.it))
             try:
@@ -180,6 +221,10 @@ def supervise_run(
     while float(state.t) < te - eps:
         if should_stop is not None and should_stop():
             report.preempted = True
+            telemetry.event(
+                "resilience", "preempt", step=int(state.it),
+                time=float(state.t),
+            )
             break
         if dt_est is None:
             # adaptive dt with no estimate yet: one step calibrates the

@@ -1,0 +1,42 @@
+"""Run telemetry: structured event stream + static per-rung cost model.
+
+The cross-cutting observability layer every other subsystem reports
+into (the upgrade of the reference's ``nvprof`` + hand-read
+``PrintSummary``, SURVEY §5):
+
+* :mod:`sink` — process-tagged JSONL event stream (spans with nesting,
+  counters, domain events), installed via the CLI ``--metrics PATH``
+  flag or :func:`capture`;
+* :mod:`costmodel` — HBM bytes / FLOPs per step for every stepper rung,
+  turning measured seconds into a roofline-efficiency percentage.
+"""
+
+from multigpu_advectiondiffusion_tpu.telemetry.sink import (  # noqa: F401
+    EVENT_SCHEMA,
+    NULL_SINK,
+    NullSink,
+    TelemetrySink,
+    capture,
+    counter,
+    event,
+    get_sink,
+    install,
+    span,
+    uninstall,
+)
+from multigpu_advectiondiffusion_tpu.telemetry import costmodel  # noqa: F401
+
+__all__ = [
+    "EVENT_SCHEMA",
+    "NULL_SINK",
+    "NullSink",
+    "TelemetrySink",
+    "capture",
+    "costmodel",
+    "counter",
+    "event",
+    "get_sink",
+    "install",
+    "span",
+    "uninstall",
+]

@@ -1,0 +1,307 @@
+"""Static per-rung cost model: HBM bytes moved and FLOPs per step.
+
+Turns a measured wall-clock rate into a *roofline efficiency*: how close
+the engaged stepper rung ran to the rate its memory traffic (or compute)
+allows on the hardware — the bytes/FLOPs accounting HipBone (PAPERS:
+arXiv 2202.12477) treats as the baseline for every kernel. The model is
+static and documented, not profiled: every count below is derived from
+the operator definitions in ``ops/`` and the steppers' data flow, so a
+test can hand-compute the same numbers (tests/test_telemetry.py).
+
+FLOP conventions (per cell, per RK stage; adds/subs/muls/divs each = 1):
+
+* O4 Laplacian axis term, factored ``c*(16*(q1+q3) - (q0+q4) - 30*q2)``:
+  2 pair-adds + (16*, -) + (30*, -) + c* = 4 add/sub + 3 mul = **7/axis**.
+* O2 Laplacian axis term ``c*((q0+q2) - 2*q1)``: 2 add/sub + 2 mul =
+  **4/axis**.
+* Cross-axis accumulation: **ndim-1** adds.
+* SSP-RK3 stage combine ``u = a*u0 + b*(u_s + dt*L)``: 3 mul + 2 add =
+  **5**.
+* WENO5 axis sweep (ops/weno.py, single-division form), per cell-stage:
+  LF split 7; per reconstruction side: betas 33 + eps-shifts 3 +
+  unnormalized alphas 9 + normalization (2 add, 1 div, 3 mul) 6 +
+  candidate stencils 15 + weighted combine 5 = 71; two sides 142; flux
+  divergence 2 → **151/axis**. WENO7 (4 stencils, wider betas) is the
+  analogous count, **232/axis** (estimate at the same conventions; no
+  test pins it — the reference never benchmarked WENO7 either).
+
+HBM traffic (field passes per *step*; 1 pass = cells * itemsize bytes,
+itemsize = the STORAGE dtype, so the f64-storage/f32-compute rung pays
+f64 bytes):
+
+* ``fused-whole-run-slab`` / ``fused-step``: read state + write state
+  once per step (the one-HBM-round-trip-per-step schedule) = **2**.
+* ``fused-whole-run``: state is VMEM-resident for the entire run — HBM
+  traffic only at run boundaries, modeled as **0** (the roofline is then
+  compute-only).
+* ``fused-stage``: SSP-RK3 ping-pong S/T1/T2 — stage 1 reads S writes
+  T1 (2), stages 2/3 read the previous stage plus S and write (3 each)
+  = **8**.
+* ``per-axis-pallas``: per stage, one read+write sweep per axis
+  (2*ndim) plus the RK combine (read L, u_s, u0; write u = 4) =
+  **3*(2*ndim+4)**.
+* ``generic-xla``: per stage, L materialized (read u_s, write L) then
+  combined (read L, u_s, u0; write u) = **3*6 = 18** — an idealized
+  lower bound; XLA may fuse better or worse.
+
+Peaks default per backend (env-overridable with
+``TPUCFD_PEAK_BYTES_PER_S`` / ``TPUCFD_PEAK_FLOPS_PER_S``): the TPU row
+is a v5e chip (819 GB/s HBM; 4.92e13 f32 FLOP/s matmul peak — stencil
+code is VPU-bound and will not approach the compute roof, so the
+meaningful number on TPU is the HBM roofline). The CPU row is a nominal
+(50 GB/s, 100 GFLOP/s) placeholder so the plumbing is testable without
+hardware; CPU percentages are not performance claims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Optional, Sequence
+
+# per-axis FLOPs of the diffusion RHS by Laplacian order
+DIFFUSION_AXIS_FLOPS = {2: 4, 4: 7}
+# per-axis FLOPs of the WENO flux-divergence sweep by order
+WENO_AXIS_FLOPS = {5: 151, 7: 232}
+RK_COMBINE_FLOPS = 5
+
+# (peak HBM bytes/s, peak FLOP/s) by backend family
+PEAKS = {
+    "tpu": (819.0e9, 4.92e13),  # v5e: HBM BW; f32 matmul peak
+    "gpu": (900.0e9, 1.0e13),   # generic placeholder (not measured here)
+    "cpu": (5.0e10, 1.0e11),    # nominal, for plumbing/tests only
+}
+
+
+def hbm_passes_per_step(stepper: str, ndim: int, stages: int = 3) -> float:
+    """Field passes (cells * itemsize each) one step moves through HBM
+    for the given engaged-stepper label; derivations in the module
+    docstring."""
+    if stepper in ("fused-whole-run-slab", "fused-step"):
+        return 2.0
+    if stepper == "fused-whole-run":
+        return 0.0
+    if stepper == "fused-stage":
+        return float(stages - 1) * 3.0 + 2.0  # 8 for SSP-RK3
+    if stepper == "per-axis-pallas":
+        return float(stages) * (2.0 * ndim + 4.0)
+    # generic-xla and anything unrecognized: the materialized-RHS bound
+    return float(stages) * 6.0
+
+
+def rhs_flops_per_cell(
+    kind: str,
+    ndim: int,
+    order: int = 4,
+    weno_order: int = 5,
+    viscous: bool = False,
+) -> float:
+    """FLOPs of one RHS evaluation per cell (no RK combine)."""
+    if kind == "diffusion":
+        return DIFFUSION_AXIS_FLOPS[order] * ndim + (ndim - 1)
+    if kind == "burgers":
+        f = WENO_AXIS_FLOPS[weno_order] * ndim + (ndim - 1)
+        if viscous:
+            # nu*lap(u) rides the O2 Laplacian plus one axpy per cell
+            f += DIFFUSION_AXIS_FLOPS[2] * ndim + (ndim - 1) + 2
+        return float(f)
+    raise ValueError(f"unknown solver kind {kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class StepCost:
+    """Modeled cost of ONE time step over the whole (global) grid."""
+
+    hbm_bytes: float
+    flops: float
+    passes: float
+    flops_per_cell_stage: float
+
+    def to_dict(self) -> dict:
+        return {
+            "hbm_bytes_per_step": self.hbm_bytes,
+            "flops_per_step": self.flops,
+            "hbm_passes_per_step": self.passes,
+            "flops_per_cell_stage": self.flops_per_cell_stage,
+        }
+
+
+def step_cost(
+    kind: str,
+    shape: Sequence[int],
+    itemsize: int,
+    stepper: str,
+    stages: int = 3,
+    order: int = 4,
+    weno_order: int = 5,
+    viscous: bool = False,
+) -> StepCost:
+    cells = math.prod(shape)
+    ndim = len(shape)
+    per_cell_stage = (
+        rhs_flops_per_cell(kind, ndim, order=order, weno_order=weno_order,
+                           viscous=viscous)
+        + RK_COMBINE_FLOPS
+    )
+    passes = hbm_passes_per_step(stepper, ndim, stages)
+    return StepCost(
+        hbm_bytes=passes * cells * itemsize,
+        flops=float(stages) * cells * per_cell_stage,
+        passes=passes,
+        flops_per_cell_stage=per_cell_stage,
+    )
+
+
+def peak_rates(backend: Optional[str] = None):
+    """(bytes/s, FLOP/s) peaks for a backend family, env-overridable."""
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+    family = backend if backend in PEAKS else (
+        "tpu" if backend not in ("cpu", "gpu") else backend
+    )
+    peak_b, peak_f = PEAKS[family]
+    env_b = os.environ.get("TPUCFD_PEAK_BYTES_PER_S")
+    env_f = os.environ.get("TPUCFD_PEAK_FLOPS_PER_S")
+    if env_b:
+        peak_b = float(env_b)
+    if env_f:
+        peak_f = float(env_f)
+    return peak_b, peak_f
+
+
+def roofline(
+    cost: StepCost,
+    iters: int,
+    seconds: float,
+    backend: Optional[str] = None,
+    devices: int = 1,
+) -> dict:
+    """Measured seconds vs the model's minimum time on the peak rates.
+
+    ``roofline_pct = 100 * t_model / t_measured`` where ``t_model`` is
+    the binding resource's time ``max(bytes/peak_bw, flops/peak_flops)``
+    for the whole run (aggregate peaks scale with ``devices``).
+    ``bound`` names the binding resource. VMEM-resident rungs (0 modeled
+    bytes) are compute-bound by construction.
+    """
+    peak_b, peak_f = peak_rates(backend)
+    peak_b *= max(1, devices)
+    peak_f *= max(1, devices)
+    bytes_total = cost.hbm_bytes * iters
+    flops_total = cost.flops * iters
+    t_mem = bytes_total / peak_b if peak_b else 0.0
+    t_cmp = flops_total / peak_f if peak_f else 0.0
+    t_model = max(t_mem, t_cmp)
+    out = {
+        "achieved_gbs": (
+            round(bytes_total / seconds / 1e9, 3) if seconds > 0 else None
+        ),
+        "achieved_gflops": (
+            round(flops_total / seconds / 1e9, 3) if seconds > 0 else None
+        ),
+        "peak_gbs": round(peak_b / 1e9, 3),
+        "peak_gflops": round(peak_f / 1e9, 3),
+        "bound": "hbm" if t_mem >= t_cmp else "flops",
+        "roofline_pct": (
+            round(100.0 * t_model / seconds, 2) if seconds > 0 else None
+        ),
+    }
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Solver-facing conveniences
+# --------------------------------------------------------------------- #
+def solver_kind(cfg) -> Optional[str]:
+    """Duck-typed solver family from its config (no model imports)."""
+    if hasattr(cfg, "weno_order"):
+        return "burgers"
+    if hasattr(cfg, "diffusivity"):
+        return "diffusion"
+    return None
+
+
+def solver_step_cost(solver, stepper: str) -> Optional[StepCost]:
+    """The static cost of one of ``solver``'s steps on the engaged
+    ``stepper`` rung, or ``None`` for solver families the model does not
+    cover (e.g. axisymmetric geometry is priced as cartesian — close
+    enough for a roofline)."""
+    import numpy as np
+
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+
+    cfg = solver.cfg
+    kind = solver_kind(cfg)
+    if kind is None:
+        return None
+    kwargs = {}
+    if kind == "diffusion":
+        kwargs["order"] = getattr(cfg, "order", 4)
+    else:
+        kwargs["weno_order"] = getattr(cfg, "weno_order", 5)
+        kwargs["viscous"] = bool(getattr(cfg, "nu", 0.0))
+    return step_cost(
+        kind,
+        cfg.grid.shape,
+        np.dtype(solver.dtype).itemsize,
+        stepper,
+        stages=STAGES[cfg.integrator],
+        **kwargs,
+    )
+
+
+def summarize_run(
+    solver,
+    stepper: str,
+    iters: int,
+    seconds: float,
+    backend: Optional[str] = None,
+) -> Optional[dict]:
+    """Cost-model block for a finished run: per-step bytes/FLOPs plus
+    the roofline efficiency — what ``RunSummary.cost_model`` and the
+    bench rows carry."""
+    cost = solver_step_cost(solver, stepper)
+    if cost is None or iters <= 0 or seconds <= 0:
+        return None
+    devices = 1 if solver.mesh is None else solver.mesh.devices.size
+    out = cost.to_dict()
+    out["stepper"] = stepper
+    out.update(roofline(cost, iters, seconds, backend=backend,
+                        devices=devices))
+    return out
+
+
+def xla_memory_analysis(fn, *args) -> Optional[dict]:
+    """Cross-check hook: lower+compile ``fn(*args)`` and read XLA's own
+    ``memory_analysis()`` where the backend provides one (TPU does;
+    CPU's is often absent/empty → ``None``). Returns a plain dict of the
+    byte-sized attributes so tests can compare magnitudes against the
+    static model without depending on the exact HLO schedule."""
+    try:
+        import jax
+
+        compiled = jax.jit(fn).lower(*args).compile()
+        m = compiled.memory_analysis()
+    except Exception:
+        return None
+    if m is None:
+        return None
+    out = {}
+    for attr in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        v = getattr(m, attr, None)
+        if v is not None:
+            out[attr] = int(v)
+    return out or None

@@ -1,0 +1,241 @@
+"""Structured run telemetry: a process-tagged JSONL event stream.
+
+The reference's observability is a hand-read ``PrintSummary`` block plus
+``nvprof`` wrapping (SURVEY §5) — one wall-clock number per run. This
+sink is the machine-readable upgrade the TensorFlow-on-TPU CFD framework
+(PAPERS: arXiv 2108.11076) treats as table stakes: every rung selection,
+halo exchange, sentinel probe, rollback and checkpoint write becomes an
+*attributable event* in an append-only JSONL stream.
+
+Event model (one JSON object per line):
+
+* every event carries ``t`` (seconds since the sink opened, from
+  ``time.monotonic`` — ordering-safe under wall-clock steps), ``proc``
+  (``jax.process_index()`` read at emit time, so events logged before
+  ``jax.distributed.initialize`` and after both tag correctly), ``kind``
+  and ``name``;
+* ``kind="span"`` events come in ``phase="begin"/"end"`` pairs with
+  ``id``/``parent``/``depth`` describing the nesting (ends carry
+  ``seconds``);
+* ``kind="counter"`` events carry the increment and the running total;
+* domain events use their own kinds: ``dispatch``, ``ladder``,
+  ``physics``, ``resilience``, ``io``, ``halo``, ``dist_init``.
+
+The module-level active sink (:func:`install` / :func:`get_sink`) is
+what the instrumented layers write to; when nothing is installed they
+hit :data:`NULL_SINK`, whose methods are no-ops — instrumentation costs
+one attribute check on a hot host path. Hot *device* loops are jitted,
+so host-side emission happens at chunk/dispatch cadence, never per cell.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import json
+import threading
+import time
+from typing import Optional
+
+# Version of the event-stream layout itself (a `meta`/`open` event
+# records it so downstream tooling can evolve).
+EVENT_SCHEMA = 1
+
+
+def _process_index() -> int:
+    """Process tag, read at emit time (cheap: a runtime global). Falls
+    back to 0 when jax is not importable or not yet set up."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class NullSink:
+    """No-op sink: the uninstalled default. ``active`` lets hot call
+    sites skip building event payloads entirely."""
+
+    active = False
+
+    def event(self, kind: str, name: str, **fields) -> None:
+        pass
+
+    def counter(self, name: str, inc, **fields) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        yield None
+
+    def tail(self, n: int = 20):
+        return []
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+NULL_SINK = NullSink()
+
+
+class TelemetrySink:
+    """JSONL event sink with span nesting, counters and a tail buffer.
+
+    Thread-safe writes (one lock around serialization + write); the span
+    stack is per-thread so concurrent host threads cannot corrupt each
+    other's nesting. ``tail(n)`` returns the last events as dicts — the
+    bench engagement guard prints these when a row fails, so a degraded
+    run is diagnosable from the bench output alone.
+    """
+
+    active = True
+
+    def __init__(self, path: str, tail_events: int = 512):
+        self.path = path
+        self._f = open(path, "a", buffering=1)  # line-buffered
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._counters: dict = {}
+        self._tail = collections.deque(maxlen=tail_events)
+        self.event(
+            "meta", "open",
+            schema=EVENT_SCHEMA,
+            wall_time=time.time(),
+        )
+
+    # ------------------------------------------------------------------ #
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def event(self, kind: str, name: str, **fields) -> None:
+        ev = {
+            "t": round(time.monotonic() - self._t0, 6),
+            "proc": _process_index(),
+            "kind": kind,
+            "name": name,
+        }
+        ev.update(fields)
+        line = json.dumps(ev)
+        with self._lock:
+            self._tail.append(ev)
+            try:
+                self._f.write(line + "\n")
+            except ValueError:
+                pass  # closed sink: keep the tail, drop the write
+
+    def counter(self, name: str, inc, **fields) -> None:
+        """Accumulate ``inc`` into the named counter and log the event
+        with the running total."""
+        with self._lock:
+            total = self._counters.get(name, 0) + inc
+            self._counters[name] = total
+        self.event("counter", name, inc=inc, total=total, **fields)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        """Nested begin/end pair; yields the span id."""
+        sid = next(self._ids)
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        self.event("span", name, phase="begin", id=sid, parent=parent,
+                   depth=len(stack), **fields)
+        stack.append(sid)
+        t0 = time.monotonic()
+        try:
+            yield sid
+        finally:
+            stack.pop()
+            self.event(
+                "span", name, phase="end", id=sid, parent=parent,
+                depth=len(stack),
+                seconds=round(time.monotonic() - t0, 6),
+            )
+
+    def tail(self, n: int = 20):
+        """The last ``n`` events, oldest first."""
+        with self._lock:
+            evs = list(self._tail)
+        return evs[-n:]
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+# --------------------------------------------------------------------- #
+# Module-level active sink
+# --------------------------------------------------------------------- #
+_active: NullSink | TelemetrySink = NULL_SINK
+
+
+def get_sink():
+    """The currently installed sink (:data:`NULL_SINK` when none)."""
+    return _active
+
+
+def install(path: str, tail_events: int = 512) -> TelemetrySink:
+    """Open a JSONL sink at ``path`` and make it the active sink. An
+    already-active sink is closed first (last install wins)."""
+    global _active
+    if _active.active:
+        _active.close()
+    _active = TelemetrySink(path, tail_events=tail_events)
+    return _active
+
+
+def uninstall(sink: Optional[TelemetrySink] = None) -> None:
+    """Close and deactivate the active sink. With ``sink`` given, only
+    deactivates if that sink is still the active one (so an owner
+    cannot tear down a later installation)."""
+    global _active
+    if sink is not None and sink is not _active:
+        sink.close()
+        return
+    if _active.active:
+        _active.close()
+    _active = NULL_SINK
+
+
+@contextlib.contextmanager
+def capture(path: str, tail_events: int = 512):
+    """``with capture('events.jsonl') as sink: ...`` — scoped install."""
+    sink = install(path, tail_events=tail_events)
+    try:
+        yield sink
+    finally:
+        uninstall(sink)
+
+
+# Proxy conveniences: instrumented modules call these without holding a
+# sink reference; they hit NULL_SINK when telemetry is off.
+def event(kind: str, name: str, **fields) -> None:
+    _active.event(kind, name, **fields)
+
+
+def counter(name: str, inc, **fields) -> None:
+    _active.counter(name, inc, **fields)
+
+
+def span(name: str, **fields):
+    return _active.span(name, **fields)

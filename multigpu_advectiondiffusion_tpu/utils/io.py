@@ -27,6 +27,20 @@ from multigpu_advectiondiffusion_tpu.models.state import SolverState
 _native = None
 
 
+def _io_event(name: str, path: str, nbytes: int, seconds: float, **fields):
+    """Telemetry record of one completed write (no-op when no sink is
+    installed) — checkpoint and snapshot I/O becomes attributable in the
+    event stream instead of folding into one wall-clock number."""
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    sink = telemetry.get_sink()
+    if sink.active:
+        sink.event(
+            "io", name, path=path, bytes=int(nbytes),
+            seconds=round(seconds, 6), **fields,
+        )
+
+
 def _load_native():
     global _native
     if _native is not None:
@@ -142,6 +156,9 @@ class AsyncBinaryWriter:
 
 def save_binary(u, path: str) -> None:
     """Write float32 raw binary, reference ``SaveBinary3D`` layout."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     arr = np.asarray(u, dtype=np.float32).ravel()
     lib = _load_native()
     if lib:
@@ -154,8 +171,11 @@ def save_binary(u, path: str) -> None:
             buf.size,
         )
         if rc == 0:
+            _io_event("binary_write", path, arr.nbytes,
+                      _time.perf_counter() - t0)
             return
     arr.tofile(path)
+    _io_event("binary_write", path, arr.nbytes, _time.perf_counter() - t0)
 
 
 def print_field(u, file=None) -> None:
@@ -372,6 +392,9 @@ def save_checkpoint(
     the same over MPI, ``main.c:326-335``, and has no restart at all),
     but a multi-host run whose global array exceeds one host's memory
     needs a per-shard format this writer does not implement."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     meta = {}
     if grid is not None:
         meta = {"shape": list(grid.shape), "bounds": [list(b) for b in grid.bounds]}
@@ -384,13 +407,17 @@ def save_checkpoint(
             with open(tmp, "w") as f:
                 json.dump(meta, f)
             os.replace(tmp, path + ".json")
-        return
-    np.savez(
-        path,
-        u=np.asarray(state.u),
-        t=np.asarray(state.t),
-        it=np.asarray(state.it),
-        meta=json.dumps(meta),
+    else:
+        np.savez(
+            path,
+            u=np.asarray(state.u),
+            t=np.asarray(state.t),
+            it=np.asarray(state.it),
+            meta=json.dumps(meta),
+        )
+    _io_event(
+        "checkpoint_write", path, getattr(state.u, "nbytes", 0),
+        _time.perf_counter() - t0, iteration=int(state.it),
     )
 
 
@@ -450,8 +477,11 @@ def save_checkpoint_sharded(
     sharding's full placement map, identically on every process), so
     exactly one process writes each distinct block — no cross-process
     write collisions by construction."""
+    import time as _time
+
     import jax
 
+    t0 = _time.perf_counter()
     os.makedirs(directory, exist_ok=True)
     u = state.u
     shards = getattr(u, "addressable_shards", None)
@@ -527,6 +557,12 @@ def save_checkpoint_sharded(
         os.replace(tmp, os.path.join(directory, "manifest.json"))
     if multi:
         multihost_utils.sync_global_devices(f"ckptd-commit:{directory}")
+    _io_event(
+        "checkpoint_write", directory,
+        sum(arr.nbytes for _, arr in blocks),
+        _time.perf_counter() - t0,
+        iteration=it, sharded=True, shards=len(blocks),
+    )
 
 
 def _shard_desc(e: dict) -> str:

@@ -10,6 +10,7 @@ becomes :class:`Stopwatch` segments around ``block_until_ready``.
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 from typing import Dict, Optional
 
@@ -67,15 +68,34 @@ class Stopwatch:
         return "\n".join(lines)
 
 
-def annotate(name: str):
-    """Decorator adding a named TraceAnnotation around a function so it
-    shows up as a labeled span in profiler timelines."""
+class annotate:
+    """Named ``jax.profiler.TraceAnnotation`` span, usable two ways:
 
-    def wrap(fn):
+    * decorator — ``@annotate("solve")`` wraps the function in the span
+      (``functools.wraps`` preserved, so profiler timelines and
+      tracebacks keep the wrapped function's name/docstring);
+    * context manager — ``with annotate("halo-exchange"): ...`` labels
+      an ad-hoc host-side region (e.g. one supervised chunk) in the
+      captured trace.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._span = None
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
         def inner(*a, **k):
-            with jax.profiler.TraceAnnotation(name):
+            with jax.profiler.TraceAnnotation(self.name):
                 return fn(*a, **k)
 
         return inner
 
-    return wrap
+    def __enter__(self):
+        self._span = jax.profiler.TraceAnnotation(self.name)
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        span, self._span = self._span, None
+        return span.__exit__(*exc)

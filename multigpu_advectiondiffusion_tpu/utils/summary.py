@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import platform
 from typing import Optional
 
@@ -20,6 +21,12 @@ from multigpu_advectiondiffusion_tpu.utils.metrics import (
     gflops_reference_convention,
     mlups,
 )
+
+# Version of the summary JSON layout. Bumped whenever fields change
+# meaning or move, so downstream BENCH tooling can branch instead of
+# guessing. History: 1 = implicit pre-schema layout (PRs 0-2);
+# 2 = adds schema/cost_model/roofline_pct/mass_drift.
+SUMMARY_SCHEMA = 2
 
 
 @dataclasses.dataclass
@@ -49,6 +56,10 @@ class RunSummary:
     # cadence/probes, rollback-retry events, preemption — absent on
     # unsupervised runs
     resilience: Optional[dict] = None
+    # static per-rung cost model (telemetry.costmodel.summarize_run):
+    # HBM bytes / FLOPs per step for the ENGAGED stepper plus the
+    # roofline-efficiency percentage of the measured rate
+    cost_model: Optional[dict] = None
 
     @property
     def num_cells(self) -> int:
@@ -69,10 +80,17 @@ class RunSummary:
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
+        d["schema"] = SUMMARY_SCHEMA
         d["mlups"] = round(self.mlups, 3)
         d["gflops_reference_convention"] = round(self.gflops, 4)
         d["backend"] = jax.default_backend()
         d["platform"] = platform.machine()
+        # headline derived fields surfaced top-level (BENCH tooling reads
+        # these without digging into the nested blocks)
+        if self.cost_model is not None:
+            d["roofline_pct"] = self.cost_model.get("roofline_pct")
+        if self.resilience is not None:
+            d["mass_drift"] = self.resilience.get("mass_drift")
         return d
 
     def print_block(self) -> None:
@@ -116,6 +134,11 @@ class RunSummary:
             if r.get("preempted"):
                 line += ", PREEMPTED"
             print(f" resilience         : {line}")
+            if r.get("mass_drift") is not None:
+                print(
+                    f" mass drift         : {r['mass_drift']:+.3e} "
+                    "(rel., vs initial state)"
+                )
             for ev in r.get("events") or ():
                 print(
                     f"   rollback         : step {ev['step']} "
@@ -124,6 +147,16 @@ class RunSummary:
                 )
         print(f" MLUPS              : {self.mlups:.1f}")
         print(f" GFLOPS (ref conv.) : {self.gflops:.3f}")
+        if self.cost_model is not None and self.cost_model.get(
+            "roofline_pct"
+        ) is not None:
+            c = self.cost_model
+            print(
+                f" roofline           : {c['roofline_pct']:.1f}% of the "
+                f"{c['bound']} roof "
+                f"({c.get('achieved_gbs', 0)} GB/s, "
+                f"{c.get('achieved_gflops', 0)} GFLOP/s modeled)"
+            )
         if self.error_l1 is not None:
             print(
                 f" error L1/L2/Linf   : {self.error_l1:.4e} / "
@@ -132,6 +165,11 @@ class RunSummary:
         print("=" * 60)
 
     def write_json(self, path: str) -> None:
-        with open(path, "w") as f:
+        """Atomic write (tmp + ``os.replace``, the checkpoint writers'
+        pattern): a reader — or a preempted run — never sees a
+        half-written summary."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self.to_dict(), f, indent=2)
             f.write("\n")
+        os.replace(tmp, path)
