@@ -300,6 +300,14 @@ def main() -> None:
     )
     sink = telemetry.install(metrics_path)
 
+    # measured dispatch rides every bench run: rows built with
+    # impl="auto" (the multichip scaling rows) may measure their
+    # (rung x steps_per_exchange) candidates on a cache miss and
+    # persist the decision — the tune:* events land in the same stream
+    from multigpu_advectiondiffusion_tpu import tuning
+
+    tuning.configure(enabled=True)
+
     from multigpu_advectiondiffusion_tpu.bench.timing import (
         timed_advance,
         timed_run,
@@ -362,6 +370,11 @@ def main() -> None:
             # the artifact keeps the full evidence (ADVICE r4)
             "raw_spread": round(timing.raw_spread, 4),
             "engaged": engaged["stepper"],
+            # comm-avoiding exchange cadence + tuner provenance: a row
+            # whose configuration was MEASURED into place says so, and
+            # says what the tuner picked (ISSUE 4)
+            "steps_per_exchange": engaged.get("steps_per_exchange", 1),
+            "tuned": engaged.get("tuned"),
             "roofline_pct": (cost or {}).get("roofline_pct"),
         }
         # engagement guard: a row running on an unexpected (slower)
@@ -379,6 +392,18 @@ def main() -> None:
                 "degraded": engaged.get("degraded"),
             }
             mismatches.append(metric)
+        # tuned-regression guard: a tuner-selected configuration that
+        # lands BELOW the reference baseline (BASELINE.md) is a silent
+        # regression dressed up as a decision — fail the run, don't
+        # just publish it (TPU rows only; CPU mode validates mechanics)
+        elif on_tpu and engaged.get("tuned") and rate < baseline:
+            row["engagement_error"] = {
+                "tuned_below_baseline": {
+                    "baseline_mlups": baseline,
+                    "tuned": engaged.get("tuned"),
+                }
+            }
+            mismatches.append(metric)
         print(json.dumps(row), flush=True)
 
     # Multi-chip strong-scaling rows: engage automatically whenever the
@@ -390,6 +415,14 @@ def main() -> None:
     from multigpu_advectiondiffusion_tpu.bench.scaling import scaling_rows
 
     for row in scaling_rows(on_tpu=on_tpu):
+        # the multichip rows dispatch through impl="auto": the tuner's
+        # measured (rung, steps_per_exchange) must not silently regress
+        # below the reference's published multi-GPU rate
+        if on_tpu and row.get("tuned") and row["vs_baseline"] < 1.0:
+            row["engagement_error"] = {
+                "tuned_below_baseline": row.get("tuned")
+            }
+            mismatches.append(row["metric"])
         print(json.dumps(row), flush=True)
 
     if mismatches:

@@ -105,15 +105,22 @@ CASES = [
 ]
 
 
-def resolve_impl(case: BenchCase, dtype: str) -> str:
+def resolve_impl(case: BenchCase, dtype: str,
+                 mesh_spec: Optional[str] = None) -> str:
     """Kernel strategy actually benchmarked: the Pallas rungs' DMA tiling
     is f32-calibrated, so non-f32 dtypes run XLA — EXCEPT 3-D diffusion
     f64, which rides the fused f32 kernels through the
     f64-storage/f32-compute convention (the solver's own eligibility
     gate; non-eligible configs still land on the generic path and the
-    'engaged' field says so). One definition — the JSON 'impl' field and
-    the constructed solver must never diverge."""
+    'engaged' field says so). Multichip f32 rows (``--mesh``, e.g. the
+    burgers3d_multigpu / split-overlap cases) route ``pallas`` through
+    ``auto`` so the measured tuner picks the rung and the
+    communication-avoiding ``steps_per_exchange`` from its decision
+    cache. One definition — the JSON 'impl' field and the constructed
+    solver must never diverge."""
     if dtype == "float32":
+        if mesh_spec and case.impl == "pallas":
+            return "auto"
         return case.impl
     if dtype == "float64" and case.kind == "diffusion" and len(
         case.grid_xyz
@@ -140,7 +147,7 @@ def build_solver(case: BenchCase, dtype: str, grid_xyz, mesh_spec: Optional[str]
     grid = Grid.make(*grid_xyz, lengths=[10.0] * len(grid_xyz))
     mesh, sizes = parse_mesh_spec(mesh_spec)
     decomp = decomposition_for(grid, sizes)
-    impl = resolve_impl(case, dtype)
+    impl = resolve_impl(case, dtype, mesh_spec)
     if case.kind == "diffusion":
         cfg = DiffusionConfig(
             grid=grid, diffusivity=1.0, dtype=dtype, impl=impl
@@ -199,13 +206,17 @@ def run_case(
         "grid": "x".join(map(str, grid_xyz)),
         "iters": iters,
         "dtype": dtype,
-        "impl": resolve_impl(case, dtype),
+        "impl": resolve_impl(case, dtype, mesh_spec),
         # which stepper rung actually executed (fused-whole-run-slab /
         # fused-whole-run / fused-stage / ... / generic-xla) — a row
         # that silently fell off the fused ladder is visible in the
         # artifact, not just slow (bench.py's engagement guard is the
         # hard-failing counterpart for the headline rows)
         "engaged": engaged["stepper"],
+        # comm-avoiding cadence in effect + tuner provenance (non-None
+        # exactly when impl resolved through "auto")
+        "steps_per_exchange": engaged.get("steps_per_exchange", 1),
+        "tuned": engaged.get("tuned"),
         "seconds": round(best, 4),
         "compile_seconds": round(compile_s, 3),
         "mlups": round(rate, 1),
@@ -245,7 +256,17 @@ def main(argv=None):
     ap.add_argument("--mesh", default=None, help="e.g. dz=4")
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--out", default=None, help="write JSON lines here")
+    ap.add_argument("--tuning-cache", default=None, metavar="PATH",
+                    help="tuning decision cache for the impl='auto' "
+                         "multichip rows (default: $TPUCFD_TUNING_CACHE "
+                         "or the user cache dir)")
     args = ap.parse_args(argv)
+
+    # multichip rows dispatch through impl="auto": enable measurement so
+    # a cache miss tunes (and persists) instead of falling back
+    from multigpu_advectiondiffusion_tpu import tuning
+
+    tuning.configure(cache_path=args.tuning_cache, enabled=True)
 
     cases = [c for c in CASES if args.name is None or c.name == args.name]
     if not cases:

@@ -125,7 +125,7 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "'Observability' for the event schema")
     p.add_argument("--impl", default="xla",
                    choices=["xla", "pallas", "pallas_axis", "pallas_step",
-                            "pallas_slab", "pallas_stage"],
+                            "pallas_slab", "pallas_stage", "auto"],
                    help="kernel strategy (pallas = best available: fused/"
                         "VMEM-slab TPU kernels where eligible, XLA "
                         "otherwise — incl. for WENO7 and non-f32 dtypes, "
@@ -134,8 +134,31 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "slab stepper; pallas_stage = pin the 3-D "
                         "per-stage stepper; pallas_axis = pin the "
                         "per-axis slab kernels; pallas_step = whole-step "
-                        "temporal blocking; the summary's 'kernel path' "
+                        "temporal blocking; auto = measured: resolve the "
+                        "rung AND --steps-per-exchange from the tuning "
+                        "cache, measuring candidates on a miss when "
+                        "--tune is given; the summary's 'kernel path' "
                         "line reports what actually ran)")
+    p.add_argument("--steps-per-exchange", type=int, default=1,
+                   metavar="K",
+                   help="communication-avoiding halo cadence: exchange a "
+                        "K*G-deep ghost zone once per K steps (redundant "
+                        "ghost recompute in between) instead of G-deep "
+                        "every step — sharded z-slab slab-rung runs "
+                        "only; 1 = the reference's per-step MPI cadence; "
+                        "with --impl auto the tuner picks K")
+    p.add_argument("--tune", action="store_true",
+                   help="allow the --impl auto tuner to MEASURE on a "
+                        "cache miss: time the (rung x K) candidate "
+                        "space (cost-model pruned) and persist the "
+                        "winner to the tuning cache; without this, auto "
+                        "uses the cache or falls back to --impl pallas")
+    p.add_argument("--tuning-cache", default=None, metavar="PATH",
+                   help="tuning decision cache file (default: "
+                        "$TPUCFD_TUNING_CACHE or ~/.cache/"
+                        "multigpu_advectiondiffusion_tpu/tuning.json); "
+                        "atomic JSON, one audited decision per (solver, "
+                        "shape, dtype, mesh, backend) key")
     p.add_argument("--overlap", default="padded",
                    choices=["padded", "split"],
                    help="sharded halo schedule: 'padded' exchanges before "
@@ -186,6 +209,7 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
         geometry=geometry,
         impl=args.impl,
         overlap=args.overlap,
+        steps_per_exchange=args.steps_per_exchange,
     )
     mesh, decomp = _mesh_decomp(args, grid)
     solver = DiffusionSolver(cfg, mesh=mesh, decomp=decomp)
@@ -229,6 +253,7 @@ def _run_burgers(args, ndim):
         bc=_bc(args, "edge"),
         impl=args.impl,
         overlap=args.overlap,
+        steps_per_exchange=args.steps_per_exchange,
     )
     mesh, decomp = _mesh_decomp(args, grid)
     solver = BurgersSolver(cfg, mesh=mesh, decomp=decomp)
@@ -393,6 +418,15 @@ def main(argv=None):
         from multigpu_advectiondiffusion_tpu import telemetry
 
         owned_sink = telemetry.install(args.metrics)
+    if getattr(args, "tune", False) or getattr(args, "tuning_cache", None):
+        # tuner surface: --tune allows measurement on a cache miss,
+        # --tuning-cache points both lookup and persistence at PATH
+        from multigpu_advectiondiffusion_tpu import tuning
+
+        tuning.configure(
+            cache_path=getattr(args, "tuning_cache", None),
+            enabled=True if getattr(args, "tune", False) else None,
+        )
     if getattr(args, "num_processes", None) is not None or getattr(
         args, "process_id", None
     ) is not None:

@@ -74,7 +74,11 @@ def physics_meta(solver: SolverBase) -> dict:
     state exists), and kernel-strategy knobs that cannot change results."""
     import dataclasses
 
-    skip = {"grid", "ic", "ic_params", "impl", "overlap"}
+    # steps_per_exchange is a kernel-strategy knob like impl/overlap: it
+    # changes the exchange cadence, not the physics a checkpoint
+    # continues under
+    skip = {"grid", "ic", "ic_params", "impl", "overlap",
+            "steps_per_exchange"}
     out = {}
     for f in dataclasses.fields(solver.cfg):
         if f.name in skip:

@@ -91,6 +91,54 @@ class SolverBase:
         # cfg.impl) and the downgrade events themselves
         self._requested_impl = getattr(cfg, "impl", "xla")
         self._degrade_events = []
+        self._tuned = None
+        if self._requested_impl == "auto":
+            # measured dispatch: the tuner resolves (rung, k) per
+            # (solver, shape, dtype, mesh, backend) key from its
+            # persisted decision cache, measuring candidates on a miss
+            # when tuning is enabled (tuning.configure / --tune); the
+            # concrete rung replaces cfg.impl before any dispatch runs
+            from multigpu_advectiondiffusion_tpu import tuning
+
+            decision = tuning.resolve(type(self), cfg, mesh, self.decomp)
+            self._tuned = decision
+            self.cfg = cfg = dataclasses.replace(
+                cfg,
+                impl=decision["impl"],
+                steps_per_exchange=decision.get("steps_per_exchange", 1),
+            )
+        self._validate_steps_per_exchange()
+
+    def _validate_steps_per_exchange(self) -> None:
+        """Gate the communication-avoiding chunk knob the way impl
+        strings are gated (``ops.IMPLS``): a config that cannot honor
+        ``steps_per_exchange > 1`` fails at construction instead of
+        silently running the per-step exchange cadence. Deeper
+        eligibility (VMEM fit, dtype, adaptive dt, shard thickness) is
+        enforced at dispatch by ``_select_slab``, which raises rather
+        than declines when k > 1."""
+        k = int(getattr(self.cfg, "steps_per_exchange", 1) or 1)
+        if k == 1:
+            return
+        if self.grid.ndim != 3:
+            raise ValueError(
+                "steps_per_exchange > 1 rides the 3-D slab stepper only"
+            )
+        if self.mesh is None:
+            raise ValueError(
+                "steps_per_exchange > 1 needs a device mesh — it trades "
+                "deeper halo exchanges for fewer of them"
+            )
+        if any(ax != 0 for ax in self._sharded_axes()):
+            raise ValueError(
+                "steps_per_exchange > 1 serves z-slab decompositions only"
+            )
+        if self.cfg.impl not in ("pallas", "pallas_slab"):
+            raise ValueError(
+                f"steps_per_exchange={k} needs the sharded slab rung "
+                f"(impl='pallas'/'pallas_slab'/'auto'), not "
+                f"impl={self.cfg.impl!r}"
+            )
 
     # ------------------------------------------------------------------ #
     # To be provided by subclasses
@@ -329,6 +377,11 @@ class SolverBase:
 
         if self._requested_impl != "pallas" or not is_kernel_failure(exc):
             return False
+        if int(getattr(self.cfg, "steps_per_exchange", 1) or 1) > 1:
+            # the k-step schedule exists only on the slab rung: falling
+            # down the ladder would silently drop the requested exchange
+            # cadence — fail loudly instead (pin semantics)
+            return False
         engaged = self.engaged_path(mode=mode)["stepper"]
         if engaged in ("generic-xla", "per-axis-pallas") and getattr(
             self.cfg, "impl", "xla"
@@ -386,8 +439,19 @@ class SolverBase:
     def _decline(self, reason: str):
         """Record why the fused fast path was declined (read by
         :meth:`engaged_path`) and return ``None`` for the caller to
-        propagate. Solvers call this at every eligibility exit."""
+        propagate. Solvers call this at every eligibility exit.
+
+        ``steps_per_exchange > 1`` turns every decline into a hard
+        error: the k-step communication-avoiding schedule exists only on
+        the sharded slab rung, so a config that falls off the fused
+        ladder cannot honor the requested exchange cadence — pin
+        semantics, like an undispatachable explicit rung pin."""
         self._fused_fallback = reason
+        if int(getattr(self.cfg, "steps_per_exchange", 1) or 1) > 1:
+            raise ValueError(
+                "steps_per_exchange > 1 needs the sharded slab rung; "
+                f"this config declined fusion: {reason}"
+            )
         return None
 
     def _pallas_f32_gate(self, impl: str) -> str:
@@ -454,8 +518,15 @@ class SolverBase:
                 "impl": impl,
                 "stepper": fused.engaged_label,
                 "overlap": overlap,
+                # comm-avoiding chunk length actually in effect (1 =
+                # the per-step exchange cadence)
+                "steps_per_exchange": int(
+                    getattr(fused, "steps_per_exchange", 1)
+                ),
                 "fallback": None,
             }
+            if self._tuned is not None:
+                out["tuned"] = self._tuned_summary()
             if self._degrade_events:
                 out["degraded"] = list(self._degrade_events)
             return out
@@ -487,11 +558,28 @@ class SolverBase:
             "impl": impl,
             "stepper": stepper,
             "overlap": overlap,
+            "steps_per_exchange": int(
+                getattr(self.cfg, "steps_per_exchange", 1) or 1
+            ),
             "fallback": fallback,
         }
+        if self._tuned is not None:
+            out["tuned"] = self._tuned_summary()
         if self._degrade_events:
             out["degraded"] = list(self._degrade_events)
         return out
+
+    def _tuned_summary(self) -> dict:
+        """Compact tuner provenance for engaged_path/bench rows: where
+        the decision came from and what it measured — enough to audit a
+        published rate without re-opening the cache file."""
+        d = self._tuned or {}
+        return {
+            k: d.get(k)
+            for k in ("source", "impl", "steps_per_exchange", "mlups",
+                      "key")
+            if k in d
+        }
 
     def _sharded_axes(self):
         """Array axes that are *actually* decomposed: listed in the
@@ -538,10 +626,17 @@ class SolverBase:
         returns the ``(lo, hi)`` exchanged z-slabs of the padded
         buffer's core, which the stage's edge calls consume as separate
         operands — so XLA schedules the interior call concurrently with
-        the ppermute instead of serializing on a buffer rewrite."""
+        the ppermute instead of serializing on a buffer rewrite.
+
+        Both closures exchange at the stepper's ``exchange_depth``
+        (the stencil halo per stage/step, or ``k * G`` for the
+        communication-avoiding k-step slab schedule) and take an
+        optional ``repeats`` telemetry hint (see
+        ``parallel.halo.exchange_ghosts``)."""
         if self.mesh is None or not fused.sharded:
             return None, None, None
         sizes = dict(self.mesh.shape)
+        depth = int(getattr(fused, "exchange_depth", fused.halo))
 
         def offsets_fn():
             return jnp.stack(
@@ -561,10 +656,11 @@ class SolverBase:
             off = offs[0]
             lz = fused.interior_shape[0]
 
-            def exch(P):
+            def exch(P, repeats: int = 1):
                 core = slice_axis(P, 0, off, off + lz)
                 return exchange_ghosts(
-                    core, 0, fused.halo, name, nsh, self.bcs[0]
+                    core, 0, depth, name, nsh, self.bcs[0],
+                    repeats=repeats,
                 )
 
             # Pencil meshes: the non-z sharded axes keep the serialized
@@ -587,7 +683,7 @@ class SolverBase:
             return refresh, offsets_fn, exch
 
         refresh = make_ghost_refresh(
-            self.decomp, sizes, self.bcs, fused.halo, fused.interior_shape,
+            self.decomp, sizes, self.bcs, depth, fused.interior_shape,
             core_offsets=getattr(fused, "core_offsets", None),
         )
         return refresh, offsets_fn, None

@@ -59,6 +59,10 @@ class BurgersConfig:
     impl: str = "xla"
     # sharded halo schedule: "padded" | "split" (see DiffusionConfig)
     overlap: str = "padded"
+    # communication-avoiding exchange cadence (see DiffusionConfig):
+    # k*G-deep exchange once per k steps on the sharded slab rung;
+    # impl="auto" lets the measured tuner pick it
+    steps_per_exchange: int = 1
 
     def __post_init__(self):
         from multigpu_advectiondiffusion_tpu.ops import IMPLS
@@ -68,6 +72,13 @@ class BurgersConfig:
         if self.impl not in IMPLS:
             raise ValueError(
                 f"unknown impl {self.impl!r}; ladder rungs: {IMPLS}"
+            )
+        if not isinstance(self.steps_per_exchange, int) or (
+            self.steps_per_exchange < 1
+        ):
+            raise ValueError(
+                "steps_per_exchange must be an int >= 1, got "
+                f"{self.steps_per_exchange!r}"
             )
 
 
@@ -339,39 +350,60 @@ class BurgersSolver(SolverBase):
         """The slab-pipelined whole-run stepper when this fixed-dt 3-D
         config should engage it, else ``None`` (per-stage selection
         proceeds). Shared eligibility (orders, BCs, dtype, halo checks)
-        has already passed when this runs."""
+        has already passed when this runs. ``steps_per_exchange > 1``
+        pins the slab rung (the k-step communication-avoiding schedule
+        lives nowhere else) and turns every decline below into a hard
+        error instead of a silent per-stage fallback."""
         cfg = self.cfg
-        pinned = cfg.impl == "pallas_slab"
+        k = int(getattr(cfg, "steps_per_exchange", 1) or 1)
+        pinned = cfg.impl == "pallas_slab" or k > 1
+
+        def decline(reason):
+            if k > 1:
+                raise ValueError(
+                    f"steps_per_exchange={k} needs the sharded slab "
+                    f"rung: {reason}"
+                )
+            return None
+
         if self.grid.ndim != 3 or cfg.impl not in ("pallas", "pallas_slab"):
-            return None
-        if mode == "t_end" or cfg.adaptive_dt:
-            # no run_to, and adaptive dt needs the between-step global
-            # reduction only the per-stage loop hosts
-            return None
+            return None  # k > 1 on these configs is rejected at __init__
+        if mode == "t_end":
+            return decline("the slab stepper has no run_to (use --iters)")
+        if cfg.adaptive_dt:
+            # adaptive dt needs the between-step global reduction only
+            # the per-stage loop hosts
+            return decline("adaptive dt rides the per-stage stepper")
         from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
             SlabRunBurgersStepper as slab_cls,
         )
+        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
 
+        G = 3 * HALO[cfg.weno_order]
         if self.mesh is not None:
             if not pinned:
                 return None
             if any(ax != 0 for ax in self._sharded_axes()):
-                return None
+                return decline("z-slab decompositions only")
         if not slab_cls.supported(lshape, self.dtype, order=cfg.weno_order):
-            return None
+            return decline("local shape exceeds the slab VMEM budget")
         if not pinned and not slab_cls.profitable(
             lshape, self.dtype, order=cfg.weno_order
         ):
             return None
-        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
-
-        if self.mesh is not None and lshape[0] < 3 * HALO[cfg.weno_order]:
-            return None  # shard too thin to serve the G-deep exchange
+        if self.mesh is not None and lshape[0] < k * G:
+            # shard too thin to serve the k*G-deep exchange
+            return decline(
+                f"local z extent {lshape[0]} cannot serve the "
+                f"{k * G}-deep exchange"
+            )
         if "fused_slab" not in self._cache:
             kwargs = {"order": cfg.weno_order}
             if self.mesh is not None:
                 kwargs["global_shape"] = self.grid.shape
                 kwargs["overlap_split"] = self._split_overlap_requested()
+                if k > 1:
+                    kwargs["steps_per_exchange"] = k
             self._cache["fused_slab"] = slab_cls(
                 lshape, self.dtype, self.grid.spacing, self.flux,
                 cfg.weno_variant, cfg.nu, dt=self.dt, **kwargs,

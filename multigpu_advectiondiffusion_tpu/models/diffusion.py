@@ -69,6 +69,12 @@ class DiffusionConfig:
     # collectives, boundary bands patched after — the reference's
     # boundary-first stream choreography as dataflow, main.c:203-260)
     overlap: str = "padded"
+    # communication-avoiding exchange cadence: exchange a k*G-deep halo
+    # once per k steps (redundant ghost recompute in between) instead of
+    # G-deep every step. 1 = per-step (reference MPI cadence); > 1 rides
+    # the sharded slab rung only and is validated at dispatch like the
+    # impl ladder. impl="auto" lets the measured tuner pick it.
+    steps_per_exchange: int = 1
 
     def __post_init__(self):
         from multigpu_advectiondiffusion_tpu.ops import IMPLS
@@ -80,6 +86,13 @@ class DiffusionConfig:
         if self.impl not in IMPLS:
             raise ValueError(
                 f"unknown impl {self.impl!r}; ladder rungs: {IMPLS}"
+            )
+        if not isinstance(self.steps_per_exchange, int) or (
+            self.steps_per_exchange < 1
+        ):
+            raise ValueError(
+                "steps_per_exchange must be an int >= 1, got "
+                f"{self.steps_per_exchange!r}"
             )
         if self.geometry == "axisymmetric" and self.grid.ndim != 2:
             raise ValueError("axisymmetric geometry requires a 2-D (y, r) grid")
@@ -360,35 +373,53 @@ class DiffusionSolver(SolverBase):
         engage it (the top rung of the 3-D ladder), else ``None`` and
         the caller falls through to the per-stage selection. The
         VMEM-budget block sizing and the traffic-vs-recompute
-        profitability model live in ``fused_slab_run``."""
+        profitability model live in ``fused_slab_run``.
+        ``steps_per_exchange > 1`` pins the slab rung (the k-step
+        schedule lives nowhere else) and turns every decline below into
+        a hard error instead of a silent per-stage fallback."""
         cfg = self.cfg
-        pinned = cfg.impl == "pallas_slab"
-        if self.grid.ndim != 3 or cfg.impl not in ("pallas", "pallas_slab"):
+        k = int(getattr(cfg, "steps_per_exchange", 1) or 1)
+        pinned = cfg.impl == "pallas_slab" or k > 1
+
+        def decline(reason):
+            if k > 1:
+                raise ValueError(
+                    f"steps_per_exchange={k} needs the sharded slab "
+                    f"rung: {reason}"
+                )
             return None
+
+        if self.grid.ndim != 3 or cfg.impl not in ("pallas", "pallas_slab"):
+            return None  # k > 1 on these configs is rejected at __init__
         if mode == "t_end":
-            return None  # no run_to: advance_to keeps the per-stage path
+            # no run_to: advance_to keeps the per-stage path
+            return decline("the slab stepper has no run_to (use --iters)")
         if self.dtype == jnp.bfloat16:
-            return None  # bf16 storage rides the per-stage stepper
+            return decline("bf16 storage rides the per-stage stepper")
         from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
             SlabRunDiffusionStepper as slab_cls,
         )
 
         if self.mesh is not None:
             # whole-run temporal blocking crosses ghost refreshes: under
-            # a mesh the slab stepper runs per-step calls with a G-deep
-            # z exchange per step — z-slab decompositions only, and a
+            # a mesh the slab stepper runs per-step calls with a k*G-deep
+            # z exchange per k steps — z-slab decompositions only, and a
             # measured-unknown tradeoff vs per-stage, so it engages only
-            # when pinned
+            # when pinned (impl='pallas_slab', steps_per_exchange > 1,
+            # or a tuner decision routed through either)
             if not pinned:
                 return None
             if any(ax != 0 for ax in self._sharded_axes()):
-                return None
-            if lshape[0] < slab_cls.halo:
-                return None
+                return decline("z-slab decompositions only")
+            if lshape[0] < k * slab_cls.halo:
+                return decline(
+                    f"local z extent {lshape[0]} cannot serve the "
+                    f"{k * slab_cls.halo}-deep exchange"
+                )
         if not slab_cls.supported(
             lshape, kernel_dtype, sharded=self.mesh is not None
         ):
-            return None
+            return decline("local shape exceeds the slab VMEM budget")
         if not pinned and not slab_cls.profitable(
             lshape, kernel_dtype, sharded=self.mesh is not None
         ):
@@ -398,6 +429,8 @@ class DiffusionSolver(SolverBase):
             if self.mesh is not None:
                 kwargs["global_shape"] = self.grid.shape
                 kwargs["overlap_split"] = self._split_overlap_requested()
+                if k > 1:
+                    kwargs["steps_per_exchange"] = k
             if f64_storage:
                 kwargs["storage_dtype"] = self.dtype
             self._cache["fused_slab"] = slab_cls(

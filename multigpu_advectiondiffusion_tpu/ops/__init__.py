@@ -3,13 +3,15 @@ from multigpu_advectiondiffusion_tpu.ops import flux, laplacian, weno, stencils,
 __all__ = ["flux", "laplacian", "weno", "stencils", "axisym"]
 
 # Every kernel-strategy rung a config may request ("pallas" = best
-# available, suffixed flavors pin one rung). The configs validate
-# against this so a typo'd impl fails at construction instead of
-# silently benchmarking the generic path — and the resilience ladder's
-# degradation targets are guaranteed members.
+# available, suffixed flavors pin one rung, "auto" = measured: the
+# tuning subsystem resolves it to a concrete rung + steps_per_exchange
+# from its persisted decision cache at solver construction). The
+# configs validate against this so a typo'd impl fails at construction
+# instead of silently benchmarking the generic path — and the
+# resilience ladder's degradation targets are guaranteed members.
 IMPLS = (
     "xla", "pallas", "pallas_axis", "pallas_step", "pallas_slab",
-    "pallas_stage",
+    "pallas_stage", "auto",
 )
 
 

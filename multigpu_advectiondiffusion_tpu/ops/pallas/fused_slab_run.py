@@ -50,6 +50,24 @@ per step instead of three). With ``overlap='split'`` the step runs the
 familiar three-call schedule (interior slabs concurrent with the
 in-flight ``ppermute``; only the two edge slabs consume the exchanged
 ``G``-deep slabs), mirroring :mod:`fused_diffusion`'s per-stage split.
+
+**Communication-avoiding k-step schedule** (``steps_per_exchange=k``):
+the within-step G=3h trick generalized ACROSS steps. The padded buffer
+carries ``k*G`` ghost rows per side; ONE ``k*G``-deep exchange per
+k-step block, and in-block step ``j`` evolves the core extended by
+``(k-1-j)*G`` rows per side — the standard trapezoid of temporal
+blocking, here spanning both the RK stages *and* k whole steps. Step 0
+consumes the exchanged ghosts; every later step reads exactly the
+previous step's output window, so the block needs no communication at
+all. Bytes per step are unchanged (``2*k*G`` rows every k steps);
+messages and collective latencies drop by 1/k, paid for with the
+redundant window growth ``~(k-1)*G/lz`` in VPU work and slab traffic.
+Split-overlap composes: the block-start exchange overlaps the interior
+call (output window exactly the locally valid core), with single-slab
+edge calls consuming the ``k*G``-deep operands. Exchange cadence is
+selected per measured tuning decision (``impl='auto'``,
+:mod:`multigpu_advectiondiffusion_tpu.tuning`) or pinned via the
+``steps_per_exchange`` config knob.
 """
 
 from __future__ import annotations
@@ -92,6 +110,29 @@ from multigpu_advectiondiffusion_tpu.ops.weno import HALO
 # ceiling is VMEM_LIMIT = 100 MiB; leave headroom for Mosaic's own
 # scheduling slack, as fused_burgers does).
 _VMEM_BUDGET = 72 * 1024 * 1024
+
+
+def _check_steps_per_exchange(k, sharded: bool, nz: int, G: int) -> int:
+    """Validate the communication-avoiding chunk length for a stepper
+    instance: sharded-only (an unsharded run exchanges nothing, so k is
+    meaningless there) and the shard must be thick enough to *serve* the
+    ``k*G``-deep exchange from its core."""
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"steps_per_exchange must be >= 1, got {k}")
+    if k == 1:
+        return 1
+    if not sharded:
+        raise ValueError(
+            "the k-step communication-avoiding schedule applies to "
+            "sharded (z-slab) runs only"
+        )
+    if nz < k * G:
+        raise ValueError(
+            f"local z extent {nz} cannot serve the k-step schedule's "
+            f"{k * G}-deep exchange (steps_per_exchange={k}, G={G})"
+        )
+    return k
 
 
 def _cross_ok(bz: int, G: int, n_slabs: int) -> bool:
@@ -210,14 +251,25 @@ def _whole_run_kernel(s_in, ss, vs, res, sem_v, sem_w, *, step_fn, bz: int,
             copy_out(i - 1, nslot).wait()
 
 
-def _step_call_kernel(*refs, step_fn, bz: int, G: int, n_slabs: int,
-                      kz_base: int, n_grid: int, ghost_src, sharded: bool):
+def _step_call_kernel(*refs, step_fn, bz: int, G: int, z_out0: int,
+                      n_grid: int, ghost_src, op_rows: int, g_start: int,
+                      sharded: bool):
     """One sharded per-step call (grid = this call's slab range): reads
     the padded state ``s_in``, writes the step result into a separate
-    ping-pong target (aliased out). Roles mirror the per-stage split
-    schedule: ``ghost_src`` = "lo"/"hi" DMAs the G-deep z-ghost rows
-    from the separately exchanged slab operand instead of the buffer
-    (whose z ghosts are stale in split mode)."""
+    ping-pong target (aliased out).
+
+    The call is parameterized on its *output window*: ``n_grid`` slabs
+    of ``bz`` rows starting at padded row ``z_out0``, each computed from
+    a ``bz + 2G``-row input box starting ``G`` rows above. The per-step
+    schedule uses one full-core window; the communication-avoiding deep
+    schedule builds one call per in-block step, the windows shrinking by
+    ``G`` per step (the cross-step trapezoid of redundant ghost
+    recompute). Roles mirror the per-stage split schedule: ``ghost_src``
+    = "lo"/"hi" DMAs ``op_rows`` rows of the box (at its start/end) from
+    the separately exchanged slab operand — ``g_hbm[g_start:]`` — instead
+    of the buffer (whose exchanged-depth z ghosts are stale in split
+    mode). Ghost-consuming calls are always single-slab (``n_grid == 1``)
+    so the operand/buffer split is static."""
     offs = None
     if sharded:
         offs, *refs = refs
@@ -231,41 +283,57 @@ def _step_call_kernel(*refs, step_fn, bz: int, G: int, n_slabs: int,
     k = jnp.asarray(pl.program_id(0), jnp.int32)
     slot = lax.rem(k, jnp.asarray(2, jnp.int32))
     nslot = lax.rem(k + 1, jnp.asarray(2, jnp.int32))
+    box = bz + 2 * G
 
     def copy_in(kk, s):
-        z0 = (kk + kz_base) * bz
+        z0 = (z_out0 - G) + kk * bz  # padded row of the box's first row
         if ghost_src is None:
             return [
                 pltpu.make_async_copy(
-                    s_in.at[pl.ds(z0, bz + 2 * G)], vs.at[s], sem_v.at[s]
+                    s_in.at[pl.ds(z0, box)], vs.at[s], sem_v.at[s]
                 )
             ]
+        # single-slab ghost calls: z0 == z_out0 - G, all splits static
+        cps = []
         if ghost_src == "lo":
-            return [
+            cps.append(
                 pltpu.make_async_copy(
-                    g_hbm, vs.at[s, pl.ds(0, G)], sem_g.at[s]
-                ),
+                    g_hbm.at[pl.ds(g_start, op_rows)],
+                    vs.at[s, pl.ds(0, op_rows)],
+                    sem_g.at[s],
+                )
+            )
+            if op_rows < box:
+                cps.append(
+                    pltpu.make_async_copy(
+                        s_in.at[pl.ds(z0 + op_rows, box - op_rows)],
+                        vs.at[s, pl.ds(op_rows, box - op_rows)],
+                        sem_v.at[s],
+                    )
+                )
+            return cps
+        head = box - op_rows
+        if head:
+            cps.append(
                 pltpu.make_async_copy(
-                    s_in.at[pl.ds(z0 + G, bz + G)],
-                    vs.at[s, pl.ds(G, bz + G)],
+                    s_in.at[pl.ds(z0, head)],
+                    vs.at[s, pl.ds(0, head)],
                     sem_v.at[s],
-                ),
-            ]
-        return [
+                )
+            )
+        cps.append(
             pltpu.make_async_copy(
-                s_in.at[pl.ds(z0, bz + G)],
-                vs.at[s, pl.ds(0, bz + G)],
-                sem_v.at[s],
-            ),
-            pltpu.make_async_copy(
-                g_hbm, vs.at[s, pl.ds(bz + G, G)], sem_g.at[s]
-            ),
-        ]
+                g_hbm.at[pl.ds(g_start, op_rows)],
+                vs.at[s, pl.ds(head, op_rows)],
+                sem_g.at[s],
+            )
+        )
+        return cps
 
     def copy_out(kk, s):
         return pltpu.make_async_copy(
             res.at[s],
-            out.at[pl.ds(G + (kk + kz_base) * bz, bz)],
+            out.at[pl.ds(z_out0 + kk * bz, bz)],
             sem_w.at[s],
         )
 
@@ -283,7 +351,7 @@ def _step_call_kernel(*refs, step_fn, bz: int, G: int, n_slabs: int,
         cp.wait()
 
     oz = offs[0] if offs is not None else 0
-    out_rows = step_fn(vs[slot], k + kz_base, oz)
+    out_rows = step_fn(vs[slot], k, oz)
 
     @pl.when(k >= 2)
     def _():
@@ -316,11 +384,16 @@ class _SlabRunStepper:
     # runs the stored-x-ghost layout (z-slab decompositions only)
     _emit_max = False
     x_sharded = False
+    # communication-avoiding chunk length k and the per-exchange ghost
+    # depth k*G; sharded instances with steps_per_exchange > 1 override
+    # in __init__ (models/base._fused_sharded_ctx exchanges
+    # ``exchange_depth`` rows instead of the per-step stencil halo)
+    k = steps_per_exchange = 1
 
     # populated by subclass __init__:
     #   interior_shape, global_shape, sharded, overlap_split, halo (=G),
-    #   core_offsets, padded_shape, dtype (kernel), _storage, dt, bz,
-    #   n_slabs, _step_fn
+    #   exchange_depth (=k*G), core_offsets, padded_shape, dtype
+    #   (kernel), _storage, dt, bz, n_slabs, _step_fn
 
     def _scratch(self):
         trailing = self.padded_shape[1:]
@@ -353,30 +426,57 @@ class _SlabRunStepper:
         )(SS)
         return out[num_iters % 2]
 
-    def _make_step_call(self, role: str):
-        G, bz, n_slabs = self.halo, self.bz, self.n_slabs
-        if role == "full":
-            kz_base, n_grid, ghost_src = 0, n_slabs, None
-        elif role == "interior":
-            kz_base, n_grid, ghost_src = 1, n_slabs - 2, None
-        elif role == "bottom":
-            kz_base, n_grid, ghost_src = 0, 1, "lo"
-        elif role == "top":
-            kz_base, n_grid, ghost_src = n_slabs - 1, 1, "hi"
-        else:  # pragma: no cover - internal
-            raise ValueError(f"unknown role {role!r}")
-        use_g = ghost_src is not None
+    def _make_call(self, z_out0: int, bz: int, n_grid: int, ghost_src=None):
+        """One sharded step call writing ``n_grid`` slabs of ``bz`` rows
+        at padded row ``z_out0`` (input boxes reach ``G`` rows beyond on
+        both sides). ``ghost_src`` = "lo"/"hi" sources the box rows that
+        fall inside the exchanged-depth ghost region from the separately
+        exchanged slab operand (single-slab calls only: the split is
+        computed statically here)."""
+        G = self.halo
+        depth = self.exchange_depth  # k*G rows per exchanged operand
+        pz = self.padded_shape[0]
+        box = bz + 2 * G
+        op_rows = g_start = 0
+        if ghost_src is not None:
+            if n_grid != 1:  # pragma: no cover - internal invariant
+                raise ValueError("ghost-consuming calls are single-slab")
+            b0 = z_out0 - G
+            if ghost_src == "lo":
+                # operand covers padded rows [0, depth)
+                op_rows = min(depth - b0, box)
+                g_start = b0
+            else:
+                # operand covers padded rows [pz - depth, pz)
+                op_rows = min(b0 + box - (pz - depth), box)
+                g_start = b0 + (box - op_rows) - (pz - depth)
+            if op_rows <= 0:  # pragma: no cover - internal invariant
+                raise ValueError("ghost call consumes no operand rows")
+        # global z of a box's first row: padded row minus the core
+        # offset (exchange depth) plus this shard's global offset (oz,
+        # traced — applied in-kernel)
+        gz_base = z_out0 - G - self.core_offsets[0]
 
         kern = functools.partial(
             _step_call_kernel,
-            step_fn=lambda v, jj, oz: self._step_fn(v, jj * bz - G + oz),
-            bz=bz, G=G, n_slabs=n_slabs, kz_base=kz_base, n_grid=n_grid,
-            ghost_src=ghost_src, sharded=True,
+            step_fn=lambda v, kk, oz: self._step_fn(
+                v, gz_base + kk * bz + oz
+            ),
+            bz=bz, G=G, z_out0=z_out0, n_grid=n_grid,
+            ghost_src=ghost_src, op_rows=op_rows, g_start=g_start,
+            sharded=True,
         )
+        use_g = ghost_src is not None
         n_in = 1 + 1 + (1 if use_g else 0) + 1  # offs, s_in, [g], tgt
         in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
         in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 1)
-        scratch = self._scratch()
+        trailing = self.padded_shape[1:]
+        scratch = [
+            pltpu.VMEM((2, box) + trailing, self.dtype),
+            pltpu.VMEM((2, bz) + trailing, self.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
         if use_g:
             scratch.append(pltpu.SemaphoreType.DMA((2,)))
         return pl.pallas_call(
@@ -391,13 +491,65 @@ class _SlabRunStepper:
             interpret=interpret_mode(),
         )
 
+    def _pick_call_bz(self, extent: int) -> int:
+        """Largest viable z-block tiling ``extent`` exactly (the deep
+        schedule's windows all differ, so each call picks its own)."""
+        raise NotImplementedError
+
     def _build_sharded_calls(self):
+        G, bz, n_slabs = self.halo, self.bz, self.n_slabs
+        if self.k > 1:
+            self._build_deep_calls()
+            return
         if self.overlap_split:
-            self._calls = tuple(
-                self._make_step_call(r) for r in ("interior", "bottom", "top")
+            self._calls = (
+                self._make_call(G + bz, bz, n_slabs - 2),        # interior
+                self._make_call(G, bz, 1, ghost_src="lo"),       # bottom
+                self._make_call(G + (n_slabs - 1) * bz, bz, 1,
+                                ghost_src="hi"),                  # top
             )
         else:
-            self._calls = (self._make_step_call("full"),)
+            self._calls = (self._make_call(G, bz, n_slabs),)
+
+    def _build_deep_calls(self):
+        """The communication-avoiding k-step block: one call per in-block
+        step ``j``, its output window the core extended by
+        ``(k-1-j) * G`` rows per side — the cross-step trapezoid. Step 0
+        consumes the freshly exchanged ``k*G``-deep ghosts (from the
+        buffer after a deep refresh, or — split mode — from the
+        exchanged slab operands via single-slab edge calls that overlap
+        the interior call with the in-flight ppermute); each later step
+        reads exactly the previous step's output window, so nothing else
+        in the block depends on communication."""
+        G, k, lz = self.halo, self.k, self.interior_shape[0]
+        depth = self.exchange_depth  # k*G
+        pz = self.padded_shape[0]
+        calls = []
+        for j in range(k):
+            ext = lz + 2 * (k - 1 - j) * G
+            bz_j = self._pick_call_bz(ext)
+            calls.append(self._make_call((j + 1) * G, bz_j, ext // bz_j))
+        self._deep_calls = tuple(calls)
+        if not self.overlap_split:
+            return
+        # split step 0: the interior call covers the window computable
+        # from the locally valid core alone (box exactly [depth,
+        # pz-depth)); the ghost-region output rows come from unrolled
+        # single-slab edge calls consuming the exchanged operands
+        ext_i = lz - 2 * G
+        bz_i = self._pick_call_bz(ext_i)
+        self._deep_interior = self._make_call(G + depth, bz_i,
+                                              ext_i // bz_i)
+        bz_e = self._pick_call_bz(depth)
+        self._deep_bottom = tuple(
+            self._make_call(G + i * bz_e, bz_e, 1, ghost_src="lo")
+            for i in range(depth // bz_e)
+        )
+        self._deep_top = tuple(
+            self._make_call(pz - G - depth + i * bz_e, bz_e, 1,
+                            ghost_src="hi")
+            for i in range(depth // bz_e)
+        )
 
     def run(self, u, t, num_iters: int, refresh=None, offsets=None,
             exch=None):
@@ -406,7 +558,11 @@ class _SlabRunStepper:
         slab-pipelined call per step with a G-deep z-ghost ``refresh``
         per step — or, in split mode, ``exch``'s exchanged G-slabs
         consumed by the two edge calls while the interior call overlaps
-        the ppermute."""
+        the ppermute. With ``steps_per_exchange = k > 1`` the
+        communication-avoiding schedule runs instead: ONE ``k*G``-deep
+        exchange per k-step block, the in-between steps recomputing the
+        ghost zone redundantly on shrinking windows (split mode overlaps
+        each block's exchange with the block-start interior call)."""
         if num_iters == 0:
             return u, t
         if not self.sharded:
@@ -422,10 +578,62 @@ class _SlabRunStepper:
         elif refresh is None:
             raise ValueError("sharded slab stepper needs a ghost refresh")
 
+        from multigpu_advectiondiffusion_tpu.ops.pallas.stepper_base import (
+            _with_repeats,
+            chunk_counts,
+        )
+
         S = self.embed(u)
         T = S
+        if self.k > 1:
+            full_blocks, rem = chunk_counts(num_iters, self.k)
+
+            def block(S, T, nsteps, refresh_b, exch_b):
+                if self.overlap_split:
+                    with jax.named_scope("tpucfd.slab_deep_exchange"):
+                        lo, hi = exch_b(S)
+                    with jax.named_scope(
+                        f"tpucfd.{self.engaged_label}[deep-split]"
+                    ):
+                        T = self._deep_interior(offsets, S, T)
+                        for c in self._deep_bottom:
+                            T = c(offsets, S, lo, T)
+                        for c in self._deep_top:
+                            T = c(offsets, S, hi, T)
+                else:
+                    with jax.named_scope("tpucfd.slab_deep_refresh"):
+                        S = refresh_b(S)
+                    with jax.named_scope(
+                        f"tpucfd.{self.engaged_label}[deep]"
+                    ):
+                        T = self._deep_calls[0](offsets, S, T)
+                S, T = T, S
+                with jax.named_scope(f"tpucfd.{self.engaged_label}[deep]"):
+                    for j in range(1, nsteps):
+                        T = self._deep_calls[j](offsets, S, T)
+                        S, T = T, S
+                return S, T
+
+            if full_blocks:
+                S, T = lax.fori_loop(
+                    0, full_blocks,
+                    lambda i, c: block(
+                        c[0], c[1], self.k,
+                        _with_repeats(refresh, full_blocks),
+                        _with_repeats(exch, full_blocks),
+                    ),
+                    (S, T),
+                )
+            if rem:
+                # partial tail block: a full-depth exchange still buys
+                # only ``rem`` steps (priced in PARITY.md); the core is
+                # valid after any prefix of a block's steps
+                S, T = block(S, T, rem, refresh, exch)
+            return self.extract(S), accumulate_t(t, self.dt, num_iters)
+
         if self.overlap_split:
             interior, bottom, top = self._calls
+            exch_loop = _with_repeats(exch, num_iters)
 
             def body(it, carry):
                 # named_scope: the split-overlap schedule's pieces are
@@ -433,7 +641,7 @@ class _SlabRunStepper:
                 # G-slabs next to the interior call they overlap with
                 S, T = carry
                 with jax.named_scope("tpucfd.slab_split_exchange"):
-                    lo, hi = exch(S)
+                    lo, hi = exch_loop(S)
                 with jax.named_scope(
                     f"tpucfd.{self.engaged_label}[split]"
                 ):
@@ -443,11 +651,12 @@ class _SlabRunStepper:
 
         else:
             (full,) = self._calls
+            refresh_loop = _with_repeats(refresh, num_iters)
 
             def body(it, carry):
                 S, T = carry
                 with jax.named_scope("tpucfd.slab_ghost_refresh"):
-                    S = refresh(S)
+                    S = refresh_loop(S)
                 with jax.named_scope(f"tpucfd.{self.engaged_label}"):
                     T = full(offsets, S, T)
                 return T, S
@@ -524,7 +733,8 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value, block_z=None, global_shape=None,
-                 overlap_split: bool = False, storage_dtype=None):
+                 overlap_split: bool = False, storage_dtype=None,
+                 steps_per_exchange: int = 1):
         nz, ny, nx = interior_shape
         G = _G_DIFF
         self.interior_shape = tuple(interior_shape)
@@ -533,6 +743,10 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
         self.dtype = jnp.dtype(dtype)
         self._storage = jnp.dtype(storage_dtype or dtype)
         self.bc_value = float(bc_value)
+        k = _check_steps_per_exchange(steps_per_exchange, self.sharded,
+                                      nz, G)
+        self.k = self.steps_per_exchange = k
+        self.exchange_depth = k * G
         row_bytes = _diff_row_bytes(interior_shape, self.dtype.itemsize)
         if block_z is None:
             block_z = _pick_bz_diffusion(
@@ -547,22 +761,29 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
         nz_eff = nz if self.sharded else -(-nz // bz) * bz
         self.n_slabs = nz_eff // bz
         self.padded_shape = (
-            nz_eff + 2 * G,
+            nz_eff + 2 * self.exchange_depth,
             round_up(ny + 2 * R, SUBLANE),
             round_up(nx + 2 * R, LANE),
         )
-        self.core_offsets = (G, R, R)
+        self.core_offsets = (self.exchange_depth, R, R)
         scales = tuple(
             float(diffusivity[i]) / (12.0 * spacing[i] * spacing[i])
             for i in range(3)
         )
         self.dt = float(dt)
-        # split-overlap needs interior slabs that never touch the stale
-        # z-ghost rows: bz >= G, and a non-degenerate interior band
-        self.overlap_split = bool(
-            overlap_split and self.sharded
-            and self.n_slabs >= 3 and bz >= G
-        )
+        # split-overlap needs interior work that never touches the stale
+        # z-ghost rows: per-step (k=1) that is >= 3 slabs with bz >= G;
+        # the deep schedule's block-start interior call just needs a
+        # non-empty window strictly inside the exchanged core (nz > 2G)
+        if k > 1:
+            self.overlap_split = bool(
+                overlap_split and self.sharded and nz > 2 * G
+            )
+        else:
+            self.overlap_split = bool(
+                overlap_split and self.sharded
+                and self.n_slabs >= 3 and bz >= G
+            )
 
         stage = functools.partial(
             _stage_rows, interior_shape=self.global_shape, scales=scales,
@@ -572,11 +793,17 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
 
         def step_fn(v, base_z):
             # the whole-step chain (fused_diffusion_step) on one slab:
-            # windows narrow by 2R per stage, masks at global z indices
+            # windows narrow by 2R per stage, masks at global z indices.
+            # Window extents derive from the box (not self.bz) so the
+            # deep schedule's per-call block sizes all serve; rows
+            # outside the global domain pass through _stage_rows
+            # untouched (neither interior nor face), keeping the
+            # exchanged Dirichlet ghosts frozen across a k-step block.
+            w = v.shape[0]
             t1 = stage(v, None, gz0=base_z + R, a=a1, b=b1)
-            t2 = stage(t1, v[2 * R: 2 * R + bz + 2 * R],
+            t2 = stage(t1, v[2 * R: w - 2 * R],
                        gz0=base_z + 2 * R, a=a2, b=b2)
-            return stage(t2, v[3 * R: 3 * R + bz],
+            return stage(t2, v[3 * R: w - 3 * R],
                          gz0=base_z + 3 * R, a=a3, b=b3)
 
         self._step_fn = step_fn
@@ -613,16 +840,20 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
         n_slabs = -(-nz // bz)
         return bz >= 4 * _G_DIFF or n_slabs <= 2
 
+    def _pick_call_bz(self, extent: int) -> int:
+        row = _diff_row_bytes(self.interior_shape, self.dtype.itemsize)
+        return _pick_bz_diffusion(extent, row, True, G=self.halo)
+
     def embed(self, u):
         full = jnp.full(self.padded_shape, self.bc_value, self.dtype)
         return lax.dynamic_update_slice(
-            full, u.astype(self.dtype), (self.halo, R, R)
+            full, u.astype(self.dtype), self.core_offsets
         )
 
     def extract(self, S):
         nz, ny, nx = self.interior_shape
-        G = self.halo
-        out = lax.slice(S, (G, R, R), (G + nz, R + ny, R + nx))
+        d = self.exchange_depth
+        out = lax.slice(S, (d, R, R), (d + nz, R + ny, R + nx))
         return out.astype(self._storage)
 
 
@@ -684,7 +915,7 @@ class SlabRunBurgersStepper(_SlabRunStepper):
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float, block_z=None,
                  global_shape=None, overlap_split: bool = False,
-                 order: int = 5):
+                 order: int = 5, steps_per_exchange: int = 1):
         if order not in HALO:
             raise ValueError(f"unsupported WENO order {order}")
         if order == 7 and variant != "js":
@@ -699,6 +930,10 @@ class SlabRunBurgersStepper(_SlabRunStepper):
         self.sharded = self.global_shape != self.interior_shape
         self.dtype = jnp.dtype(dtype)
         self._storage = self.dtype
+        k = _check_steps_per_exchange(steps_per_exchange, self.sharded,
+                                      nz, G)
+        self.k = self.steps_per_exchange = k
+        self.exchange_depth = k * G
         row_bytes = _burg_row_bytes(interior_shape, self.dtype.itemsize, r)
         if block_z is None:
             block_z = _pick_bz_burgers(
@@ -714,17 +949,22 @@ class SlabRunBurgersStepper(_SlabRunStepper):
         bz = self.bz = block_z
         self.n_slabs = nz // bz
         self.padded_shape = (
-            nz + 2 * G,
+            nz + 2 * self.exchange_depth,
             round_up(ny + 2 * r, SUBLANE),
             round_up(nx + 2 * r, LANE),
         )
         self.r = r
-        self.core_offsets = (G, r, r)
+        self.core_offsets = (self.exchange_depth, r, r)
         self.dt = float(dt)
-        self.overlap_split = bool(
-            overlap_split and self.sharded
-            and self.n_slabs >= 3 and bz >= G
-        )
+        if k > 1:
+            self.overlap_split = bool(
+                overlap_split and self.sharded and nz > 2 * G
+            )
+        else:
+            self.overlap_split = bool(
+                overlap_split and self.sharded
+                and self.n_slabs >= 3 and bz >= G
+            )
         inv_dx = tuple(1.0 / spacing[i] for i in range(3))
         nu_scales = None
         if nu:
@@ -734,27 +974,49 @@ class SlabRunBurgersStepper(_SlabRunStepper):
             )
         NZ, NY, NX = self.global_shape
 
-        def fill(t, base, lo_src, hi_src):
+        deep = k > 1
+
+        def fill(t, base, zsrc):
             """Edge-replicate ghost/slack cells (WENO5resAdv_X.m:53):
             x/y from the static boundary columns; z keyed on *global*
             row indices, so the masks are nonempty only on the slabs
-            (and shards) that actually touch a wall — where the replica
-            source row sits at the static index ``lo_src``/``hi_src``.
-            Elsewhere the mask is empty and the ghost rows keep their
-            loaded (neighbor/recomputed) values."""
+            (and shards) that actually touch a wall. ``zsrc``: ``None``
+            skips the z fill (the window has no out-of-domain rows), a
+            static ``(lo_src, hi_src)`` pair names the replica source
+            rows at fixed slab-local positions (per-step schedule), and
+            ``"dyn"`` indexes them dynamically from the traced window
+            origin — the deep schedule's windows shift per in-block
+            step, so the wall row has no fixed slab-local position
+            (clipped: when the wall is outside this box the mask is
+            empty and the clipped read is harmless)."""
             gx = lax.broadcasted_iota(jnp.int32, t.shape, 2) - r
             t = jnp.where(gx < 0, t[:, :, r: r + 1], t)
             t = jnp.where(gx >= NX, t[:, :, r + NX - 1: r + NX], t)
             gy = lax.broadcasted_iota(jnp.int32, t.shape, 1) - r
             t = jnp.where(gy < 0, t[:, r: r + 1], t)
             t = jnp.where(gy >= NY, t[:, r + NY - 1: r + NY], t)
-            if lo_src is not None:
-                gz = lax.broadcasted_iota(jnp.int32, t.shape, 0) + base
-                t = jnp.where(gz < 0, t[lo_src: lo_src + 1], t)
-                t = jnp.where(gz >= NZ, t[hi_src: hi_src + 1], t)
+            if zsrc is None:
+                return t
+            gz = lax.broadcasted_iota(jnp.int32, t.shape, 0) + base
+            if zsrc == "dyn":
+                n = t.shape[0]
+                zero = jnp.asarray(0, jnp.int32)
+                top = jnp.asarray(n - 1, jnp.int32)
+                lo = lax.dynamic_slice_in_dim(
+                    t, jnp.clip(-base, zero, top), 1, axis=0
+                )
+                hi = lax.dynamic_slice_in_dim(
+                    t, jnp.clip(NZ - 1 - base, zero, top), 1, axis=0
+                )
+            else:
+                lo_src, hi_src = zsrc
+                lo = t[lo_src: lo_src + 1]
+                hi = t[hi_src: hi_src + 1]
+            t = jnp.where(gz < 0, lo, t)
+            t = jnp.where(gz >= NZ, hi, t)
             return t
 
-        def stage(u, vwin, a, b, w_out, base, lo_src, hi_src, dtv):
+        def stage(u, vwin, a, b, w_out, base, zsrc, dtv):
             vc = vwin[r: r + w_out]
             vp, vm = _split(flux, vwin)
             Y = vwin.shape[1]
@@ -779,25 +1041,32 @@ class SlabRunBurgersStepper(_SlabRunStepper):
             rk = b * (vc + dtv * rhs) if a == 0.0 else (
                 a * u + b * (vc + dtv * rhs)
             )
-            return fill(rk.astype(vwin.dtype), base, lo_src, hi_src)
+            return fill(rk.astype(vwin.dtype), base, zsrc)
 
         (a1, b1), (a2, b2), (a3, b3) = _STAGES
-        w = bz + 2 * G
         dt_f = self.dt  # python float: materialized in-kernel, not captured
 
         def step_fn(v, base_z):
             d = jnp.asarray(dt_f, v.dtype)
-            # step-input z ghosts are stale in HBM (never rewritten):
+            # windows derive from the box (not self.bz): the deep
+            # schedule's per-call block sizes all route through here.
+            # Step-input z ghosts are stale in HBM (never rewritten):
             # re-synthesize at the global walls; shard-interior ghosts
             # hold fresh neighbor rows (refresh/exch) and pass through
-            v = fill(v, base_z, G, bz + G - 1)
+            w = v.shape[0]
+            bw = w - 2 * G
+            v = fill(v, base_z, "dyn" if deep else (G, bw + G - 1))
             t1 = stage(None, v, a1, b1, w - 2 * r, base_z + r,
-                       G - r, bz + 2 * r - 1, d)
+                       "dyn" if deep else (G - r, bw + 2 * r - 1), d)
             t2 = stage(v[2 * r: w - 2 * r], t1, a2, b2, w - 4 * r,
-                       base_z + 2 * r, G - 2 * r, bz + r - 1, d)
-            # stage-3 output is exactly the core: no z-ghost rows left
-            return stage(v[G: G + bz], t2, a3, b3, bz,
-                         base_z + G, None, None, d)
+                       base_z + 2 * r,
+                       "dyn" if deep else (G - 2 * r, bw + r - 1), d)
+            # k=1: stage-3 output is exactly the core — no z-ghost rows
+            # left; deep windows still carry ghost-region rows, which on
+            # wall shards may sit outside the domain and need the
+            # replica fill like every other stage
+            return stage(v[G: w - G], t2, a3, b3, bw,
+                         base_z + G, "dyn" if deep else None, d)
 
         self._step_fn = step_fn
         if self.sharded:
@@ -824,17 +1093,28 @@ class SlabRunBurgersStepper(_SlabRunStepper):
             return False
         return bz >= 6 * r or nz // bz <= 2
 
+    def _pick_call_bz(self, extent: int) -> int:
+        row = _burg_row_bytes(
+            self.interior_shape, self.dtype.itemsize, self.r
+        )
+        b = _pick_bz_burgers(extent, row, self.r, self.order)
+        if b is None:  # pragma: no cover - _VMEM_BUDGET admits bz=1
+            raise ValueError(
+                f"no viable slab block for a {extent}-row deep window"
+            )
+        return b
+
     def embed(self, u):
-        G, r = self.halo, self.r
+        d, r = self.exchange_depth, self.r
         nz, ny, nx = self.interior_shape
         pz, py, px = self.padded_shape
         return jnp.pad(
             u.astype(self.dtype),
-            ((G, G), (r, py - ny - r), (r, px - nx - r)),
+            ((d, d), (r, py - ny - r), (r, px - nx - r)),
             mode="edge",
         )
 
     def extract(self, S):
-        G, r = self.halo, self.r
+        d, r = self.exchange_depth, self.r
         nz, ny, nx = self.interior_shape
-        return lax.slice(S, (G, r, r), (G + nz, r + ny, r + nx))
+        return lax.slice(S, (d, r, r), (d + nz, r + ny, r + nx))

@@ -29,9 +29,42 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def chunk_counts(num_iters: int, steps_per_exchange: int):
+    """``(full_blocks, remainder)`` of the communication-avoiding k-step
+    chunk schedule: ``full_blocks`` whole blocks of ``steps_per_exchange``
+    steps (one deep halo exchange each) plus one partial block of
+    ``remainder`` steps (which still pays a full-depth exchange — the
+    per-run cost of a non-multiple iteration count, not a correctness
+    issue: every block starts from a fully refreshed buffer). The ONE
+    definition both the slab stepper's run loop and the telemetry
+    byte-accounting use, so "exchanges per run" cannot fork between the
+    executed schedule and the reported one."""
+    if steps_per_exchange < 1:
+        raise ValueError(
+            f"steps_per_exchange must be >= 1, got {steps_per_exchange}"
+        )
+    return num_iters // steps_per_exchange, num_iters % steps_per_exchange
+
+
+def _with_repeats(fn, repeats: int):
+    """Bind the static telemetry ``repeats`` hint into a refresh/exch
+    closure headed into a ``fori_loop`` body (trace-once, execute-N)."""
+    if fn is None:
+        return None
+    return lambda P: fn(P, repeats=repeats)
+
+
 class FusedStepperBase:
     needs_offsets = False
     engaged_label = "fused-stage"  # what engaged_path()/PrintSummary report
+    # communication-avoiding chunk length: the per-stage kernels bake
+    # one stencil-halo refresh per RK stage into their dataflow, so the
+    # per-stage family serves k=1 only — the k-step deep-halo schedule
+    # lives on the slab whole-run rung (ops/pallas/fused_slab_run.py),
+    # which overrides this per instance. Dispatch validates the knob
+    # against the engaged rung (models/base.py) and fails loudly rather
+    # than silently running the per-step cadence.
+    steps_per_exchange = 1
 
     def _dt_value(self, S):
         raise NotImplementedError
@@ -87,7 +120,13 @@ class FusedStepperBase:
             # pencil split mode: the serialized (non-z) axes' refresh —
             # the z ghosts ride the exchanged-slab operands instead
             S = refresh(S)
-        dt_of, step_of, m0 = self._loop_pieces(u, refresh, offsets, exch)
+        # exchanges inside the fori body trace ONCE but execute
+        # num_iters times: bind the static count so the telemetry byte
+        # counters report true bytes per compiled execution
+        dt_of, step_of, m0 = self._loop_pieces(
+            u, _with_repeats(refresh, num_iters), offsets,
+            _with_repeats(exch, num_iters),
+        )
 
         def body(i, carry):
             S, T1, T2, t, m = carry
@@ -106,7 +145,11 @@ class FusedStepperBase:
 
         The reference drivers' native ``while (t < tEnd)`` mode at the
         fused stepper's speed, with the final step trimmed through the
-        runtime SMEM dt scalar.
+        runtime SMEM dt scalar. (The halo telemetry counters record
+        this mode's loop-resident exchange sites at ``repeats=1`` — a
+        ``while_loop`` trip count is dynamic, so per-execution bytes
+        are not statically knowable here; scale by the summary's step
+        count instead.)
         """
         self._check_sharded_args(refresh, offsets, exch)
         S = self.embed(u)

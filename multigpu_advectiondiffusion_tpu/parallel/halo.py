@@ -38,6 +38,7 @@ def exchange_ghosts(
     mesh_axis: str,
     num_shards: int,
     bc: Boundary,
+    repeats: int = 1,
 ):
     """The two ``ppermute`` shifts of a halo exchange, returned as the
     ``(lo, hi)`` ghost slabs without concatenating onto ``u``.
@@ -48,13 +49,21 @@ def exchange_ghosts(
     interior compute that does not depend on them — the role of the
     reference's boundary-first five-stream choreography
     (``MultiGPU/Diffusion3d_Baseline/main.c:203-297``).
+
+    ``halo`` is the exchange *depth* — the communication-avoiding k-step
+    schedule passes ``k * G`` here (one deep exchange per k-step block)
+    while the per-step schedules pass the stencil halo. ``repeats`` is a
+    telemetry-only hint: how many times the compiled program executes
+    this trace site per run (e.g. the loop trip count when the exchange
+    sits inside a ``fori_loop`` body), so ``halo.bytes_per_execution``
+    reports true bytes moved instead of one trace-site's worth.
     """
     n_local = u.shape[axis]
     if n_local < halo:
         raise ValueError(
             f"shard of {n_local} cells can't serve a halo of {halo} on axis {axis}"
         )
-    _record_exchange(u, axis, halo, mesh_axis)
+    _record_exchange(u, axis, halo, mesh_axis, repeats)
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [((i + 1) % num_shards, i) for i in range(num_shards)]
     # left halo <- left neighbor's rightmost cells; right halo <- right
@@ -79,15 +88,21 @@ def exchange_ghosts(
         return from_left, from_right
 
 
-def _record_exchange(u, axis: int, halo: int, mesh_axis: str) -> None:
+def _record_exchange(
+    u, axis: int, halo: int, mesh_axis: str, repeats: int = 1
+) -> None:
     """Telemetry record of one halo exchange *site*.
 
-    Runs at TRACE time (``exchange_ghosts`` executes under ``jit``), so
-    each counter increment describes one exchange **per execution of the
-    compiled program** — e.g. a fused 3-step chunk that exchanges per RK
-    stage traces 3 sites; multiply by executed chunks for run totals.
-    ``bytes`` is the per-execution ICI/DCN payload of the site: two
-    ``halo``-deep slabs (lo + hi) of the shard-local block."""
+    Runs at TRACE time (``exchange_ghosts`` executes under ``jit``).
+    ``bytes`` is the ICI/DCN payload of the site per compiled execution:
+    two ``halo``-deep slabs (lo + hi) of the shard-local block, times
+    ``repeats`` — the caller's static count of how often the site runs
+    inside one execution (loop trip count for exchanges traced inside a
+    ``fori_loop`` body, number of k-step blocks for the deep
+    communication-avoiding schedule; 1 for straight-line sites). Sites
+    in dynamic-trip loops (``while_loop`` run_to) cannot know their
+    count and record ``repeats=1`` — the stream still carries the depth
+    so a consumer can scale by the summary's step count."""
     from multigpu_advectiondiffusion_tpu import telemetry
 
     sink = telemetry.get_sink()
@@ -97,10 +112,12 @@ def _record_exchange(u, axis: int, halo: int, mesh_axis: str) -> None:
     for ax, n in enumerate(u.shape):
         slab *= halo if ax == axis else int(n)
     nbytes = 2 * slab * jnp.dtype(u.dtype).itemsize
-    sink.counter("halo.exchanges_traced", 1, axis=axis, mesh_axis=mesh_axis)
     sink.counter(
-        "halo.bytes_per_execution", nbytes,
-        axis=axis, mesh_axis=mesh_axis, halo=halo,
+        "halo.exchanges_traced", 1, axis=axis, mesh_axis=mesh_axis
+    )
+    sink.counter(
+        "halo.bytes_per_execution", int(repeats) * nbytes,
+        axis=axis, mesh_axis=mesh_axis, halo=halo, repeats=int(repeats),
     )
 
 
@@ -189,6 +206,13 @@ def make_ghost_refresh(
     ``core_offsets`` gives the interior origin in the padded layout per
     axis (default ``halo`` on every axis — steppers with alignment
     margins, e.g. the fused Burgers y axis, sit deeper).
+
+    ``halo`` is the refresh *depth*: the per-step schedules pass the
+    stepper's stencil halo, the communication-avoiding k-step schedule
+    passes its deep ``k * G`` exchange depth (with ``core_offsets``
+    sitting ``k * G`` in). The closure takes an optional ``repeats``
+    telemetry hint (see :func:`exchange_ghosts`) so loop-resident
+    refreshes report true bytes per compiled execution.
     """
     offs = (
         tuple(core_offsets)
@@ -202,14 +226,14 @@ def make_ghost_refresh(
         and axis_extent(mesh_axis_sizes, decomp.mesh_axis(ax)) > 1
     ]
 
-    def refresh(P: jnp.ndarray) -> jnp.ndarray:
+    def refresh(P: jnp.ndarray, repeats: int = 1) -> jnp.ndarray:
         for ax, name in sharded:
             n_loc = interior_local[ax]
             off = offs[ax]
             core = slice_axis(P, ax, off, off + n_loc)
             lo, hi = exchange_ghosts(
                 core, ax, halo, name, axis_extent(mesh_axis_sizes, name),
-                bcs[ax],
+                bcs[ax], repeats=repeats,
             )
             P = lax.dynamic_update_slice_in_dim(P, lo, off - halo, axis=ax)
             P = lax.dynamic_update_slice_in_dim(P, hi, off + n_loc, axis=ax)

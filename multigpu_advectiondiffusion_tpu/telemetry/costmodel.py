@@ -153,6 +153,41 @@ def step_cost(
     )
 
 
+# Per-message fixed cost of one halo exchange (ppermute pair): launch +
+# interconnect latency, not bandwidth. Crude by design — it only has to
+# rank k-candidates for the tuner's pruning, and the measured pass
+# decides. Env-overridable like the peaks.
+EXCHANGE_LATENCY_S = 25e-6
+
+
+def halo_exchange_seconds(
+    nbytes: float,
+    messages: int = 1,
+    backend: Optional[str] = None,
+) -> float:
+    """Modeled wall time of halo traffic: ``messages`` fixed per-message
+    latencies (``TPUCFD_EXCHANGE_LATENCY_S`` overrides the default) plus
+    the payload at the backend's peak bandwidth. The communication-
+    avoiding tradeoff in one line: a k-step schedule moves the same
+    bytes per step but pays the latency term only once per k steps."""
+    lat = float(
+        os.environ.get("TPUCFD_EXCHANGE_LATENCY_S", EXCHANGE_LATENCY_S)
+    )
+    peak_b, _ = peak_rates(backend)
+    return messages * lat + (nbytes / peak_b if peak_b else 0.0)
+
+
+def deep_halo_recompute_factor(local_nz: int, G: int, k: int) -> float:
+    """Mean redundant-work multiplier of the k-step deep-halo schedule
+    on a ``local_nz``-row shard: in-block step ``j`` evolves the core
+    plus ``(k-1-j)*G`` ghost rows per side, so the average window is
+    ``local_nz + (k-1)*G`` rows — the FLOP (and slab-traffic) price paid
+    for exchanging once per k steps."""
+    if local_nz <= 0:
+        return 1.0
+    return 1.0 + (k - 1) * G / float(local_nz)
+
+
 def peak_rates(backend: Optional[str] = None):
     """(bytes/s, FLOP/s) peaks for a backend family, env-overridable."""
     if backend is None:

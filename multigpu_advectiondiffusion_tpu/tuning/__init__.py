@@ -1,0 +1,133 @@
+"""Measured autotuned dispatch (``impl="auto"``).
+
+The subsystem behind the ``--tune`` / ``--tuning-cache`` CLI surface:
+
+* :mod:`autotuner` — candidate enumeration over (stepper rung x
+  communication-avoiding exchange cadence k), cost-model pruning,
+  median-of-reps measurement, ``tune:*`` telemetry;
+* :mod:`cache` — the atomic, persisted JSON decision store that makes
+  ``impl="auto"`` reproducible: one measurement per key, every later
+  run resolves from disk.
+
+``resolve`` is the dispatch entry point (``models/base.SolverBase``
+calls it when ``cfg.impl == "auto"``):
+
+* cache hit -> the persisted decision, no device time;
+* miss with tuning enabled (:func:`configure` ``enabled=True``, the
+  CLI's ``--tune``, or ``TPUCFD_TUNE=1``) -> measure, persist, return;
+* miss with tuning disabled -> the best-available heuristic
+  (``impl="pallas"``, per-step cadence) plus a ``tune:fallback`` event —
+  auto never blocks a run on measurement the user didn't ask for.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from multigpu_advectiondiffusion_tpu.tuning import autotuner  # noqa: F401
+from multigpu_advectiondiffusion_tpu.tuning.autotuner import (  # noqa: F401
+    autotune,
+    candidates,
+    make_key,
+    measure_candidate,
+    modeled_step_seconds,
+)
+from multigpu_advectiondiffusion_tpu.tuning.cache import (  # noqa: F401
+    TuningCache,
+    default_path,
+)
+
+__all__ = [
+    "TuningCache",
+    "autotune",
+    "candidates",
+    "configure",
+    "default_path",
+    "make_key",
+    "measure_candidate",
+    "modeled_step_seconds",
+    "resolve",
+    "tuning_enabled",
+]
+
+# process-wide tuner configuration (the CLI/bench surface writes it
+# before building solvers; env vars override nothing set explicitly)
+_state = {
+    "path": None,       # cache file; None -> cache.default_path()
+    "enabled": None,    # measure on miss; None -> TPUCFD_TUNE env
+    "iters": None,      # measurement iterations; None -> TPUCFD_TUNE_ITERS
+    "reps": None,       # timing repetitions; None -> TPUCFD_TUNE_REPS
+    "prune_ratio": None,  # None -> TPUCFD_TUNE_PRUNE
+}
+
+
+def configure(
+    cache_path: Optional[str] = None,
+    enabled: Optional[bool] = None,
+    measure_iters: Optional[int] = None,
+    measure_reps: Optional[int] = None,
+    prune_ratio: Optional[float] = None,
+) -> None:
+    """Set the process-wide tuner knobs; ``None`` leaves a knob as-is."""
+    if cache_path is not None:
+        _state["path"] = cache_path
+    if enabled is not None:
+        _state["enabled"] = bool(enabled)
+    if measure_iters is not None:
+        _state["iters"] = int(measure_iters)
+    if measure_reps is not None:
+        _state["reps"] = int(measure_reps)
+    if prune_ratio is not None:
+        _state["prune_ratio"] = float(prune_ratio)
+
+
+def tuning_enabled() -> bool:
+    if _state["enabled"] is not None:
+        return _state["enabled"]
+    return os.environ.get("TPUCFD_TUNE", "").lower() in ("1", "true", "yes")
+
+
+def cache_path() -> str:
+    return _state["path"] or default_path()
+
+
+def _measure_params():
+    iters = _state["iters"] or autotuner._env_int("TPUCFD_TUNE_ITERS", 12)
+    reps = _state["reps"] or autotuner._env_int("TPUCFD_TUNE_REPS", 3)
+    prune = _state["prune_ratio"] or float(
+        os.environ.get("TPUCFD_TUNE_PRUNE", "2.0")
+    )
+    return max(1, iters), max(1, reps), prune
+
+
+def resolve(solver_cls, cfg, mesh, decomp) -> dict:
+    """Resolve ``impl="auto"`` for one solver construction; see the
+    module docstring for the hit/miss/disabled contract."""
+    import jax
+
+    backend = jax.default_backend()
+    key = make_key(solver_cls, cfg, mesh, decomp, backend)
+    cache = TuningCache(cache_path())
+    hit = cache.get(key)
+    autotuner._emit("lookup", key=key, hit=hit is not None,
+                    cache=cache.path)
+    if hit is not None:
+        hit["source"] = "cache"
+        return hit
+    if not tuning_enabled():
+        decision = {
+            "impl": "pallas",
+            "steps_per_exchange": 1,
+            "source": "untuned-heuristic",
+            "key": key,
+        }
+        autotuner._emit(
+            "fallback", key=key, impl="pallas",
+            reason="no cached decision and tuning not enabled "
+                   "(--tune / TPUCFD_TUNE=1)",
+        )
+        return decision
+    iters, reps, prune = _measure_params()
+    return autotune(solver_cls, cfg, mesh, decomp, cache, key,
+                    iters, reps, prune)

@@ -1,0 +1,337 @@
+"""Measured rung + exchange-cadence selection (``impl="auto"``).
+
+PR 1 left the stepper ladder's top-rung selection to a deliberately
+conservative static model ("deep grids keep the measured per-stage
+default until a TPU session measures the slab rung"), and the
+communication-avoiding k-step schedule adds a second axis — exchange
+cadence — no static model prices credibly across interconnects. This
+module replaces that last hand-tuned heuristic with *measurement*:
+
+1. build the candidate list for the config's ``(rung, k)`` space —
+   ``fused-stage`` at the per-step cadence plus the slab rung at every
+   k the shard can serve;
+2. seed with the PR 3 cost model (``telemetry/costmodel``): modeled
+   step time = max(HBM, FLOP) roofline x the deep-halo recompute factor
+   + the exchange latency/bandwidth term — candidates far off the
+   modeled best are pruned before any device time is spent;
+3. time the survivors with the bench harness's own ``timed_run``
+   (median-of-reps, same sync discipline as every published number);
+4. persist the winner to the atomic JSON cache (``tuning/cache.py``),
+   keyed by ``(solver, shape, dtype, mesh, backend)`` — the same key
+   resolves to the same decision forever after, without re-measurement.
+
+Every lookup, measurement, pruning and decision is a ``tune:*``
+telemetry event, so a tuned bench row is auditable from the stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+from multigpu_advectiondiffusion_tpu.telemetry import costmodel
+from multigpu_advectiondiffusion_tpu.tuning.cache import TuningCache
+
+# candidate chunk lengths for the comm-avoiding schedule (1 = per-step)
+K_CANDIDATES = (1, 2, 4, 8)
+
+
+def _emit(name: str, **fields) -> None:
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    telemetry.event("tune", name, **fields)
+
+
+def _fused_halo(kind: str, cfg) -> int:
+    """Per-step fused ghost depth G = 3h of the config's stencil."""
+    if kind == "diffusion":
+        from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R
+
+        return 3 * R
+    from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+    return 3 * HALO[getattr(cfg, "weno_order", 5)]
+
+
+def _mesh_tokens(mesh, decomp):
+    if mesh is None:
+        return "mesh=1"
+    sizes = ",".join(f"{n}:{s}" for n, s in mesh.shape.items())
+    axes = ",".join(
+        f"{ax}:{'|'.join(nm) if isinstance(nm, tuple) else nm}"
+        for ax, nm in decomp.axes
+    )
+    return f"mesh={sizes};decomp={axes}"
+
+
+def make_key(solver_cls, cfg, mesh, decomp, backend: str) -> str:
+    """The tuning key: everything that changes which ``(rung, k)`` wins.
+    Kernel-strategy knobs that the tuner itself decides (impl,
+    steps_per_exchange) are excluded; physics scalars that do not change
+    kernel structure (diffusivity value, flux params) are too."""
+    kind = costmodel.solver_kind(cfg) or type(cfg).__name__
+    shape = "x".join(map(str, cfg.grid.shape))
+    parts = [
+        solver_cls.__name__,
+        kind,
+        f"shape={shape}",
+        f"dtype={cfg.dtype}",
+        f"integ={cfg.integrator}",
+        f"overlap={getattr(cfg, 'overlap', None)}",
+        _mesh_tokens(mesh, decomp),
+        f"backend={backend}",
+    ]
+    if kind == "burgers":
+        parts += [
+            f"weno={cfg.weno_order}-{cfg.weno_variant}",
+            f"adaptive={bool(cfg.adaptive_dt)}",
+            f"viscous={bool(getattr(cfg, 'nu', 0.0))}",
+        ]
+    elif kind == "diffusion":
+        parts += [
+            f"order={getattr(cfg, 'order', 4)}",
+            f"geom={getattr(cfg, 'geometry', 'cartesian')}",
+        ]
+    return "|".join(parts)
+
+
+def _zslab_only(solver) -> bool:
+    sharded = solver._sharded_axes()
+    return bool(sharded) and all(ax == 0 for ax in sharded)
+
+
+def candidates(solver_cls, cfg, mesh, decomp) -> list:
+    """``[{"impl", "steps_per_exchange"}, ...]`` the config can engage.
+
+    A probe solver (impl="pallas") answers the eligibility questions the
+    dispatch layer already owns — the tuner never re-implements VMEM /
+    dtype / decomposition gates, it asks them."""
+    probe = solver_cls(
+        dataclasses.replace(cfg, impl="pallas", steps_per_exchange=1),
+        mesh=mesh,
+        decomp=decomp,
+    )
+    kind = costmodel.solver_kind(cfg)
+    out = [{"impl": "pallas", "steps_per_exchange": 1}]
+    fused = probe._fused_stepper()
+    if fused is None or probe.grid.ndim != 3 or kind is None:
+        return out  # heuristic best-available is the only candidate
+    fixed_dt = not getattr(cfg, "adaptive_dt", False)
+    out = [{"impl": "pallas_stage", "steps_per_exchange": 1}]
+    slab_ok = fixed_dt
+    if slab_ok:
+        # slab eligibility via the dispatch's own gate: a pinned probe
+        # either engages the slab rung or raises/declines
+        try:
+            pin = solver_cls(
+                dataclasses.replace(
+                    cfg, impl="pallas_slab", steps_per_exchange=1
+                ),
+                mesh=mesh,
+                decomp=decomp,
+            )
+            slab_ok = (
+                pin.engaged_path()["stepper"] == "fused-whole-run-slab"
+            )
+        except ValueError:
+            slab_ok = False
+    if not slab_ok:
+        return out
+    out.append({"impl": "pallas_slab", "steps_per_exchange": 1})
+    if mesh is not None and _zslab_only(probe):
+        lz = probe.decomp.local_shape(mesh, cfg.grid.shape)[0]
+        G = _fused_halo(kind, cfg)
+        for k in K_CANDIDATES[1:]:
+            if lz >= k * G:
+                out.append({"impl": "pallas_slab", "steps_per_exchange": k})
+    return out
+
+
+def modeled_step_seconds(cfg, lshape, cand, devices: int,
+                         backend: str) -> Optional[float]:
+    """Cost-model seconds for ONE step of one shard under a candidate —
+    the pruning metric. None when the model has no opinion (the
+    candidate is then never pruned)."""
+    import numpy as np
+
+    kind = costmodel.solver_kind(cfg)
+    if kind is None:
+        return None
+    stepper = {
+        "pallas_slab": "fused-whole-run-slab",
+        "pallas_stage": "fused-stage",
+    }.get(cand["impl"])
+    if stepper is None:
+        return None
+    kwargs = {}
+    if kind == "diffusion":
+        kwargs["order"] = getattr(cfg, "order", 4)
+    else:
+        kwargs["weno_order"] = getattr(cfg, "weno_order", 5)
+        kwargs["viscous"] = bool(getattr(cfg, "nu", 0.0))
+    itemsize = np.dtype(cfg.dtype).itemsize
+    cost = costmodel.step_cost(kind, lshape, itemsize, stepper, **kwargs)
+    peak_b, peak_f = costmodel.peak_rates(backend)
+    t = max(
+        cost.hbm_bytes / peak_b if peak_b else 0.0,
+        cost.flops / peak_f if peak_f else 0.0,
+    )
+    k = cand["steps_per_exchange"]
+    G = _fused_halo(kind, cfg)
+    if stepper == "fused-whole-run-slab" and k > 1:
+        t *= costmodel.deep_halo_recompute_factor(lshape[0], G, k)
+    if devices > 1:
+        plane = itemsize
+        for n in lshape[1:]:
+            plane *= n
+        if stepper == "fused-stage":
+            # one h-deep refresh per RK stage
+            h = G // 3
+            t += costmodel.halo_exchange_seconds(
+                3 * 2 * h * plane, messages=3, backend=backend
+            )
+        else:
+            # one k*G-deep exchange per k steps: same bytes per step,
+            # 1/k of the messages — the comm-avoiding tradeoff
+            t += costmodel.halo_exchange_seconds(
+                2 * G * plane, messages=1.0 / k, backend=backend
+            )
+    return t
+
+
+def measure_candidate(solver_cls, cfg, mesh, decomp, cand,
+                      iters: int, reps: int) -> dict:
+    """Median-of-reps MLUPS of one candidate, via the bench harness's
+    own timing discipline (``bench/timing.timed_run``)."""
+    from multigpu_advectiondiffusion_tpu.bench.timing import timed_run
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    solver = solver_cls(
+        dataclasses.replace(
+            cfg,
+            impl=cand["impl"],
+            steps_per_exchange=cand["steps_per_exchange"],
+        ),
+        mesh=mesh,
+        decomp=decomp,
+    )
+    timing = timed_run(solver, solver.initial_state(), iters, reps=reps)
+    rate = mlups(
+        cfg.grid.num_cells, iters, STAGES[cfg.integrator],
+        timing.median_seconds,
+    )
+    return {
+        "mlups": round(rate, 2),
+        "seconds": round(timing.median_seconds, 6),
+        "spread": round(timing.spread, 4),
+        "engaged": solver.engaged_path()["stepper"],
+    }
+
+
+def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
+             iters: int, reps: int, prune_ratio: float) -> dict:
+    """Measure the pruned candidate space and persist the winner."""
+    import jax
+
+    backend = jax.default_backend()
+    devices = 1 if mesh is None else mesh.devices.size
+    lshape = (
+        cfg.grid.shape
+        if mesh is None
+        else decomp.local_shape(mesh, cfg.grid.shape)
+    )
+    cands = candidates(solver_cls, cfg, mesh, decomp)
+    best_model = None
+    for c in cands:
+        t = modeled_step_seconds(cfg, lshape, c, devices, backend)
+        c["modeled_us"] = None if t is None else round(t * 1e6, 3)
+        if t is not None and (best_model is None or t < best_model):
+            best_model = t
+    for c in cands:
+        # cost-model pruning: never prune the per-step baseline (k=1 on
+        # the modeled-best rung family keeps the comparison honest) or
+        # model-less candidates
+        c["pruned"] = bool(
+            best_model is not None
+            and c["modeled_us"] is not None
+            and c["steps_per_exchange"] > 1
+            and c["modeled_us"] > prune_ratio * best_model * 1e6
+        )
+    _emit(
+        "candidates", key=key,
+        considered=[
+            {k: c[k] for k in ("impl", "steps_per_exchange",
+                               "modeled_us", "pruned")}
+            for c in cands
+        ],
+    )
+    live = [c for c in cands if not c["pruned"]]
+    measured = []
+    if len(live) == 1:
+        choice = dict(live[0])
+        choice["source"] = "static"  # nothing to race: no device time
+    else:
+        for c in live:
+            try:
+                m = measure_candidate(
+                    solver_cls, cfg, mesh, decomp, c, iters, reps
+                )
+            except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+                c["error"] = f"{type(exc).__name__}: {exc}"[:200]
+                _emit("measure", key=key, impl=c["impl"],
+                      steps_per_exchange=c["steps_per_exchange"],
+                      error=c["error"])
+                continue
+            c.update(m)
+            measured.append(c)
+            _emit("measure", key=key, impl=c["impl"],
+                  steps_per_exchange=c["steps_per_exchange"],
+                  mlups=m["mlups"], seconds=m["seconds"])
+        if not measured:
+            raise RuntimeError(
+                f"autotune: every candidate failed for key {key}"
+            )
+        choice = dict(max(measured, key=lambda c: c["mlups"]))
+        choice["source"] = "measured"
+    decision = {
+        "impl": choice["impl"],
+        "steps_per_exchange": choice["steps_per_exchange"],
+        "mlups": choice.get("mlups"),
+        "source": choice["source"],
+        "backend": backend,
+        "devices": devices,
+        "key": key,
+        "tuner": {"iters": iters, "reps": reps,
+                  "prune_ratio": prune_ratio},
+        "candidates": [
+            {
+                k: c.get(k)
+                for k in ("impl", "steps_per_exchange", "modeled_us",
+                          "pruned", "mlups", "seconds", "spread",
+                          "engaged", "error")
+                if k in c
+            }
+            for c in cands
+        ],
+        "created": time.time(),
+    }
+    cache.put(key, decision)
+    _emit(
+        "decision", key=key, impl=decision["impl"],
+        steps_per_exchange=decision["steps_per_exchange"],
+        mlups=decision["mlups"], source=decision["source"],
+        cache=cache.path,
+    )
+    return decision
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

@@ -1,0 +1,90 @@
+"""Persisted tuning decisions: an atomic JSON key-value store.
+
+The autotuner's measurements are expensive (seconds of device time per
+key) and its decisions must be *reproducible*: the same
+``(solver, shape, dtype, mesh, backend)`` key resolves to the same rung
+and exchange cadence on every later run, without re-measurement, until
+the cache is deleted or re-tuned. That makes the file itself the
+artifact: one JSON object per key, with full candidate provenance, so a
+published bench rate can be audited back to the measurements that
+selected its configuration.
+
+Writes are atomic (tempfile + ``os.replace`` in the destination
+directory, same discipline as ``utils/io.py`` checkpoints and
+``RunSummary.write_json``) and read-modify-write under a process-local
+lock; a corrupt or truncated file is treated as empty rather than
+poisoning every later run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Optional
+
+CACHE_SCHEMA = 1
+
+# default location; TPUCFD_TUNING_CACHE / --tuning-cache override
+_DEFAULT_PATH = os.path.join(
+    "~", ".cache", "multigpu_advectiondiffusion_tpu", "tuning.json"
+)
+
+
+def default_path() -> str:
+    env = os.environ.get("TPUCFD_TUNING_CACHE")
+    return env if env else os.path.expanduser(_DEFAULT_PATH)
+
+
+class TuningCache:
+    """Atomic JSON decision store, keyed by the autotuner's key string."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_path()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ #
+    def _read(self) -> dict:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return {}
+        except (json.JSONDecodeError, OSError, ValueError):
+            # corrupt/truncated cache: a miss, not a crash — the next
+            # decision rewrites the file atomically
+            return {}
+        if not isinstance(data, dict) or data.get("schema") != CACHE_SCHEMA:
+            return {}
+        entries = data.get("entries")
+        return entries if isinstance(entries, dict) else {}
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._read().get(key)
+        return dict(entry) if isinstance(entry, dict) else None
+
+    def put(self, key: str, decision: dict) -> None:
+        """Read-modify-write with an atomic replace; concurrent writers
+        last-write-win per key but never leave a torn file."""
+        with self._lock:
+            entries = self._read()
+            entries[key] = decision
+            payload = {"schema": CACHE_SCHEMA, "entries": entries}
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=d, prefix=".tuning_", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(payload, f, indent=1, sort_keys=True)
+                os.replace(tmp, self.path)
+            finally:
+                if os.path.exists(tmp):  # replace failed
+                    os.unlink(tmp)
+
+    def entries(self) -> dict:
+        with self._lock:
+            return self._read()

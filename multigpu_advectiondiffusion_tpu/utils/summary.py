@@ -107,8 +107,20 @@ class RunSummary:
             line = f"{e['stepper']} (impl={e['impl']}"
             if e.get("overlap"):
                 line += f", overlap={e['overlap']}"
+            if e.get("steps_per_exchange", 1) != 1:
+                line += f", steps/exchange={e['steps_per_exchange']}"
             line += ")"
             print(f" kernel path        : {line}")
+            if e.get("tuned"):
+                t = e["tuned"]
+                print(
+                    f" tuned dispatch     : {t.get('source')}"
+                    + (
+                        f" ({t.get('mlups')} MLUPS measured)"
+                        if t.get("mlups")
+                        else ""
+                    )
+                )
             if e.get("fallback"):
                 print(f" fused fallback     : {e['fallback']}")
             for ev in e.get("degraded") or ():

@@ -21,6 +21,19 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import tempfile
+
+# the tuner must never read or write the user-level decision cache from
+# tests (bench entry points under test enable measurement process-wide),
+# and any in-test measurement runs at smoke-grade cost
+os.environ.setdefault(
+    "TPUCFD_TUNING_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="tpucfd_test_tuning_"),
+                 "tuning.json"),
+)
+os.environ.setdefault("TPUCFD_TUNE_ITERS", "2")
+os.environ.setdefault("TPUCFD_TUNE_REPS", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -34,3 +47,16 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _isolate_tuner_state():
+    """bench/matrix entry points call tuning.configure (process-global);
+    restore the knobs after every test so one test's enablement cannot
+    change another's dispatch."""
+    from multigpu_advectiondiffusion_tpu import tuning
+
+    saved = dict(tuning._state)
+    yield
+    tuning._state.clear()
+    tuning._state.update(saved)

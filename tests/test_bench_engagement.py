@@ -42,6 +42,11 @@ def test_bench_rows_engage_expected_steppers():
         assert engaged["stepper"] in expect, (
             metric, engaged["stepper"], engaged["fallback"]
         )
+        # every row publishes its exchange cadence (ISSUE 4: bench rows
+        # gain steps_per_exchange + tuner-provenance fields); the
+        # single-chip pinned rows run the per-step cadence untuned
+        assert engaged.get("steps_per_exchange") == 1, metric
+        assert engaged.get("tuned") is None, metric
         seen[metric] = engaged["stepper"]
     # the slab-run round's acceptance rows: the 3-D headline Burgers
     # config and the f64 diffusion row must ride a fused path on the
@@ -78,3 +83,35 @@ def test_bench_matrix_cases_report_engaged():
             assert engaged == "per-axis-pallas", (case.name, engaged)
         if case.name == "diffusion3d_multigpu_f64":
             assert engaged != "generic-xla", engaged
+
+
+def test_matrix_multichip_rows_route_through_auto():
+    """With a --mesh spec the f32 pallas cases dispatch through the
+    measured tuner (impl='auto'); single-chip and explicitly pinned
+    rows are untouched (ISSUE 4 satellite)."""
+    from multigpu_advectiondiffusion_tpu.bench.matrix import (
+        CASES,
+        resolve_impl,
+    )
+
+    by_name = {c.name: c for c in CASES}
+    b3 = by_name["burgers3d_multigpu"]
+    assert resolve_impl(b3, "float32", "dz=2") == "auto"
+    assert resolve_impl(b3, "float32", None) == "pallas"
+    assert resolve_impl(b3, "float32") == "pallas"  # legacy signature
+    assert resolve_impl(
+        by_name["burgers3d_512_axis"], "float32", "dz=2"
+    ) == "pallas_axis"
+    assert resolve_impl(
+        by_name["diffusion3d_multigpu_f64"], "float64", "dz=2"
+    ) == "pallas"
+
+
+def test_scaling_configs_use_measured_dispatch():
+    """The strong-scaling rows (the only standing multichip bench
+    surface) dispatch through impl='auto' so a real multichip session
+    tunes rung + steps_per_exchange instead of guessing."""
+    from multigpu_advectiondiffusion_tpu.bench.scaling import _configs
+
+    for cfg, _, _ in _configs(on_tpu=False).values():
+        assert cfg.impl == "auto", cfg

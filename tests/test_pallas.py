@@ -888,6 +888,51 @@ def test_fused_burgers_block_mesh_8dev_split_overlap(devices):
     _assert_fused_close(outs["split"], ref.u)
 
 
+def test_fused_diffusion_block_mesh_8dev_split_overlap(devices):
+    """A full {dz:2, dy:2, dx:2} BLOCK mesh (all 8 virtual devices) with
+    overlap='split' for DIFFUSION: the z halo rides the exchanged-slab
+    operands while the y and x ghosts (stored on every axis for
+    diffusion) take the serialized per-stage refresh. Completes the
+    ADVICE r5 coverage of the _split_overlap_requested gate: the Burgers
+    8-device block-mesh test pins the WENO side; this pins the O4
+    stencil family on the same decomposition. Must match the
+    all-serialized fused path and the unsharded fused run."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    # local (48, 8, 16): z's largest block divisor (16) hosts a 3-slab
+    # interior band, y/x locals clear the O4 halo (2)
+    grid = Grid.make(32, 16, 96, lengths=2.0)
+    unsharded = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas_stage")
+    )
+    ref = unsharded.run(unsharded.initial_state(), 5)
+    outs = {}
+    for overlap in ("split", "padded"):
+        cfg = DiffusionConfig(grid=grid, dtype="float32", impl="pallas",
+                              overlap=overlap)
+        solver = DiffusionSolver(
+            cfg,
+            mesh=make_mesh({"dz": 2, "dy": 2, "dx": 2}),
+            decomp=Decomposition.of({0: "dz", 1: "dy", 2: "dx"}),
+        )
+        fused = solver._fused_stepper()
+        assert fused is not None and fused.sharded, (
+            overlap, getattr(solver, "_fused_fallback", None)
+        )
+        assert fused.overlap_split == (overlap == "split"), (
+            overlap, getattr(solver, "_fused_fallback", None),
+            fused.n_slabs,
+        )
+        st = solver.run(solver.initial_state(), 5)
+        outs[overlap] = np.asarray(st.u)
+        np.testing.assert_allclose(float(st.t), float(ref.t), rtol=1e-6)
+    _assert_fused_close(outs["split"], outs["padded"])
+    _assert_fused_close(outs["split"], ref.u)
+
+
 def test_fused_diffusion_xsharded_split_overlap(devices):
     """The split-overlap broadening also exposes {dz, dx} DIFFUSION
     meshes: the z halo rides the exchanged-slab schedule while the x

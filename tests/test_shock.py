@@ -64,6 +64,39 @@ def test_shock_speed_1d_generic(order):
     _assert_shock_within_one_cell(grid, out, 0, np.asarray(out.u))
 
 
+def test_shock_speed_3d_comm_avoiding_k4(devices):
+    """The golden gate under the communication-avoiding schedule: the
+    same Riemann shock marched 100 steps on a dz=2 z-slab mesh with
+    steps_per_exchange=4 (one 36-deep exchange per 4 steps, redundant
+    ghost recompute in between; 100 = 25 full blocks). Shock along x,
+    sharded axis z uniform — a deep-schedule defect that let stale or
+    mis-replicated ghost rows leak into the trapezoid would break the
+    y/z uniformity or move the shock, failing the one-cell gate."""
+    grid = Grid.make(200, 4, 72, lengths=[2.0, 2.0, 2.0])
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    solver = BurgersSolver(
+        BurgersConfig(grid=grid, ic="riemann", bc="edge",
+                      weno_order=5, adaptive_dt=False, cfl=0.4,
+                      dtype="float32", impl="pallas_slab",
+                      steps_per_exchange=4),
+        mesh=make_mesh({"dz": 2}, devices=devices[:2]),
+        decomp=Decomposition.slab("dz"),
+    )
+    fused = solver._fused_stepper()
+    assert fused.steps_per_exchange == 4, "comm-avoiding schedule not engaged"
+    assert fused.exchange_depth == 36
+    out = solver.run(solver.initial_state(), 100)
+    u = np.asarray(out.u)
+    np.testing.assert_allclose(
+        u, np.broadcast_to(u[:1, :1, :], u.shape), atol=1e-5
+    )
+    _assert_shock_within_one_cell(grid, out, 2, u[1, 1, :])
+
+
 @pytest.mark.parametrize("order,impl", [(5, "pallas"), (7, "pallas_stage")])
 def test_shock_speed_3d_fused(order, impl):
     """The fused rungs (whole-run slab via impl='pallas', per-stage via

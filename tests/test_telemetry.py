@@ -431,6 +431,96 @@ def test_multihost_initialize_emits_retry_events(monkeypatch, tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# Halo byte accounting: deep / k-step exchanges report true bytes
+# --------------------------------------------------------------------- #
+def _halo_counter_events(path):
+    return [
+        e for e in _events(path)
+        if e.get("name") == "halo.bytes_per_execution"
+    ]
+
+
+def test_halo_bytes_deep_k_step_schedule(tmp_path, devices):
+    """The k-step comm-avoiding schedule must report its true per-
+    compiled-execution traffic: one k*G-deep exchange site, repeated
+    once per block (loop trip count folded in), not a per-step h-deep
+    estimate. 4 iters at k=2 -> 2 blocks of a 12-row-deep exchange."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import make_mesh
+
+    grid = Grid.make(16, 16, 48, lengths=2.0)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas_slab",
+                        steps_per_exchange=2),
+        mesh=make_mesh({"dz": 2}, devices=devices[:2]),
+        decomp=Decomposition.of({0: "dz"}),
+    )
+    fused = solver._fused_stepper()
+    path = str(tmp_path / "ev.jsonl")
+    with telemetry.capture(path):
+        solver.run(solver.initial_state(), 4)
+    evs = _halo_counter_events(path)
+    assert len(evs) == 1, evs  # ONE deep site, traced once
+    ev = evs[0]
+    py, px = fused.padded_shape[1:]
+    per_exchange = 2 * fused.exchange_depth * py * px * 4  # lo+hi slabs
+    assert ev["halo"] == fused.exchange_depth == 12
+    assert ev["repeats"] == 2  # 4 iters / k=2 -> 2 blocks
+    assert ev["inc"] == 2 * per_exchange
+
+
+def test_halo_bytes_per_step_slab_counts_loop_trips(tmp_path, devices):
+    """The per-step (k=1) sharded slab schedule exchanges G-deep once
+    per step inside a fori_loop: the counter must carry the trip count,
+    not one trace-site's worth (the pre-ISSUE-4 under-report)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import make_mesh
+
+    grid = Grid.make(16, 16, 48, lengths=2.0)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas_slab"),
+        mesh=make_mesh({"dz": 2}, devices=devices[:2]),
+        decomp=Decomposition.of({0: "dz"}),
+    )
+    fused = solver._fused_stepper()
+    path = str(tmp_path / "ev.jsonl")
+    with telemetry.capture(path):
+        solver.run(solver.initial_state(), 5)
+    evs = _halo_counter_events(path)
+    assert len(evs) == 1
+    ev = evs[0]
+    py, px = fused.padded_shape[1:]
+    assert ev["halo"] == fused.halo == 6
+    assert ev["repeats"] == 5
+    assert ev["inc"] == 5 * 2 * fused.halo * py * px * 4
+
+
+def test_halo_bytes_fused_stage_counts_loop_trips(tmp_path, devices):
+    """The per-stage fused stepper refreshes h-deep ghosts after every
+    RK stage inside the run loop: 3 sites, each repeated num_iters
+    times per compiled execution."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import make_mesh
+
+    grid = Grid.make(16, 16, 48, lengths=2.0)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas_stage"),
+        mesh=make_mesh({"dz": 2}, devices=devices[:2]),
+        decomp=Decomposition.of({0: "dz"}),
+    )
+    fused = solver._fused_stepper()
+    path = str(tmp_path / "ev.jsonl")
+    with telemetry.capture(path):
+        solver.run(solver.initial_state(), 4)
+    evs = _halo_counter_events(path)
+    # one embed-time refresh (repeats=1) + 3 per-stage loop sites
+    loop = [e for e in evs if e["repeats"] == 4]
+    assert len(loop) == 3, evs
+    py, px = fused.padded_shape[1:]
+    per = 2 * fused.halo * py * px * 4
+    assert all(e["inc"] == 4 * per for e in loop)
+    embed = [e for e in evs if e["repeats"] == 1]
+    assert len(embed) == 1 and embed[0]["inc"] == per
+
+
+# --------------------------------------------------------------------- #
 # Summary schema + atomic write
 # --------------------------------------------------------------------- #
 def test_write_json_atomic_and_schema(tmp_path):
