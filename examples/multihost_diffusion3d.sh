@@ -20,6 +20,7 @@ for PID in 0 1; do
       --mesh dz_dcn=2,dz_ici=4 --impl pallas --overlap split \
       --coordinator localhost:$PORT --num-processes 2 --process-id $PID \
       --checkpoint-every 500 --checkpoint-sharded \
+      --sentinel-every 500 --watchdog-timeout 60 \
       --save out/multihost_diffusion3d "$@" &
 done
 wait

@@ -15,9 +15,14 @@ harness (``./Diffusion3d.run K L W H Nx Ny Nz iters bX bY bZ``,
 Block sizes (bX/bY/bZ) have no TPU meaning and are not taken; XLA/Pallas
 choose tiling.
 
-Exit codes: 0 success, 1 failure, 75 preempted (SIGTERM/SIGINT landed; a
-final CRC-valid checkpoint + ``preempt.json`` manifest were written to
-``--save DIR`` — rerun the same command with ``--resume auto``).
+Exit codes (full table in README "Failure modes & resilience"):
+0 success; 1 failure; 75 preempted (SIGTERM/SIGINT landed; a final
+CRC-valid checkpoint + ``preempt.json`` manifest were written to
+``--save DIR`` — rerun the same command with ``--resume auto``);
+76 rank failure (a peer process of a multi-process run died or stalled
+past ``--watchdog-timeout``; restart — on the surviving topology if a
+host is gone — with ``--resume auto``); 77 silent data corruption
+detected (``--sdc-every``) and the rollback budget exhausted.
 """
 
 from __future__ import annotations
@@ -106,6 +111,24 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
     p.add_argument("--dt-backoff", type=float, default=0.5, metavar="F",
                    help="dt (fixed-dt solvers) or CFL (adaptive) "
                         "multiplier applied per rollback retry")
+    p.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   metavar="S",
+                   help="rank-liveness watchdog for multi-process runs "
+                        "(needs --save DIR): every process writes a "
+                        "heartbeat record and monitors its peers'; a "
+                        "peer dead or silent for S seconds aborts THIS "
+                        "process with exit code 76 and a structured "
+                        "rank_failure report instead of hanging in a "
+                        "collective forever (0 = off, the MPI "
+                        "abort-the-world model)")
+    p.add_argument("--sdc-every", type=int, default=0, metavar="M",
+                   help="silent-data-corruption guard: every M-th "
+                        "sentinel probe re-executes one step from the "
+                        "probed state and compares bit-exact; a "
+                        "mismatch emits an sdc:detect event and "
+                        "recovers via rollback WITHOUT a dt backoff "
+                        "(0 = off; needs --sentinel-every; costs two "
+                        "extra steps per check)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace of the timed "
                         "solve into DIR (TensorBoard/Perfetto viewable) — "
@@ -229,6 +252,8 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       sentinel_growth=args.sentinel_growth,
                       max_retries=args.max_retries,
                       dt_backoff=args.dt_backoff,
+                      watchdog_timeout=args.watchdog_timeout,
+                      sdc_every=args.sdc_every,
                       metrics_path=getattr(args, "metrics", None))
 
 
@@ -272,6 +297,8 @@ def _run_burgers(args, ndim):
                       sentinel_growth=args.sentinel_growth,
                       max_retries=args.max_retries,
                       dt_backoff=args.dt_backoff,
+                      watchdog_timeout=args.watchdog_timeout,
+                      sdc_every=args.sdc_every,
                       metrics_path=getattr(args, "metrics", None))
 
 
@@ -455,8 +482,39 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_enable_x64", True)
+    from multigpu_advectiondiffusion_tpu.resilience.errors import (
+        EXIT_RANK_FAILURE,
+        EXIT_SDC,
+        RankFailureError,
+        SDCDetectedError,
+    )
+
     try:
         return args.fn(args)
+    except RankFailureError as err:
+        # a peer is dead/wedged: exit with the documented code (the
+        # watchdog's monitor thread takes the os._exit path instead
+        # when the main thread is unreachable inside a collective)
+        print(f"rank failure: {err}; exiting {EXIT_RANK_FAILURE}",
+              file=sys.stderr, flush=True)
+        import jax
+
+        from multigpu_advectiondiffusion_tpu import telemetry
+
+        telemetry.get_sink().close()
+        if jax.process_count() > 1:
+            # a normal SystemExit would run jax.distributed's atexit
+            # shutdown, which blocks on the DEAD peer's disconnect —
+            # the hang this exit path exists to rule out
+            import os
+
+            os._exit(EXIT_RANK_FAILURE)
+        raise SystemExit(EXIT_RANK_FAILURE)
+    except SDCDetectedError as err:
+        # only reaches the CLI when the rollback budget ran out
+        print(f"unrecovered silent data corruption: {err}; "
+              f"exiting {EXIT_SDC}", file=sys.stderr)
+        raise SystemExit(EXIT_SDC)
     finally:
         if owned_sink is not None:
             from multigpu_advectiondiffusion_tpu import telemetry

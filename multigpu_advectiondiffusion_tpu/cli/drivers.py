@@ -102,6 +102,7 @@ def run_solver(
     name: str,
     *args,
     metrics_path: Optional[str] = None,
+    watchdog_timeout: float = 0.0,
     **kwargs,
 ) -> RunSummary:
     """Public run driver; see :func:`_run_solver` for the full contract.
@@ -111,16 +112,53 @@ def run_solver(
     installed (e.g. by ``cli.main`` before the multihost join) it is
     reused and left alone. The whole run executes under a top-level
     ``run_solver`` span so every dispatch/physics/resilience/io event is
-    attributable to this run."""
+    attributable to this run.
+
+    ``watchdog_timeout`` > 0 arms the rank-liveness watchdog for
+    multi-process runs (heartbeat records under ``save_dir``): a peer
+    dead or silent past the timeout aborts this process with the
+    documented rank-failure exit code instead of hanging in a
+    collective; any exception raised while a peer is down is classified
+    as the structured ``RankFailureError`` it really is."""
+    import jax
+
     from multigpu_advectiondiffusion_tpu import telemetry
+    from multigpu_advectiondiffusion_tpu.parallel import multihost
+
+    watchdog = None
+    if watchdog_timeout and watchdog_timeout > 0 and jax.process_count() > 1:
+        save_dir = kwargs.get("save_dir")
+        if not save_dir:
+            raise ValueError(
+                "--watchdog-timeout needs --save DIR (the heartbeat "
+                "records live under it)"
+            )
+        os.makedirs(save_dir, exist_ok=True)
+        watchdog = multihost.RankWatchdog(
+            os.path.join(save_dir, ".heartbeats"),
+            timeout_seconds=watchdog_timeout,
+            report_dir=save_dir,
+        )
 
     with contextlib.ExitStack() as scope:
         if metrics_path and not telemetry.get_sink().active:
             sink = telemetry.install(metrics_path)
             scope.callback(telemetry.uninstall, sink)
+        if watchdog is not None:
+            # after the sink install, so direct run_solver(metrics_path=
+            # ...) callers get the armed record in their stream too
+            telemetry.event(
+                "rank", "watchdog_armed",
+                timeout=float(watchdog_timeout),
+                interval=watchdog.interval,
+                processes=jax.process_count(),
+            )
         t_sink = telemetry.get_sink()
         if t_sink.active:
             scope.enter_context(t_sink.span("run_solver", run=name))
+        # the scope covers warm-up, the timed solve AND the gathered
+        # file output — every cross-process collective of the run
+        scope.enter_context(multihost.watchdog_scope(watchdog))
         return _run_solver(solver, name, *args, **kwargs)
 
 
@@ -143,6 +181,7 @@ def _run_solver(
     sentinel_growth: float = 1e3,
     max_retries: int = 3,
     dt_backoff: float = 0.5,
+    sdc_every: int = 0,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
@@ -228,6 +267,26 @@ def _run_solver(
         # matching node count on a different domain is silently wrong
         # physics
         meta = io_utils.read_checkpoint_meta(resume)
+        # elastic reshard: a .ckptd written on mesh A restoring onto a
+        # different process/device topology (the restart-after-losing-a-
+        # host path) is legitimate and worth recording — each process
+        # read only the shard regions overlapping its NEW placement
+        saved_procs = (meta or {}).get("num_processes")
+        if saved_procs is not None and int(saved_procs) != jax.process_count():
+            from multigpu_advectiondiffusion_tpu import telemetry
+
+            telemetry.event(
+                "resilience", "elastic_resume",
+                checkpoint=resume,
+                saved_processes=int(saved_procs),
+                processes=jax.process_count(),
+            )
+            if is_coord:
+                print(
+                    f"elastic resume: checkpoint {resume} was written "
+                    f"by {int(saved_procs)} process(es); restoring onto "
+                    f"{jax.process_count()}"
+                )
         got = (meta or {}).get("bounds")
         if got is not None:
             want = [list(b) for b in solver.grid.bounds]
@@ -284,6 +343,11 @@ def _run_solver(
         raise ValueError(
             "--sentinel-every supervises checkpoint-grain chunks; "
             "combine it with --checkpoint-every, not --snapshot-every"
+        )
+    if sdc_every and not supervised:
+        raise ValueError(
+            "--sdc-every rides the sentinel cadence; it needs "
+            "--sentinel-every > 0"
         )
     if (periodic or (supervised and checkpoint_every)) and not save_dir:
         raise ValueError("snapshot/checkpoint output needs save_dir")
@@ -357,6 +421,7 @@ def _run_solver(
                 checkpoint_every=checkpoint_every,
                 save_checkpoint=save_ckpt if checkpoint_every else None,
                 should_stop=lambda: guard.should_stop,
+                sdc_every=sdc_every,
             )
             sync(out.u)
             io_s = io_acc[0] if checkpoint_every else None

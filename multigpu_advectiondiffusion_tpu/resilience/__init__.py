@@ -21,7 +21,21 @@ arXiv:2506.09242); this subsystem does the same for this framework:
   + manifest and a documented exit code (:data:`EXIT_PREEMPTED`);
 * :mod:`~.faults` — the fault-injection harness driving
   ``tests/test_resilience.py`` (NaN-at-step-N, simulated Mosaic failure,
-  checkpoint truncation/corruption, simulated SIGTERM).
+  checkpoint truncation/corruption, simulated SIGTERM) and the chaos
+  harness driving ``tests/test_chaos.py`` (``kill_rank``/``stall_rank``
+  against real OS processes, ``sdc_at_step``, ``torn_ckptd_write``).
+
+The DISTRIBUTED fault-tolerance layer (ISSUE 5) lives across this
+package and ``parallel/multihost.py``: a rank-liveness watchdog
+(heartbeat records + timeout-wrapped collectives, structured
+:class:`RankFailureError` + exit code :data:`EXIT_RANK_FAILURE` instead
+of an MPI-style indefinite hang), coordinated cross-rank
+rollback/checkpoint agreement (asserted via ``multihost.agree``,
+:class:`CoordinationError` on desync), COMMIT-marker torn-write defense
+for ``.ckptd`` directories with elastic resharded resume, and an opt-in
+silent-data-corruption guard at sentinel cadence
+(:class:`SDCDetectedError`, exit code :data:`EXIT_SDC` when
+unrecoverable).
 
 Graceful kernel-ladder degradation itself lives at the dispatch layer
 (``models/base.py``): under ``impl='pallas'`` (best-available) a
@@ -31,6 +45,11 @@ Pallas/Mosaic compile or launch failure falls down the ladder
 """
 
 from multigpu_advectiondiffusion_tpu.resilience.errors import (
+    EXIT_RANK_FAILURE,
+    EXIT_SDC,
+    CoordinationError,
+    RankFailureError,
+    SDCDetectedError,
     SimulatedMosaicError,
     SolverDivergedError,
     is_kernel_failure,
@@ -55,9 +74,14 @@ from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
 
 __all__ = [
     "EXIT_PREEMPTED",
+    "EXIT_RANK_FAILURE",
+    "EXIT_SDC",
+    "CoordinationError",
     "DivergenceSentinel",
     "PreemptionExit",
     "PreemptionGuard",
+    "RankFailureError",
+    "SDCDetectedError",
     "SimulatedMosaicError",
     "SolverDivergedError",
     "SupervisorReport",

@@ -25,6 +25,80 @@ class SolverDivergedError(RuntimeError):
         )
 
 
+class SDCDetectedError(SolverDivergedError):
+    """The silent-data-corruption guard re-executed one step from a
+    probed state and the two executions disagreed bit-for-bit on a
+    deterministic rung — a hardware/memory flake, not physics. Subclasses
+    :class:`SolverDivergedError` so the supervisor's existing rollback
+    path recovers it (without a dt backoff: the time step is not the
+    problem); if retries run out, the CLI maps it to :data:`EXIT_SDC`."""
+
+    def __init__(self, step: int, t: float, mismatched_cells: int = 0):
+        self.mismatched_cells = int(mismatched_cells)
+        self.step = int(step)
+        self.t = float(t)
+        self.norm = float("nan")
+        self.reason = (
+            "silent data corruption: duplicate executions of one step "
+            f"differ in {self.mismatched_cells} cell(s)"
+        )
+        RuntimeError.__init__(
+            self,
+            f"SDC detected at step {self.step} (t={self.t:.6g}): "
+            f"{self.mismatched_cells} cell(s) differ between bit-exact "
+            "duplicate executions",
+        )
+
+
+#: Documented CLI exit code when a peer rank died or stalled past the
+#: watchdog timeout: the survivor aborts instead of hanging in a
+#: collective forever. Restart the job (on the surviving topology if a
+#: host is gone) with ``--resume auto``.
+EXIT_RANK_FAILURE = 76
+
+#: Documented CLI exit code when the silent-data-corruption guard
+#: detected a duplicate-execution mismatch and the rollback budget ran
+#: out — the hardware (or memory) is flaking faster than recovery can
+#: absorb; the run directory still holds the last committed checkpoint.
+EXIT_SDC = 77
+
+
+class RankFailureError(RuntimeError):
+    """A peer process of a multi-process run is dead or wedged.
+
+    Raised by the rank-liveness watchdog (``parallel/multihost.py``)
+    when a peer's heartbeat record goes stale, its pid dies, or a
+    timeout-wrapped collective never completes. Carries the offending
+    rank (``None`` when the watchdog cannot attribute the failure to a
+    single peer) so the survivor's exit report names who to blame; the
+    CLI maps it to :data:`EXIT_RANK_FAILURE`.
+    """
+
+    def __init__(self, rank, reason: str, detected_by=None, suspects=()):
+        self.rank = None if rank is None else int(rank)
+        self.reason = reason
+        self.detected_by = None if detected_by is None else int(detected_by)
+        self.suspects = list(suspects)
+        who = f"rank {self.rank}" if self.rank is not None else "a peer rank"
+        super().__init__(f"{who} failed: {reason}")
+
+
+class CoordinationError(RuntimeError):
+    """Cross-rank agreement on a rollback/checkpoint decision failed:
+    the ranks proposed different values — a control-flow desync that
+    must abort loudly instead of letting ranks continue from different
+    checkpoints (the torn-recovery failure mode coordinated rollback
+    exists to rule out)."""
+
+    def __init__(self, tag: str, per_rank_values):
+        self.tag = tag
+        self.per_rank_values = per_rank_values
+        super().__init__(
+            f"cross-rank agreement {tag!r} failed: ranks proposed "
+            f"different values {per_rank_values}"
+        )
+
+
 class SimulatedMosaicError(RuntimeError):
     """Fault-injection stand-in for a Mosaic compile/launch failure.
 
@@ -57,6 +131,8 @@ def is_kernel_failure(exc: BaseException) -> bool:
     failure that a lower kernel-ladder rung could avoid."""
     if isinstance(exc, SolverDivergedError):
         return False  # physics, not kernels — handled by the supervisor
+    if isinstance(exc, (RankFailureError, CoordinationError)):
+        return False  # a dead/desynced peer: no rung change can help
     if isinstance(exc, (KeyboardInterrupt, SystemExit, MemoryError)):
         return False
     text = f"{type(exc).__name__}: {exc}".lower()

@@ -14,6 +14,21 @@ actual preemption:
   tear a checkpoint file so CRC verification must catch it;
 * :func:`send_signal` — deliver a real SIGTERM/SIGINT to a process (the
   scheduler-preemption stand-in).
+
+Chaos harness (the distributed faults the watchdog + coordinated
+recovery layer claims to survive, driven by ``tests/test_chaos.py``,
+marker ``chaos``):
+
+* :func:`kill_rank` — SIGKILL a rank's OS process: no cleanup runs, its
+  collectives never complete (a dead host/preempted VM);
+* :func:`stall_rank` — SIGSTOP a rank: the pid stays alive but its
+  heartbeat goes stale and peers' collectives wedge (a livelocked or
+  swapping rank — the failure MPI turns into an indefinite hang);
+* :func:`sdc_at_step` — perturb ONE of the SDC guard's duplicate step
+  executions so the bit-exact comparison must flag it;
+* :func:`torn_ckptd_write` — tear a sharded ``.ckptd`` checkpoint the
+  way a mid-write crash would (COMMIT removed, shard file missing,
+  manifest gap/overlap), so the resume scan must skip it.
 """
 
 from __future__ import annotations
@@ -159,3 +174,124 @@ def send_signal(pid: Optional[int] = None, signum=_signal.SIGTERM) -> None:
     scheduler-preemption stand-in for in-process tests; subprocess tests
     use ``Popen.send_signal`` directly."""
     os.kill(os.getpid() if pid is None else pid, signum)
+
+
+# --------------------------------------------------------------------- #
+# Chaos harness: distributed / torn-write faults
+# --------------------------------------------------------------------- #
+def _pid(proc) -> int:
+    """Accept a pid or anything with a ``.pid`` (subprocess.Popen)."""
+    return int(getattr(proc, "pid", proc))
+
+
+def kill_rank(proc) -> None:
+    """SIGKILL a rank's OS process. Nothing runs on the victim — no
+    signal handlers, no atexit, no final checkpoint — and every
+    collective its peers are in (or enter) can never complete: the
+    fault the rank-liveness watchdog exists to bound."""
+    os.kill(_pid(proc), _signal.SIGKILL)
+
+
+def stall_rank(proc):
+    """SIGSTOP a rank's OS process (pid stays alive, heartbeat goes
+    stale — the wedged-not-dead failure). Returns a ``resume()``
+    callable delivering SIGCONT; tolerate the victim having been killed
+    meanwhile."""
+    pid = _pid(proc)
+    os.kill(pid, _signal.SIGSTOP)
+
+    def resume():
+        try:
+            os.kill(pid, _signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+
+    return resume
+
+
+@contextlib.contextmanager
+def sdc_at_step(solver, step: int, once: bool = True,
+                magnitude: float = 1e-3):
+    """Within the context, ``solver.step`` calls whose output crosses
+    global iteration ``step`` get one cell perturbed by ``magnitude`` —
+    since the SDC guard executes the step TWICE and compares bit-exact,
+    a corrupted execution models a hardware flake the guard must flag.
+    ``once=True`` corrupts exactly the first such call (a transient
+    flake: after rollback the guard re-checks clean and recovery
+    completes); ``once=False`` corrupts every OTHER call (a flaky ALU:
+    each duplicate pair keeps mismatching, which must exhaust the
+    supervisor's retry budget — corrupting EVERY call would be
+    undetectable by replay, both executions agreeing on the same wrong
+    bits). The supervisor's chunked ``run`` calls are untouched, so the
+    trajectory itself stays clean.
+    """
+    import jax.numpy as jnp
+
+    orig = solver.step
+    fired = {"count": 0}
+
+    def wrapped(st):
+        out = orig(st)
+        if int(out.it) < step:
+            return out
+        fired["count"] += 1
+        if once and fired["count"] > 1:
+            return out
+        if not once and fired["count"] % 2 == 0:
+            return out
+        idx = tuple(s // 2 for s in out.u.shape)
+        bump = jnp.asarray(magnitude, out.u.dtype)
+        return type(out)(
+            u=out.u.at[idx].add(bump), t=out.t, it=out.it
+        )
+
+    solver.step = wrapped
+    try:
+        yield fired
+    finally:
+        solver.step = orig
+
+
+def torn_ckptd_write(directory: str, mode: str = "uncommitted") -> None:
+    """Tear a sharded ``.ckptd`` checkpoint directory the way a
+    mid-write crash (or bit-rot) would, so the verification/resume path
+    must refuse it:
+
+    * ``'uncommitted'`` — remove the COMMIT marker (the write never
+      finished);
+    * ``'missing_shard'`` — delete one shard file out from under the
+      manifest;
+    * ``'manifest_gap'`` — shrink one manifest entry's extent: cells of
+      the global array are covered by no shard;
+    * ``'manifest_overlap'`` — grow one manifest entry's extent into
+      its neighbor (or out of bounds): two shards claim the same cells.
+    """
+    import glob
+    import json
+
+    if mode == "uncommitted":
+        os.remove(os.path.join(directory, "COMMIT"))
+        return
+    if mode == "missing_shard":
+        shards = sorted(glob.glob(os.path.join(directory, "shard_*.ckpt")))
+        if not shards:
+            raise ValueError(f"no shard files to remove in {directory}")
+        os.remove(shards[-1])
+        return
+    if mode in ("manifest_gap", "manifest_overlap"):
+        mpaths = sorted(
+            glob.glob(os.path.join(directory, "manifest_p*.json"))
+        )
+        if not mpaths:
+            raise ValueError(f"no process manifests in {directory}")
+        with open(mpaths[0]) as f:
+            m = json.load(f)
+        entry = min(m["shards"], key=lambda e: tuple(e["start"]))
+        delta = -1 if mode == "manifest_gap" else 1
+        if entry["shape"][0] + delta <= 0:
+            raise ValueError("shard too small to tear along axis 0")
+        entry["shape"] = [entry["shape"][0] + delta] + entry["shape"][1:]
+        with open(mpaths[0], "w") as f:
+            json.dump(m, f)
+        return
+    raise ValueError(f"unknown torn-checkpoint mode {mode!r}")

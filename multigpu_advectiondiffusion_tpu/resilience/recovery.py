@@ -9,10 +9,12 @@ Selection rules (documented in README "Failure modes & resilience"):
 2. newest first by iteration number (name order == write order);
 3. the first candidate that passes full integrity verification wins —
    header parse, payload CRC32, and for ``.ckptd`` directories the
-   manifest tiling check plus every shard's CRC;
-4. corrupt/truncated candidates are reported to stderr and skipped —
-   the exact behavior a preempted run needs when it died mid-write
-   (the atomic rename makes that window tiny but a torn disk is not).
+   COMMIT marker, the manifest's exact tiling of the global index
+   space (no gaps, no overlaps) plus every shard's CRC;
+4. corrupt/truncated/uncommitted candidates are reported to stderr and
+   skipped — the exact behavior a preempted (or SIGKILLed) run needs
+   when it died mid-write: a ``.ckptd`` directory torn before its
+   COMMIT landed is named in the report and never selected.
 
 Returns ``None`` when nothing valid exists — the caller starts from the
 initial condition.

@@ -85,6 +85,31 @@ def make_health_probe(solver):
     return probe
 
 
+def duplicate_step_check(solver, state):
+    """Silent-data-corruption probe: execute ONE step twice from the
+    same ``state`` and compare the results bit-for-bit.
+
+    On a deterministic rung (every rung of this framework: the step
+    functions are pure jitted programs with no RNG and a fixed
+    reduction order per compiled executable) two executions of the same
+    compiled step on the same operands must agree exactly; any
+    mismatch is a hardware/memory flake — the silent corruption that
+    otherwise propagates into every later state and checkpoint.
+    Sharded-safe: the elementwise inequality reduces over the global
+    array, so every process sees the same replicated verdict (the
+    comparison itself is the cheap part — the cost is the two extra
+    steps, paid only at the opt-in cadence).
+
+    Returns ``(ok, mismatched_cells)``.
+    """
+    import jax.numpy as jnp
+
+    a = solver.step(state)
+    b = solver.step(state)
+    mismatched = int(jnp.sum(a.u != b.u))
+    return mismatched == 0, mismatched
+
+
 class DivergenceSentinel:
     """All-finite + norm-growth health check against a solver's state.
 

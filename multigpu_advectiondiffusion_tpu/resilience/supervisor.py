@@ -16,10 +16,12 @@ from typing import Callable, List, Optional
 
 from multigpu_advectiondiffusion_tpu import telemetry
 from multigpu_advectiondiffusion_tpu.resilience.errors import (
+    SDCDetectedError,
     SolverDivergedError,
 )
 from multigpu_advectiondiffusion_tpu.resilience.sentinel import (
     DivergenceSentinel,
+    duplicate_step_check,
 )
 
 
@@ -33,6 +35,15 @@ class SupervisorReport:
     events: List[dict] = dataclasses.field(default_factory=list)
     preempted: bool = False
     final_norm: Optional[float] = None
+    # silent-data-corruption guard (opt-in, probe cadence): checks run,
+    # detections caught — a detection also lands in ``events`` with the
+    # rollback it triggered
+    sdc_every: int = 0
+    sdc_checks: int = 0
+    sdc_detects: int = 0
+    # True when rollback/checkpoint decisions were asserted identical
+    # across ranks (multi-process runs)
+    coordinated: bool = False
     # physics-probe facts of the LAST probe (chunk cadence): relative
     # mass-integral drift vs the armed initial state, plus the full
     # min/max/L2/mass scalars — the drift line in RunSummary.print_block
@@ -77,6 +88,8 @@ def supervise_run(
     checkpoint_every: int = 0,
     save_checkpoint: Optional[Callable] = None,
     should_stop: Optional[Callable[[], bool]] = None,
+    sdc_every: int = 0,
+    coordinated: Optional[bool] = None,
 ):
     """Run to ``iters`` steps or simulated time ``t_end`` under
     supervision; returns ``(final_state, SupervisorReport)``.
@@ -98,10 +111,54 @@ def supervise_run(
     lands on the same simulated time whatever dt the backoff schedule
     settled on — the mode to use when a retried run must reproduce the
     un-faulted answer.
+
+    ``sdc_every`` > 0 arms the opt-in silent-data-corruption guard:
+    every ``sdc_every``-th sentinel probe re-executes one step from the
+    probed state and compares bit-exact
+    (:func:`~.sentinel.duplicate_step_check`); a mismatch emits an
+    ``sdc:detect`` telemetry event and recovers through the same
+    rollback path as a divergence — but WITHOUT the dt backoff (the
+    time step is not the problem), so a recovered run reproduces the
+    un-faulted trajectory bit-for-bit.
+
+    ``coordinated`` (default: auto — on whenever ``jax.process_count()
+    > 1``) makes every rollback and checkpoint decision an explicit
+    cross-rank agreement (:func:`parallel.multihost.agree`): all ranks
+    assert the same rollback target, retry count and backoff factor
+    before acting, and the same checkpoint iteration before writing —
+    a desync raises :class:`CoordinationError` loudly instead of ranks
+    silently recovering to different states.
     """
     if (iters is None) == (t_end is None):
         raise ValueError("provide exactly one of iters/t_end")
-    report = SupervisorReport(sentinel_every=int(sentinel_every))
+    if sdc_every and not sentinel_every:
+        raise ValueError(
+            "the SDC guard rides the sentinel cadence: sdc_every needs "
+            "sentinel_every > 0"
+        )
+    import jax
+
+    coordinate = (
+        jax.process_count() > 1 if coordinated is None else bool(coordinated)
+    )
+    report = SupervisorReport(
+        sentinel_every=int(sentinel_every),
+        sdc_every=int(sdc_every),
+        coordinated=coordinate,
+    )
+
+    def _agree(tag: str, *values):
+        """Assert every rank proposes the same decision (no-op in
+        single-process runs); the agreement itself becomes an event."""
+        if not coordinate:
+            return
+        from multigpu_advectiondiffusion_tpu.parallel import multihost
+
+        multihost.agree(tag, values)
+        telemetry.event(
+            "resilience", "agree", tag=tag,
+            values=[float(v) for v in values],
+        )
     sentinel = None
     if sentinel_every:
         sentinel = DivergenceSentinel(solver, growth=growth)
@@ -136,9 +193,30 @@ def supervise_run(
                 "physics", "probe",
                 step=int(nxt.it), time=float(nxt.t), **stats,
             )
+            if sdc_every and report.probes % sdc_every == 0:
+                # opt-in SDC guard: one step re-executed twice from the
+                # probed state, compared bit-exact; runs BEFORE the
+                # rollback point advances so a detection recovers to
+                # the last state that passed it
+                report.sdc_checks += 1
+                ok, mismatched = duplicate_step_check(solver, nxt)
+                if not ok:
+                    report.sdc_detects += 1
+                    telemetry.event(
+                        "sdc", "detect",
+                        step=int(nxt.it), time=float(nxt.t),
+                        mismatched_cells=mismatched,
+                    )
+                    raise SDCDetectedError(
+                        int(nxt.it), float(nxt.t),
+                        mismatched_cells=mismatched,
+                    )
         if checkpoint_every and (
             int(nxt.it) - last_ckpt_it >= checkpoint_every
         ):
+            # coordinated commit: every rank asserts the same
+            # checkpoint iteration before any shard byte is written
+            _agree("checkpoint", int(nxt.it))
             if save_checkpoint is not None:
                 save_checkpoint(nxt)
             last_ckpt_it = int(nxt.it)
@@ -159,7 +237,20 @@ def supervise_run(
                 reason=err.reason,
             )
             raise err
-        action = scale_dt(solver, dt_backoff)
+        sdc = isinstance(err, SDCDetectedError)
+        if sdc:
+            # corruption, not stiffness: recompute from the rollback
+            # point at the SAME dt — the retried trajectory reproduces
+            # the un-faulted one bit-for-bit
+            action = "recompute (dt unchanged)"
+        else:
+            action = scale_dt(solver, dt_backoff)
+        # coordinated rollback: all ranks assert the same rollback
+        # target, retry count and backoff factor before continuing
+        _agree(
+            "rollback", report.retries, err.step, int(last_good.it),
+            0.0 if sdc else dt_backoff,
+        )
         ev = {
             "step": err.step,
             "t": err.t,

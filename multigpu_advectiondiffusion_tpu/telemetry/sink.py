@@ -30,10 +30,12 @@ so host-side emission happens at chunk/dispatch cadence, never per cell.
 
 from __future__ import annotations
 
+import atexit
 import collections
 import contextlib
 import itertools
 import json
+import sys
 import threading
 import time
 from typing import Optional
@@ -45,10 +47,25 @@ EVENT_SCHEMA = 1
 
 def _process_index() -> int:
     """Process tag, read at emit time (cheap: a runtime global). Falls
-    back to 0 when jax is not importable or not yet set up."""
+    back to 0 when jax is not importable or not yet set up.
+
+    Must NOT force backend initialization: the CLI installs the sink
+    BEFORE ``jax.distributed.initialize`` (so the join's retry loop is
+    in the stream), and ``jax.process_index()`` on an uninitialized
+    process would bring the backend up single-process — making the
+    later distributed join fail with "must be called before any JAX
+    computations". Until the backend exists the tag is this process's
+    declared distributed id (0 when undeclared)."""
     try:
         import jax
+        from jax._src import xla_bridge
 
+        if not xla_bridge.backends_are_initialized():
+            from jax._src import distributed
+
+            state = getattr(distributed, "global_state", None)
+            pid = getattr(state, "process_id", None)
+            return int(pid) if pid is not None else 0
         return int(jax.process_index())
     except Exception:
         return 0
@@ -194,12 +211,65 @@ def get_sink():
     return _active
 
 
+# ------------------------------------------------------------------ #
+# Crash-path flush: the JSONL tail is the post-mortem evidence — it
+# must survive a SolverDivergedError unwinding to the interpreter, a
+# RankFailureError abort and a preemption SystemExit, in EVERY process,
+# not only clean returns. Two hooks, installed once on first install():
+#
+# * an atexit flush (covers SystemExit — which never reaches
+#   sys.excepthook — and ordinary interpreter teardown);
+# * a chained sys.excepthook that records the crash itself as a final
+#   `crash` event (exception type + message) and flushes before the
+#   previous hook prints the traceback.
+#
+# The watchdog's os._exit path bypasses both by design; it flushes and
+# closes the sink explicitly before exiting.
+# ------------------------------------------------------------------ #
+_crash_hooks_installed = False
+
+
+def _atexit_flush() -> None:
+    try:
+        _active.flush()
+    except Exception:
+        pass
+
+
+def _install_crash_hooks() -> None:
+    global _crash_hooks_installed
+    if _crash_hooks_installed:
+        return
+    _crash_hooks_installed = True
+    atexit.register(_atexit_flush)
+    prev_hook = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        try:
+            if _active.active:
+                code = exc.code if isinstance(exc, SystemExit) else None
+                _active.event(
+                    "crash", exc_type.__name__,
+                    message=str(exc)[:500], exit_code=code,
+                )
+                _active.flush()
+        except Exception:
+            pass  # the crash record must never mask the crash
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = hook
+
+
 def install(path: str, tail_events: int = 512) -> TelemetrySink:
     """Open a JSONL sink at ``path`` and make it the active sink. An
-    already-active sink is closed first (last install wins)."""
+    already-active sink is closed first (last install wins). The first
+    install also arms the crash-path flush hooks (atexit +
+    ``sys.excepthook``), so the stream's tail survives uncaught errors
+    and preemption exits."""
     global _active
     if _active.active:
         _active.close()
+    _install_crash_hooks()
     _active = TelemetrySink(path, tail_events=tail_events)
     return _active
 

@@ -458,7 +458,14 @@ def load_checkpoint(path: str, sharding=None) -> SolverState:
 #   manifest.json          global shape/dtype/t/it + grid/physics meta
 #   manifest_p<K>.json     process K's shard list ({file, start, shape})
 #   shard_<start...>.ckpt  one standard .ckpt per distinct shard block
+#   COMMIT                 the durability marker, written LAST (after a
+#                          cross-process barrier proved every shard and
+#                          manifest landed) — a directory without it is
+#                          a torn or in-progress write and is never
+#                          loaded, verified, or auto-resumed from
 # --------------------------------------------------------------------- #
+
+_CKPTD_COMMIT = "COMMIT"
 
 
 def save_checkpoint_sharded(
@@ -481,8 +488,23 @@ def save_checkpoint_sharded(
 
     import jax
 
+    from multigpu_advectiondiffusion_tpu.parallel import multihost
+
     t0 = _time.perf_counter()
     os.makedirs(directory, exist_ok=True)
+    # Overwriting an earlier checkpoint of the same name: invalidate its
+    # COMMIT marker FIRST (and barrier, so no peer starts rewriting
+    # shards while a reader could still see the stale commit) — the
+    # directory is complete-or-uncommitted at every instant.
+    commit_path = os.path.join(directory, _CKPTD_COMMIT)
+    multi = jax.process_count() > 1
+    if jax.process_index() == 0:
+        try:
+            os.remove(commit_path)
+        except FileNotFoundError:
+            pass
+    if multi:
+        multihost.barrier(f"ckptd-begin:{directory}")
     u = state.u
     shards = getattr(u, "addressable_shards", None)
     if shards is None:  # plain array: one full-extent shard
@@ -527,17 +549,17 @@ def save_checkpoint_sharded(
         json.dump({"process": pid, "shards": entries}, f)
     os.replace(tmp, os.path.join(directory, f"manifest_p{pid}.json"))
 
-    # manifest.json is the checkpoint's commit record: it must appear
-    # only after EVERY process's shards are on disk (else a directory
-    # can look complete while peers are still writing — losing the
-    # complete-or-absent guarantee the single-file format gets from its
-    # atomic rename). Barrier, coordinator writes, barrier again so no
-    # process returns (and possibly loads) before the commit landed.
-    multi = jax.process_count() > 1
+    # The COMMIT marker is the checkpoint's commit record: it must
+    # appear only after EVERY process's shards and manifests are on
+    # disk (else a directory can look complete while peers are still
+    # writing — losing the complete-or-absent guarantee the single-file
+    # format gets from its atomic rename). Barrier, coordinator writes
+    # manifest.json then COMMIT, barrier again so no process returns
+    # (and possibly loads) before the commit landed. The barriers are
+    # timeout-wrapped when a rank watchdog is installed — a peer dying
+    # mid-checkpoint surfaces as RankFailureError, not a silent hang.
     if multi:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"ckptd-shards:{directory}")
+        multihost.barrier(f"ckptd-shards:{directory}")
     if pid == 0:
         meta = {
             "global_shape": list(gshape),
@@ -555,8 +577,18 @@ def save_checkpoint_sharded(
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, os.path.join(directory, "manifest.json"))
+        # COMMIT last: its presence asserts every earlier artifact
+        tmp = commit_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"it": it, "t": t, "num_processes": jax.process_count()},
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, commit_path)
     if multi:
-        multihost_utils.sync_global_devices(f"ckptd-commit:{directory}")
+        multihost.barrier(f"ckptd-commit:{directory}")
     _io_event(
         "checkpoint_write", directory,
         sum(arr.nbytes for _, arr in blocks),
@@ -577,14 +609,63 @@ def _shard_desc(e: dict) -> str:
     return f"{e['file']} (global offsets {region})"
 
 
+def _validate_tiling(directory: str, gshape, entries) -> None:
+    """The manifest's shard set must tile the global index space
+    EXACTLY: every shard in bounds, no pairwise overlaps, no gaps.
+    (Disjoint + in-bounds + total cell count equal is an exact cover;
+    the previous cell-count-only check let an overlap and a gap cancel
+    — precisely the kind of torn/hand-edited manifest the resume path
+    must refuse.)"""
+    ndim = len(gshape)
+    total = int(np.prod(gshape))
+    covered = 0
+    for e in entries:
+        start, shape = e["start"], e["shape"]
+        if len(start) != ndim or len(shape) != ndim or any(
+            s < 0 or n <= 0 or s + n > g
+            for s, n, g in zip(start, shape, gshape)
+        ):
+            raise IOError(
+                f"sharded checkpoint {directory}: shard {_shard_desc(e)}"
+                f" lies outside the global shape {tuple(gshape)}"
+            )
+        covered += int(np.prod(shape))
+    for i, a in enumerate(entries):
+        for b in entries[i + 1:]:
+            if all(
+                max(a["start"][k], b["start"][k])
+                < min(a["start"][k] + a["shape"][k],
+                      b["start"][k] + b["shape"][k])
+                for k in range(ndim)
+            ):
+                raise IOError(
+                    f"sharded checkpoint {directory}: manifest shards "
+                    f"overlap: {_shard_desc(a)} and {_shard_desc(b)}"
+                )
+    if covered != total:
+        raise IOError(
+            f"sharded checkpoint {directory} does not tile the global "
+            f"array: shards cover {covered} of {total} cells (gap in "
+            "the manifest); present shards: "
+            + "; ".join(_shard_desc(e) for e in entries)
+        )
+
+
 def _sharded_manifest(directory: str):
     """(meta, entries): the global manifest plus the union of every
     process manifest's shard entries, deduplicated by start offset and
-    validated to tile the global array exactly. A shard listed by a
-    manifest but absent on disk raises an error naming the missing
-    file(s) and the global offsets they should cover."""
+    validated to tile the global array exactly (no gaps, no overlaps).
+    Requires the COMMIT marker — a directory without one is a torn or
+    in-progress write. A shard listed by a manifest but absent on disk
+    raises an error naming the missing file(s) and the global offsets
+    they should cover."""
     import glob as _glob
 
+    if not os.path.exists(os.path.join(directory, _CKPTD_COMMIT)):
+        raise IOError(
+            f"sharded checkpoint {directory} has no COMMIT marker "
+            "(torn or in-progress write)"
+        )
     with open(os.path.join(directory, "manifest.json")) as f:
         meta = json.load(f)
     entries, seen = [], set()
@@ -605,15 +686,7 @@ def _sharded_manifest(directory: str):
             f"{len(missing)} shard file(s): "
             + "; ".join(_shard_desc(e) for e in missing)
         )
-    gshape = tuple(meta["global_shape"])
-    cells = sum(int(np.prod(e["shape"])) for e in entries)
-    if cells != int(np.prod(gshape)):
-        raise IOError(
-            f"sharded checkpoint {directory} does not tile the global "
-            f"array: shards cover {cells} cells of {int(np.prod(gshape))};"
-            " present shards: "
-            + "; ".join(_shard_desc(e) for e in entries)
-        )
+    _validate_tiling(directory, tuple(meta["global_shape"]), entries)
     return meta, entries
 
 
@@ -710,11 +783,12 @@ def load_checkpoint_sharded(directory: str, sharding=None) -> SolverState:
 def verify_checkpoint(path: str) -> None:
     """Full integrity check without constructing device arrays: header
     parse + payload CRC32 for ``.ckpt``, archive read for ``.npz``, and
-    for a ``.ckptd`` directory the manifest tiling check plus every
-    shard's CRC (errors name the exact shard file and its global
-    offsets). Raises ``IOError``/``ValueError`` on any defect; the
-    ``--resume auto`` scan (``resilience/recovery.py``) uses this to
-    skip corrupt candidates."""
+    for a ``.ckptd`` directory the COMMIT marker, the manifest's exact
+    tiling of the global index space (no gaps, no overlaps, nothing out
+    of bounds) plus every shard's CRC (errors name the exact shard file
+    and its global offsets). Raises ``IOError``/``ValueError`` on any
+    defect; the ``--resume auto`` scan (``resilience/recovery.py``)
+    uses this to skip corrupt candidates."""
     import struct
     import zlib
 

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Soak the distributed chaos suite: loop the 2-process kill/stall/torn-
+# checkpoint tests N times (default 5) and fail on ANY flake — the
+# recovery paths must be deterministic, not merely usually-working.
+#
+#   ./out/soak_resilience.sh        # 5 rounds of the fast chaos suite
+#   ./out/soak_resilience.sh 20     # longer soak
+#   SOAK_SLOW=1 ./out/soak_resilience.sh 3   # include the slow soak test
+#
+# Runs on the virtual CPU backend (no TPU needed), same as tier-1.
+set -euo pipefail
+N="${1:-5}"
+cd "$(dirname "$0")/.."
+
+MARKER="chaos and not slow"
+if [[ "${SOAK_SLOW:-0}" == "1" ]]; then
+  MARKER="chaos"
+fi
+
+for i in $(seq 1 "$N"); do
+  echo "=== soak_resilience: round $i/$N ==="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+    -m "$MARKER" -p no:cacheprovider -p no:randomly \
+    || { echo "soak_resilience: FLAKE in round $i/$N" >&2; exit 1; }
+done
+echo "soak_resilience: $N round(s) clean"

@@ -1,0 +1,605 @@
+"""Distributed chaos suite (marker ``chaos``, CPU-only, tier-1 except
+the soak).
+
+The failure modes PR 2's single-process resilience layer cannot see are
+injected here for real — OS signals against real processes, torn bytes
+against real sharded checkpoints — and the full recovery loop proven:
+
+* ``kill_rank`` (SIGKILL) mid-run: the survivor exits with the
+  documented rank-failure code within the watchdog timeout (no MPI-style
+  indefinite hang), and a restart with ``--resume auto`` — on the
+  original 2-process mesh AND on a 1-process mesh (elastic resharded
+  resume) — reproduces the uninterrupted run's final state bit-exactly;
+* ``stall_rank`` (SIGSTOP): the pid stays alive, the heartbeat goes
+  stale, the survivor still exits with the rank-failure code — the
+  wedged-not-dead case that otherwise hangs forever inside gloo;
+* ``torn_ckptd_write``: a ``.ckptd`` missing its COMMIT marker, missing
+  a shard file, or carrying a manifest gap/overlap is never selected by
+  ``--resume auto`` and the skip names the defect;
+* ``sdc_at_step``: an injected duplicate-execution mismatch is detected
+  at sentinel cadence, emitted as an ``sdc:detect`` event and recovered
+  through the rollback path — bit-exactly, since SDC recovery keeps dt.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu import (
+    DiffusionConfig,
+    DiffusionSolver,
+    Grid,
+    telemetry,
+)
+from multigpu_advectiondiffusion_tpu.cli.__main__ import main as cli_main
+from multigpu_advectiondiffusion_tpu.parallel import multihost
+from multigpu_advectiondiffusion_tpu.resilience import (
+    EXIT_RANK_FAILURE,
+    CoordinationError,
+    RankFailureError,
+    faults,
+    find_latest_checkpoint,
+    supervise_run,
+)
+from multigpu_advectiondiffusion_tpu.utils import io as io_utils
+from multigpu_advectiondiffusion_tpu.utils.io import load_binary
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the 2-process chaos topology: z split over (2 processes) x (4 virtual
+# devices); lz=24 -> 3 rows/shard, the documented bit-identity floor.
+# ITERS sized so the post-kill runway (ITERS - CKPT_EVERY steps at
+# ~25 ms/step over single-core gloo) dwarfs the kill latency while the
+# 2-process restart stays tier-1-affordable.
+GRID = ["--n", "16", "16", "24"]
+SHAPE_ZYX = (24, 16, 16)
+ITERS = 600
+CKPT_EVERY = 25
+
+
+# --------------------------------------------------------------------- #
+# Two-process launch plumbing (pattern of tests/test_multihost.py:
+# output to files, never pipes — a full pipe stalls a worker
+# mid-collective and deadlocks its peer)
+# --------------------------------------------------------------------- #
+_CLI_WORKER = r'''
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multigpu_advectiondiffusion_tpu.cli.__main__ import main
+main(json.loads(sys.argv[2]))
+print("CHAOS-WORKER-OK", flush=True)
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch_two(tmp_path, tag, cli_args_for):
+    """Start two CLI worker subprocesses; returns (procs, logs, handles)."""
+    port = _free_port()
+    script = tmp_path / f"worker_{tag}.py"
+    script.write_text(_CLI_WORKER)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    logs = [tmp_path / f"{tag}_w{i}.log" for i in range(2)]
+    handles = [open(log, "w") for log in logs]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), REPO,
+             json.dumps(cli_args_for(i, port))],
+            stdout=handles[i], stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for i in range(2)
+    ]
+    return procs, logs, handles
+
+
+def _cleanup(procs, handles):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=30)
+    for h in handles:
+        h.close()
+
+
+def _wait_for_commit(run_dir, procs, logs, deadline_s=180):
+    """Block until ``--resume auto`` would find a committed checkpoint
+    under ``run_dir`` (i.e. the chunked loop is running)."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        picked = find_latest_checkpoint(str(run_dir), report=lambda m: None)
+        if picked:
+            return picked
+        for i, p in enumerate(procs):
+            if p.poll() is not None:
+                pytest.fail(
+                    f"worker {i} exited rc={p.returncode} before any "
+                    "committed checkpoint:\n" + logs[i].read_text()[-3000:]
+                )
+        time.sleep(0.1)
+    pytest.fail(f"no committed checkpoint within {deadline_s}s")
+
+
+def _chaos_args(i, port, run_dir, iters=ITERS, extra=()):
+    return [
+        "diffusion3d", *GRID, "--iters", str(iters),
+        "--mesh", "dz_dcn=2,dz_ici=4", "--save", str(run_dir),
+        "--coordinator", f"localhost:{port}",
+        "--num-processes", "2", "--process-id", str(i),
+        *extra,
+    ]
+
+
+def _picked_iteration(path: str) -> int:
+    stem = os.path.basename(path)[len("checkpoint_"):].rsplit(".", 1)[0]
+    return int(stem)
+
+
+# --------------------------------------------------------------------- #
+# Acceptance: SIGKILL -> documented exit within the watchdog timeout ->
+# restart (same mesh AND elastic reshard) -> bit-exact trajectory
+# --------------------------------------------------------------------- #
+def _kill_rank_cycle(tmp_path, tag, ref):
+    """One kill -> abort -> both restarts cycle; returns the survivor's
+    detection latency in seconds."""
+    run = tmp_path / f"run_{tag}"
+    run.mkdir()  # the --metrics sink opens before the run dir exists
+
+    def argsf(i, port):
+        return _chaos_args(
+            i, port, run,
+            extra=[
+                "--checkpoint-every", str(CKPT_EVERY),
+                "--checkpoint-sharded",
+                "--sentinel-every", str(CKPT_EVERY),
+                "--watchdog-timeout", "3",
+                "--metrics", str(run / f"events_p{i}.jsonl"),
+            ],
+        )
+
+    procs, logs, handles = _launch_two(tmp_path, f"kill_{tag}", argsf)
+    try:
+        _wait_for_commit(run, procs, logs)
+        faults.kill_rank(procs[1])
+        t_kill = time.time()
+        # the survivor must NOT hang: documented exit code within the
+        # watchdog window (generous slack for a loaded CI box)
+        rc0 = procs[0].wait(timeout=90)
+        detect_s = time.time() - t_kill
+        procs[1].wait(timeout=30)
+    finally:
+        _cleanup(procs, handles)
+    assert rc0 == EXIT_RANK_FAILURE, (
+        f"survivor rc={rc0}:\n" + logs[0].read_text()[-3000:]
+    )
+    assert procs[1].returncode == -9  # SIGKILL took the victim
+
+    # structured forensics: report file names the failed rank, and the
+    # telemetry stream's tail carries the rank:failure event (the
+    # crash-path flush satellite)
+    report = json.loads((run / "rank_failure_p0.json").read_text())
+    assert report["failed_rank"] == 1
+    assert report["exit_code"] == EXIT_RANK_FAILURE
+    events = [
+        json.loads(line)
+        for line in (run / "events_p0.jsonl").read_text().splitlines()
+    ]
+    kinds = {(e["kind"], e["name"]) for e in events}
+    assert ("rank", "watchdog_armed") in kinds
+    assert ("rank", "failure") in kinds
+
+    # elastic resharded resume: 1 process, 8-way local mesh, reading
+    # only the shard regions overlapping the NEW placement
+    picked = find_latest_checkpoint(str(run))
+    assert picked and picked.endswith(".ckptd")
+    remaining = ITERS - _picked_iteration(picked)
+    assert remaining > 0, "survivor finished before the kill landed"
+    cli_main(["diffusion3d", *GRID, "--iters", str(remaining),
+              "--mesh", "dz=8", "--save", str(run), "--resume", "auto"])
+    out1 = load_binary(str(run / "result.bin"), SHAPE_ZYX)
+    np.testing.assert_array_equal(out1, ref)
+
+    # restart on the ORIGINAL 2-process topology from the same
+    # checkpoint (the no-reshard recovery path)
+    procs2, logs2, handles2 = _launch_two(
+        tmp_path, f"restart_{tag}",
+        lambda i, port: _chaos_args(
+            i, port, run, iters=remaining, extra=["--resume", "auto"]
+        ),
+    )
+    try:
+        for i, p in enumerate(procs2):
+            assert p.wait(timeout=240) == 0, (
+                f"restart worker {i}:\n" + logs2[i].read_text()[-3000:]
+            )
+    finally:
+        _cleanup(procs2, handles2)
+    out2 = load_binary(str(run / "result.bin"), SHAPE_ZYX)
+    np.testing.assert_array_equal(out2, ref)
+    return detect_s
+
+
+def _uninterrupted_reference(tmp_path):
+    full = tmp_path / "full"
+    cli_main(["diffusion3d", *GRID, "--iters", str(ITERS),
+              "--save", str(full)])
+    return load_binary(str(full / "result.bin"), SHAPE_ZYX)
+
+
+def test_kill_rank_watchdog_exit_and_elastic_resume(tmp_path):
+    ref = _uninterrupted_reference(tmp_path)
+    detect_s = _kill_rank_cycle(tmp_path, "t1", ref)
+    # detection bounded by the watchdog, not by a gloo/TCP timeout
+    assert detect_s < 60
+
+
+@pytest.mark.slow
+def test_kill_restart_soak(tmp_path):
+    """Multi-minute soak: the kill -> abort -> elastic-restart loop must
+    hold up under repetition (out/soak_resilience.sh runs the whole
+    chaos suite N times on top of this)."""
+    ref = _uninterrupted_reference(tmp_path)
+    for round_idx in range(3):
+        _kill_rank_cycle(tmp_path, f"soak{round_idx}", ref)
+
+
+def test_stall_rank_watchdog_exit(tmp_path):
+    """SIGSTOP (not SIGKILL): the victim's pid stays alive so only the
+    heartbeat-staleness path can catch it — the true hang case where
+    gloo keeps its TCP connections open forever."""
+    run = tmp_path / "run"
+
+    def argsf(i, port):
+        return _chaos_args(
+            i, port, run,
+            extra=[
+                "--checkpoint-every", str(CKPT_EVERY),
+                "--checkpoint-sharded",
+                "--sentinel-every", str(CKPT_EVERY),
+                "--watchdog-timeout", "2",
+            ],
+        )
+
+    procs, logs, handles = _launch_two(tmp_path, "stall", argsf)
+    resume = None
+    try:
+        _wait_for_commit(run, procs, logs)
+        resume = faults.stall_rank(procs[1])
+        t_stall = time.time()
+        rc0 = procs[0].wait(timeout=90)
+        detect_s = time.time() - t_stall
+    finally:
+        if resume is not None:
+            resume()
+        _cleanup(procs, handles)
+    assert rc0 == EXIT_RANK_FAILURE, (
+        f"survivor rc={rc0}:\n" + logs[0].read_text()[-3000:]
+    )
+    assert detect_s < 60
+    report = json.loads((run / "rank_failure_p0.json").read_text())
+    assert report["failed_rank"] == 1
+    assert "stale" in report["reason"]
+
+
+# --------------------------------------------------------------------- #
+# Torn sharded checkpoints are never auto-selected
+# --------------------------------------------------------------------- #
+def _save_ckptd(devices, path, it=4):
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from multigpu_advectiondiffusion_tpu.models.state import SolverState
+
+    mesh = Mesh(np.asarray(devices[:2]), ("dy",))
+    sharding = NamedSharding(mesh, P("dy", None))
+    u = jax.device_put(
+        jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8), sharding
+    )
+    io_utils.save_checkpoint_sharded(
+        path, SolverState(u=u, t=jnp.asarray(0.5), it=jnp.asarray(it))
+    )
+
+
+def test_torn_ckptd_variants_never_auto_selected(devices, tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    valid = str(d / "checkpoint_000010.ckptd")
+    _save_ckptd(devices, valid, it=10)
+    modes = (
+        "uncommitted", "missing_shard", "manifest_gap", "manifest_overlap",
+    )
+    for k, mode in enumerate(modes):
+        torn = str(d / f"checkpoint_{20 + k:06d}.ckptd")
+        _save_ckptd(devices, torn, it=20 + k)
+        faults.torn_ckptd_write(torn, mode)
+        with pytest.raises(IOError):
+            io_utils.verify_checkpoint(torn)
+    reports = []
+    picked = find_latest_checkpoint(str(d), report=reports.append)
+    assert picked == valid
+    assert len(reports) == len(modes)
+    joined = "\n".join(reports)
+    assert "COMMIT" in joined  # uncommitted named as such
+    assert "missing" in joined  # absent shard file
+    assert "gap" in joined  # manifest gap
+    assert "overlap" in joined  # manifest overlap
+
+
+def test_ckptd_commit_marker_written_last(devices, tmp_path):
+    d = str(tmp_path / "c.ckptd")
+    _save_ckptd(devices, d)
+    assert os.path.exists(os.path.join(d, "COMMIT"))
+    io_utils.verify_checkpoint(d)  # pristine passes
+    faults.torn_ckptd_write(d, "uncommitted")
+    with pytest.raises(IOError, match="COMMIT"):
+        io_utils.verify_checkpoint(d)
+    with pytest.raises(IOError, match="COMMIT"):
+        io_utils.load_checkpoint(d)
+
+
+def test_elastic_reshard_load(devices, tmp_path):
+    """A .ckptd written on mesh A restores onto mesh B (different
+    device count / axis split) and onto no mesh at all — each reader
+    assembling only the regions its new placement needs."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    d = str(tmp_path / "c.ckptd")
+    _save_ckptd(devices, d)  # written on a 2-way dy mesh
+    full = io_utils.load_checkpoint(d)  # meshless
+    want = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    np.testing.assert_array_equal(np.asarray(full.u), want)
+    for n in (4, 8):
+        sh = NamedSharding(
+            Mesh(np.asarray(devices[:n]), ("dy",)), P("dy", None)
+        )
+        re = io_utils.load_checkpoint(d, sharding=sh)
+        assert re.u.sharding.num_devices == n
+        np.testing.assert_array_equal(np.asarray(re.u), want)
+        assert float(re.t) == 0.5 and int(re.it) == 4
+
+
+# --------------------------------------------------------------------- #
+# SDC guard: inject -> sdc:detect event -> rollback -> bit-exact
+# --------------------------------------------------------------------- #
+def _diffusion2d():
+    return DiffusionSolver(
+        DiffusionConfig(
+            grid=Grid.make(16, 12, lengths=4.0), dtype="float32"
+        )
+    )
+
+
+def test_sdc_guard_detects_and_recovers_bit_exact(tmp_path):
+    ref = _diffusion2d()
+    ref_out = ref.run(ref.initial_state(), 12)
+
+    solver = _diffusion2d()
+    state = solver.initial_state()
+    with telemetry.capture(str(tmp_path / "ev.jsonl")) as sink:
+        with faults.sdc_at_step(solver, 4):
+            out, report = supervise_run(
+                solver, state, iters=12, sentinel_every=2, sdc_every=1,
+                max_retries=2,
+            )
+        events = sink.tail(400)
+    assert report.sdc_every == 1
+    assert report.sdc_checks >= 2  # re-checked after the rollback
+    assert report.sdc_detects == 1
+    assert report.retries == 1
+    assert report.events[0]["action"] == "recompute (dt unchanged)"
+    assert "silent data corruption" in report.events[0]["reason"]
+    kinds = [(e["kind"], e["name"]) for e in events]
+    assert kinds.index(("sdc", "detect")) < kinds.index(
+        ("resilience", "rollback")
+    )
+    # dt untouched -> the recovered trajectory IS the un-faulted one
+    assert int(out.it) == 12
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref_out.u))
+
+
+def test_sdc_persistent_corruption_exhausts_retries():
+    solver = _diffusion2d()
+    state = solver.initial_state()
+    from multigpu_advectiondiffusion_tpu.resilience import SDCDetectedError
+
+    with faults.sdc_at_step(solver, 2, once=False):
+        with pytest.raises(SDCDetectedError):
+            supervise_run(
+                solver, state, iters=12, sentinel_every=2, sdc_every=1,
+                max_retries=2,
+            )
+
+
+def test_sdc_needs_sentinel_cadence():
+    solver = _diffusion2d()
+    with pytest.raises(ValueError, match="sentinel"):
+        supervise_run(
+            solver, solver.initial_state(), iters=4, sdc_every=1,
+        )
+
+
+# --------------------------------------------------------------------- #
+# Watchdog + timeout-wrapped collectives (in-process unit coverage)
+# --------------------------------------------------------------------- #
+def _dead_pid() -> int:
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid
+
+
+def test_watchdog_detects_dead_peer(tmp_path):
+    failures = []
+    wd = multihost.RankWatchdog(
+        str(tmp_path), timeout_seconds=5.0, interval_seconds=0.05,
+        rank=0, num_processes=2, on_failure=failures.append,
+    )
+    wd.start()
+    try:
+        multihost.write_heartbeat(str(tmp_path), 1, pid=_dead_pid())
+        deadline = time.time() + 5
+        while not failures and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert failures, "dead peer never detected"
+    err = failures[0]
+    assert isinstance(err, RankFailureError)
+    assert err.rank == 1
+    assert "dead" in err.reason
+    assert wd.failure is err
+
+
+def test_watchdog_detects_stale_heartbeat(tmp_path):
+    failures = []
+    wd = multihost.RankWatchdog(
+        str(tmp_path), timeout_seconds=0.4, interval_seconds=0.05,
+        rank=0, num_processes=2, on_failure=failures.append,
+    )
+    wd.start()
+    try:
+        # alive pid (our own) but a heartbeat that will never refresh
+        multihost.write_heartbeat(str(tmp_path), 1, pid=os.getpid())
+        deadline = time.time() + 5
+        while not failures and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert failures and failures[0].rank == 1
+    assert "stale" in failures[0].reason
+
+
+def test_watchdog_ignores_previous_incarnation_records(tmp_path):
+    """A restart reusing the save dir must not insta-fail on the dead
+    previous run's heartbeat corpses — only records written after this
+    watchdog started count as evidence."""
+    multihost.write_heartbeat(
+        str(tmp_path), 1, pid=_dead_pid(), wall=time.time() - 300.0
+    )
+    failures = []
+    wd = multihost.RankWatchdog(
+        str(tmp_path), timeout_seconds=10.0, interval_seconds=0.05,
+        rank=0, num_processes=2, on_failure=failures.append,
+    )
+    wd.start()
+    try:
+        time.sleep(0.4)
+        assert not failures
+        # a fresh record from the (restarted) peer replaces the corpse
+        multihost.write_heartbeat(str(tmp_path), 1, pid=os.getpid())
+        time.sleep(0.3)
+        assert not failures
+    finally:
+        wd.stop()
+
+
+def test_collective_timeout_raises_rank_failure():
+    with pytest.raises(RankFailureError, match="did not complete"):
+        multihost.call_with_timeout(
+            lambda: time.sleep(5.0), 0.2, "unit-collective"
+        )
+    # fast path: value passes through, exceptions re-raise
+    assert multihost.call_with_timeout(lambda: 7, 0.5, "ok") == 7
+    with pytest.raises(ZeroDivisionError):
+        multihost.call_with_timeout(lambda: 1 // 0, 0.5, "err")
+
+
+def test_agree_single_process_and_desync(monkeypatch):
+    # single process: agreement is trivially the proposed vector
+    np.testing.assert_array_equal(
+        multihost.agree("t", [3.0, 4.0]), np.asarray([3.0, 4.0])
+    )
+    # forge a 2-rank world where the peers disagree
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(multihost.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: np.stack([arr, arr + 1.0]),
+    )
+    with pytest.raises(CoordinationError, match="agreement"):
+        multihost.agree("rollback", [3.0])
+    # and one where they agree
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda arr: np.stack([arr, arr]),
+    )
+    np.testing.assert_array_equal(
+        multihost.agree("rollback", [3.0, 1.0]), np.asarray([3.0, 1.0])
+    )
+
+
+def test_watchdog_scope_classifies_generic_error(tmp_path):
+    """A generic exception (gloo 'connection reset') raised while a
+    peer is down must surface as the structured RankFailureError, with
+    the forensics report written."""
+    wd = multihost.RankWatchdog(
+        str(tmp_path / "hb"), timeout_seconds=30.0, interval_seconds=0.05,
+        rank=0, num_processes=2, on_failure=lambda e: None,
+        report_dir=str(tmp_path),
+    )
+    with pytest.raises(RankFailureError) as ei:
+        with multihost.watchdog_scope(wd):
+            multihost.write_heartbeat(str(tmp_path / "hb"), 1,
+                                      pid=_dead_pid())
+            raise RuntimeError("connection reset by peer")
+    assert ei.value.rank == 1
+    assert multihost.current_watchdog() is None  # uninstalled on exit
+    report = json.loads((tmp_path / "rank_failure_p0.json").read_text())
+    assert report["failed_rank"] == 1
+
+
+# --------------------------------------------------------------------- #
+# Crash-path telemetry flush (satellite): the JSONL tail survives an
+# uncaught structured error — the post-mortem evidence
+# --------------------------------------------------------------------- #
+def test_crash_event_flushed_on_uncaught_error(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    code = (
+        "import os, sys;"
+        "os.environ['JAX_PLATFORMS']='cpu';"
+        f"sys.path.insert(0, {REPO!r});"
+        "from multigpu_advectiondiffusion_tpu import telemetry;"
+        "from multigpu_advectiondiffusion_tpu.resilience.errors import "
+        "SolverDivergedError;"
+        f"telemetry.install({path!r});"
+        "telemetry.event('resilience', 'sentinel_armed', cadence=5);"
+        "raise SolverDivergedError(7, 0.5, 123.0)"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode != 0
+    events = [
+        json.loads(line)
+        for line in open(path).read().splitlines()
+    ]
+    assert events[-1]["kind"] == "crash"
+    assert events[-1]["name"] == "SolverDivergedError"
+    assert "diverged" in events[-1]["message"]
+    # the pre-crash tail survived too
+    assert any(
+        e["kind"] == "resilience" and e["name"] == "sentinel_armed"
+        for e in events
+    )

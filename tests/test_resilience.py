@@ -346,6 +346,50 @@ def test_ckptd_missing_shard_lists_absent_offsets(devices, tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# Supervisor restart determinism (ISSUE 5 satellite): rollback-retry
+# with dt backoff is REPRODUCIBLE — a second supervised run resumed
+# from the same checkpoint with the same flags replays the identical
+# retry ledger and lands on the bit-identical final state.
+# --------------------------------------------------------------------- #
+def _fused_diffusion3d():
+    # the grid test_mosaic_* proves engages the fused rung
+    grid = Grid.make(24, 16, 16, lengths=[4.0, 4.0, 6.0])
+    return DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas")
+    )
+
+
+def test_supervised_restart_determinism_fused_f32(tmp_path):
+    seed = _fused_diffusion3d()
+    assert seed.engaged_path()["stepper"].startswith("fused")
+    pre = seed.run(seed.initial_state(), 6)
+    ckpt = str(tmp_path / "c.ckpt")
+    io_utils.save_checkpoint(ckpt, pre)
+
+    def resumed_supervised_run():
+        solver = _fused_diffusion3d()
+        state = io_utils.load_checkpoint(ckpt)
+        state = type(state)(
+            u=jnp.asarray(state.u, solver.dtype), t=state.t, it=state.it
+        )
+        with faults.nan_at_step(solver, 10):
+            return supervise_run(
+                solver, state, iters=12, sentinel_every=2,
+                max_retries=3, dt_backoff=0.5,
+            )
+
+    out_a, rep_a = resumed_supervised_run()
+    out_b, rep_b = resumed_supervised_run()
+    assert rep_a.retries == rep_b.retries == 1
+    assert rep_a.events == rep_b.events  # identical retry ledger
+    assert "dt" in rep_a.events[0]["action"]
+    assert int(out_a.it) == int(out_b.it) == 18
+    np.testing.assert_array_equal(  # f32 bit-exact on the fused rung
+        np.asarray(out_a.u), np.asarray(out_b.u)
+    )
+
+
+# --------------------------------------------------------------------- #
 # Preemption (acceptance d)
 # --------------------------------------------------------------------- #
 def test_preemption_guard_latches_signal():
