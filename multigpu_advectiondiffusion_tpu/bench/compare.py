@@ -1,0 +1,267 @@
+"""Bench-round regression gate: diff a fresh bench JSON against a
+prior round with per-row noise thresholds; nonzero exit on regression.
+
+The bench trajectory (BENCH_r01 -> r05, driver-captured) records a
+rate per metric plus its OWN measurement-quality evidence (median-of-
+reps ``spread``, discarded-stall ``outliers``). This module is the
+consumer that was missing: the trend-tracking discipline of HipBone
+(PAPERS: arXiv 2202.12477 — every optimization claim is a measured
+delta against the previous round) as an executable gate instead of a
+human eyeballing JSON.
+
+Input formats (auto-detected): the ``bench.py`` / ``bench/matrix.py``
+JSON-lines artifacts (one row per line, ``metric``+``value`` or
+``name``+``mlups``), a JSON list of such rows, or the driver's wrapper
+object whose ``tail`` embeds the JSONL (the BENCH_r0*.json layout; a
+truncated first line is skipped, not fatal).
+
+Threshold per row: ``max(rel_tol, spread_factor * max(spread_old,
+spread_new))`` — a noisy row must move by more than its own observed
+dispersion before the gate calls it a regression. Usage::
+
+    python -m multigpu_advectiondiffusion_tpu.bench.compare NEW OLD
+    python -m multigpu_advectiondiffusion_tpu.bench.compare NEW --floors
+
+``--floors`` checks each row's ``vs_baseline`` against the BASELINE.md
+floor (>= 1.0) instead of a prior round. Wrappers: ``out/bench_gate.sh``
+(newest BENCH_r0*.json + injected-slowdown self-test) and
+``bench/matrix.py --compare PRIOR``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+DEFAULT_REL_TOL = 0.05
+DEFAULT_SPREAD_FACTOR = 2.0
+
+
+def parse_rows(text: str) -> List[dict]:
+    """JSON-lines -> row dicts; unparseable lines (the truncated head
+    of a driver ``tail``) are skipped."""
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            rows.append(obj)
+    return rows
+
+
+def row_key(row: dict) -> Optional[str]:
+    return row.get("metric") or row.get("name")
+
+
+def row_value(row: dict) -> Optional[float]:
+    v = row.get("value", row.get("mlups"))
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def row_spread(row: dict) -> float:
+    try:
+        return float(row.get("spread") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def load_rows(path: str) -> Dict[str, dict]:
+    """A bench artifact -> ``{metric: row}``, whatever the container."""
+    with open(path) as f:
+        text = f.read()
+    rows: List[dict] = []
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, list):
+        rows = [r for r in obj if isinstance(r, dict)]
+    elif isinstance(obj, dict) and isinstance(obj.get("tail"), str):
+        rows = parse_rows(obj["tail"])  # driver wrapper (BENCH_r0*.json)
+    elif isinstance(obj, dict) and isinstance(obj.get("rows"), list):
+        rows = [r for r in obj["rows"] if isinstance(r, dict)]
+    elif isinstance(obj, dict) and row_key(obj):
+        rows = [obj]
+    else:
+        rows = parse_rows(text)
+    out: Dict[str, dict] = {}
+    for row in rows:
+        key = row_key(row)
+        if key and row_value(row) is not None:
+            out[key] = row  # later rows win (tail may repeat a metric)
+    return out
+
+
+@dataclasses.dataclass
+class RowResult:
+    metric: str
+    status: str  # ok | regression | improved | added | missing
+    new: Optional[float] = None
+    old: Optional[float] = None
+    ratio: Optional[float] = None
+    threshold: Optional[float] = None
+
+    def line(self) -> str:
+        if self.status in ("added", "missing"):
+            return f"  {self.status.upper():>10}  {self.metric}"
+        arrow = {"regression": "REGRESSION", "improved": "improved",
+                 "ok": "ok"}[self.status]
+        return (
+            f"  {arrow:>10}  {self.metric}: {self.old:.2f} -> "
+            f"{self.new:.2f}  ({100 * (self.ratio - 1):+.1f}%, "
+            f"threshold ±{100 * self.threshold:.1f}%)"
+        )
+
+
+@dataclasses.dataclass
+class CompareResult:
+    rows: List[RowResult]
+
+    @property
+    def regressions(self) -> List[RowResult]:
+        return [r for r in self.rows
+                if r.status in ("regression", "missing")]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "rows": [dataclasses.asdict(r) for r in self.rows],
+        }
+
+    def format_text(self) -> str:
+        lines = ["bench compare:"]
+        lines += [r.line() for r in self.rows]
+        n_reg = len(self.regressions)
+        lines.append(
+            "bench compare: PASS"
+            if self.ok
+            else f"bench compare: FAIL ({n_reg} regression(s))"
+        )
+        return "\n".join(lines)
+
+
+def compare(
+    new_rows: Dict[str, dict],
+    old_rows: Dict[str, dict],
+    rel_tol: float = DEFAULT_REL_TOL,
+    spread_factor: float = DEFAULT_SPREAD_FACTOR,
+) -> CompareResult:
+    """Per-metric diff of two rounds. A metric present in the old round
+    but absent from the new one is a ``missing`` failure (a silently
+    dropped benchmark is a regression in coverage); a new metric is
+    reported as ``added`` and never fails."""
+    results: List[RowResult] = []
+    for key in sorted(set(old_rows) | set(new_rows)):
+        old = old_rows.get(key)
+        new = new_rows.get(key)
+        if old is None:
+            results.append(RowResult(key, "added",
+                                     new=row_value(new)))
+            continue
+        if new is None:
+            results.append(RowResult(key, "missing",
+                                     old=row_value(old)))
+            continue
+        ov, nv = row_value(old), row_value(new)
+        threshold = max(
+            rel_tol,
+            spread_factor * max(row_spread(old), row_spread(new)),
+        )
+        ratio = nv / ov if ov else float("inf")
+        if ratio < 1.0 - threshold:
+            status = "regression"
+        elif ratio > 1.0 + threshold:
+            status = "improved"
+        else:
+            status = "ok"
+        results.append(RowResult(key, status, new=nv, old=ov,
+                                 ratio=round(ratio, 4),
+                                 threshold=round(threshold, 4)))
+    return CompareResult(results)
+
+
+def check_floors(new_rows: Dict[str, dict],
+                 floor: float = 1.0) -> CompareResult:
+    """BASELINE.md-floor mode: every row carrying a ``vs_baseline``
+    ratio must sit at or above ``floor`` (the reference's own published
+    rate). Rows without the field are skipped — not every metric has a
+    published baseline."""
+    results = []
+    for key in sorted(new_rows):
+        row = new_rows[key]
+        vs = row.get("vs_baseline")
+        if vs is None:
+            continue
+        vs = float(vs)
+        status = "ok" if vs >= floor else "regression"
+        results.append(RowResult(key, status, new=vs, old=floor,
+                                 ratio=round(vs / floor, 4),
+                                 threshold=0.0))
+    return CompareResult(results)
+
+
+def main(argv=None) -> None:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="multigpu_advectiondiffusion_tpu.bench.compare",
+        description="bench-round regression gate (nonzero exit on "
+                    "regression)",
+    )
+    ap.add_argument("new", help="fresh bench artifact (JSONL rows or "
+                                "driver wrapper JSON)")
+    ap.add_argument("old", nargs="?", default=None,
+                    help="prior round to diff against (e.g. the newest "
+                         "BENCH_r0*.json)")
+    ap.add_argument("--floors", action="store_true",
+                    help="check vs_baseline >= 1 (BASELINE.md floors) "
+                         "instead of a prior round")
+    ap.add_argument("--rel-tol", type=float, default=DEFAULT_REL_TOL,
+                    help="minimum relative threshold per row "
+                         f"(default {DEFAULT_REL_TOL})")
+    ap.add_argument("--spread-factor", type=float,
+                    default=DEFAULT_SPREAD_FACTOR,
+                    help="multiple of a row's own measured spread the "
+                         "threshold grows to on noisy rows "
+                         f"(default {DEFAULT_SPREAD_FACTOR})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable result on stdout")
+    args = ap.parse_args(argv)
+
+    if args.floors == (args.old is not None):
+        ap.error("provide exactly one of: a prior round, or --floors")
+    new_rows = load_rows(args.new)
+    if not new_rows:
+        raise SystemExit(f"no bench rows found in {args.new}")
+    if args.floors:
+        result = check_floors(new_rows)
+    else:
+        old_rows = load_rows(args.old)
+        if not old_rows:
+            raise SystemExit(f"no bench rows found in {args.old}")
+        result = compare(new_rows, old_rows, rel_tol=args.rel_tol,
+                         spread_factor=args.spread_factor)
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        print(result.format_text())
+    if not result.ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

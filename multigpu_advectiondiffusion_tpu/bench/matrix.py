@@ -256,6 +256,11 @@ def main(argv=None):
     ap.add_argument("--mesh", default=None, help="e.g. dz=4")
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--out", default=None, help="write JSON lines here")
+    ap.add_argument("--compare", default=None, metavar="PRIOR",
+                    help="regression gate: after the run, diff the "
+                         "produced rows against a prior round's "
+                         "artifact (bench/compare.py thresholds) and "
+                         "exit nonzero on any regression")
     ap.add_argument("--tuning-cache", default=None, metavar="PATH",
                     help="tuning decision cache for the impl='auto' "
                          "multichip rows (default: $TPUCFD_TUNING_CACHE "
@@ -292,6 +297,27 @@ def main(argv=None):
     if args.out:
         with open(args.out, "w") as f:
             f.write("\n".join(lines) + "\n")
+    if args.compare:
+        # measured regression gate: this run's rows against the prior
+        # round, per-row noise thresholds, loud nonzero exit
+        from multigpu_advectiondiffusion_tpu.bench import compare as cmp
+
+        new_rows = {}
+        for line in lines:
+            row = json.loads(line)
+            key = cmp.row_key(row)
+            if key and cmp.row_value(row) is not None:
+                new_rows[key] = row
+        # --name may have subsetted the cases: gate only what ran (the
+        # full-round coverage check lives in out/bench_gate.sh)
+        old_rows = {
+            k: v for k, v in cmp.load_rows(args.compare).items()
+            if k in new_rows
+        }
+        result = cmp.compare(new_rows, old_rows)
+        print(result.format_text(), flush=True)
+        if not result.ok:
+            raise SystemExit(1)
 
 
 if __name__ == "__main__":

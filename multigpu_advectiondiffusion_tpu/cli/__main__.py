@@ -145,7 +145,22 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "(min/max/L2/mass drift, supervised runs), "
                         "resilience events (rollbacks, retries, "
                         "preemption), checkpoint writes — see README "
-                        "'Observability' for the event schema")
+                        "'Observability' for the event schema; analyze "
+                        "or merge streams with the 'trace' subcommand")
+    p.add_argument("--metrics-max-bytes", type=int, default=0,
+                   metavar="N",
+                   help="size-capped rotation for the --metrics stream: "
+                        "when the file exceeds N bytes it rotates to "
+                        "PATH.1 (previous rotation dropped) and a "
+                        "sink:rotate event opens the fresh tail — "
+                        "long supervised runs keep the newest ~2N "
+                        "bytes of evidence (0 = unbounded)")
+    p.add_argument("--progress", action="store_true",
+                   help="live terminal status line at the supervised "
+                        "chunk cadence (step, rate, MLUPS, ETA, mass "
+                        "drift, outliers) rendered from the "
+                        "supervisor's progress events; needs "
+                        "--sentinel-every > 0")
     p.add_argument("--impl", default="xla",
                    choices=["xla", "pallas", "pallas_axis", "pallas_step",
                             "pallas_slab", "pallas_stage", "auto"],
@@ -254,7 +269,9 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       dt_backoff=args.dt_backoff,
                       watchdog_timeout=args.watchdog_timeout,
                       sdc_every=args.sdc_every,
-                      metrics_path=getattr(args, "metrics", None))
+                      progress=args.progress,
+                      metrics_path=getattr(args, "metrics", None),
+                      metrics_max_bytes=args.metrics_max_bytes)
 
 
 def _run_burgers(args, ndim):
@@ -299,7 +316,9 @@ def _run_burgers(args, ndim):
                       dt_backoff=args.dt_backoff,
                       watchdog_timeout=args.watchdog_timeout,
                       sdc_every=args.sdc_every,
-                      metrics_path=getattr(args, "metrics", None))
+                      progress=args.progress,
+                      metrics_path=getattr(args, "metrics", None),
+                      metrics_max_bytes=args.metrics_max_bytes)
 
 
 def _run_convergence(args):
@@ -427,6 +446,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "TestAccuracy.fig/.log")
     p.set_defaults(fn=_run_convergence)
 
+    # tpucfd-trace: the consumable layer over --metrics streams (also
+    # runnable standalone: python -m multigpu_advectiondiffusion_tpu.cli.trace)
+    from multigpu_advectiondiffusion_tpu.cli import trace as trace_cli
+
+    p = sub.add_parser("trace",
+                       help="analyze/merge --metrics JSONL streams "
+                            "(tpucfd-trace): cross-rank clock-aligned "
+                            "merge, phase breakdown, measured-vs-"
+                            "roofline per rung, critical path, "
+                            "Chrome/Perfetto trace_event export")
+    trace_cli.configure_parser(p)
+
     return ap
 
 
@@ -444,7 +475,10 @@ def main(argv=None):
     if getattr(args, "metrics", None):
         from multigpu_advectiondiffusion_tpu import telemetry
 
-        owned_sink = telemetry.install(args.metrics)
+        owned_sink = telemetry.install(
+            args.metrics,
+            max_bytes=getattr(args, "metrics_max_bytes", 0),
+        )
     if getattr(args, "tune", False) or getattr(args, "tuning_cache", None):
         # tuner surface: --tune allows measurement on a cache miss,
         # --tuning-cache points both lookup and persistence at PATH
@@ -478,7 +512,7 @@ def main(argv=None):
             num_processes=args.num_processes,
             process_id=args.process_id,
         )
-    if args.dtype == "float64":
+    if getattr(args, "dtype", None) == "float64":
         import jax
 
         jax.config.update("jax_enable_x64", True)

@@ -102,6 +102,7 @@ def run_solver(
     name: str,
     *args,
     metrics_path: Optional[str] = None,
+    metrics_max_bytes: int = 0,
     watchdog_timeout: float = 0.0,
     **kwargs,
 ) -> RunSummary:
@@ -142,7 +143,8 @@ def run_solver(
 
     with contextlib.ExitStack() as scope:
         if metrics_path and not telemetry.get_sink().active:
-            sink = telemetry.install(metrics_path)
+            sink = telemetry.install(metrics_path,
+                                     max_bytes=metrics_max_bytes)
             scope.callback(telemetry.uninstall, sink)
         if watchdog is not None:
             # after the sink install, so direct run_solver(metrics_path=
@@ -182,6 +184,7 @@ def _run_solver(
     max_retries: int = 3,
     dt_backoff: float = 0.5,
     sdc_every: int = 0,
+    progress: bool = False,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
@@ -349,6 +352,11 @@ def _run_solver(
             "--sdc-every rides the sentinel cadence; it needs "
             "--sentinel-every > 0"
         )
+    if progress and not supervised:
+        raise ValueError(
+            "--progress renders the supervisor's chunk-cadence events; "
+            "it needs --sentinel-every > 0"
+        )
     if (periodic or (supervised and checkpoint_every)) and not save_dir:
         raise ValueError("snapshot/checkpoint output needs save_dir")
 
@@ -408,21 +416,38 @@ def _run_solver(
                 _write_checkpoint(st)
                 io_acc[0] += time.perf_counter() - io_t0
 
+            # --progress: the coordinator renders the supervisor's
+            # chunk-cadence progress events as one status line (other
+            # ranks still emit the events into their own streams)
+            progress_line = None
+            if progress and is_coord:
+                from multigpu_advectiondiffusion_tpu.telemetry.live import (
+                    ProgressLine,
+                )
+
+                progress_line = ProgressLine(label=name)
             t0 = time.perf_counter()
-            out, sup_report = supervise_run(
-                solver,
-                state,
-                iters=iters,
-                t_end=t_end,
-                sentinel_every=sentinel_every,
-                growth=sentinel_growth,
-                max_retries=max_retries,
-                dt_backoff=dt_backoff,
-                checkpoint_every=checkpoint_every,
-                save_checkpoint=save_ckpt if checkpoint_every else None,
-                should_stop=lambda: guard.should_stop,
-                sdc_every=sdc_every,
-            )
+            try:
+                out, sup_report = supervise_run(
+                    solver,
+                    state,
+                    iters=iters,
+                    t_end=t_end,
+                    sentinel_every=sentinel_every,
+                    growth=sentinel_growth,
+                    max_retries=max_retries,
+                    dt_backoff=dt_backoff,
+                    checkpoint_every=checkpoint_every,
+                    save_checkpoint=save_ckpt if checkpoint_every else None,
+                    should_stop=lambda: guard.should_stop,
+                    sdc_every=sdc_every,
+                    progress=(
+                        progress_line.update if progress_line else None
+                    ),
+                )
+            finally:
+                if progress_line is not None:
+                    progress_line.close()
             sync(out.u)
             io_s = io_acc[0] if checkpoint_every else None
             best = time.perf_counter() - t0 - (io_s or 0.0)

@@ -1,0 +1,105 @@
+"""``tpucfd-trace``: offline analysis of ``--metrics`` JSONL streams.
+
+The consumable layer over the telemetry subsystem — where the reference
+opened one ``nvprof`` file per rank in the Visual Profiler by hand
+(``profile.sh``), this merges every rank's stream onto one aligned
+timeline and answers the questions a person (or the future scheduler
+daemon) actually asks of a run:
+
+* where did the wall clock go? (compile vs step vs checkpoint I/O vs
+  rollback re-execution vs modeled halo time, per rank);
+* how close did each run land to its cost-model roofline?
+* which rank (and which span chain) bounded the run — the cross-rank
+  critical path and end skew;
+* which steps stalled (``perf:outlier`` record)?
+
+Usage (also a ``trace`` subcommand of the main CLI)::
+
+    python -m multigpu_advectiondiffusion_tpu.cli.trace \
+        out/run/events_p0.jsonl out/run/events_p1.jsonl \
+        --export out/run/trace.json         # open at ui.perfetto.dev
+
+    python -m multigpu_advectiondiffusion_tpu.cli trace out/run/ --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def configure_parser(ap: argparse.ArgumentParser) -> None:
+    """Arguments shared by the standalone prog and the CLI subcommand."""
+    ap.add_argument("streams", nargs="+", metavar="STREAM",
+                    help="one or more --metrics JSONL files (rotated "
+                         ".1 segments ride along automatically), or a "
+                         "directory containing them — one file per "
+                         "process of a multi-rank run")
+    ap.add_argument("--export", default=None, metavar="PATH",
+                    help="write the merged, clock-aligned trace as "
+                         "Chrome trace_event JSON — opens directly at "
+                         "ui.perfetto.dev / chrome://tracing")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report (JSON) "
+                         "instead of the text block")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the JSON report to PATH")
+    ap.set_defaults(fn=run)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpucfd-trace",
+        description="merge + analyze per-process telemetry streams "
+                    "(clock-aligned cross-rank trace, phase breakdown, "
+                    "measured-vs-roofline, critical path, Perfetto "
+                    "export)",
+    )
+    configure_parser(ap)
+    return ap
+
+
+def run(args) -> None:
+    """Execute an analysis request (the argparse-facing driver)."""
+    from multigpu_advectiondiffusion_tpu.telemetry.analyze import (
+        analyze,
+        align_clocks,
+        load_streams,
+    )
+
+    try:
+        streams = load_streams(args.streams)
+    except FileNotFoundError as err:
+        raise SystemExit(str(err))
+    align_clocks(streams)
+
+    if args.export:
+        from multigpu_advectiondiffusion_tpu.telemetry.export import (
+            write_chrome_trace,
+        )
+
+        obj = write_chrome_trace(args.export, streams)
+        print(
+            f"wrote {len(obj['traceEvents'])} trace events to "
+            f"{args.export} (open at https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+
+    report = analyze(args.streams)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report.to_dict(), f, indent=2)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.format_text())
+
+
+def main(argv: Optional[list] = None) -> None:
+    run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -345,6 +345,13 @@ def barrier(tag: str, timeout_seconds: Optional[float] = None) -> None:
         timeout_seconds,
         f"barrier:{tag}",
     )
+    # sync anchor: every rank emits this immediately after the SAME
+    # barrier released — the trace analyzer's clock-alignment points
+    # (telemetry/analyze.py align_clocks), alongside dist_init:ok and
+    # resilience:agree
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    telemetry.event("sync", "barrier", tag=tag)
 
 
 def agree(tag: str, values, timeout_seconds: Optional[float] = None):

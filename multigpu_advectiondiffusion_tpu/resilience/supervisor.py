@@ -12,6 +12,7 @@ host-side copy of the last known-good state for rollback.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, List, Optional
 
 from multigpu_advectiondiffusion_tpu import telemetry
@@ -49,6 +50,10 @@ class SupervisorReport:
     # min/max/L2/mass scalars — the drift line in RunSummary.print_block
     mass_drift: Optional[float] = None
     physics: Optional[dict] = None
+    # step-time record of the live watch (telemetry/live.py): chunk
+    # count, robust median, outliers, histogram — the wall-clock health
+    # the resilience stack otherwise only sees after a failure
+    perf: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -90,6 +95,7 @@ def supervise_run(
     should_stop: Optional[Callable[[], bool]] = None,
     sdc_every: int = 0,
     coordinated: Optional[bool] = None,
+    progress: Optional[Callable[[dict], None]] = None,
 ):
     """Run to ``iters`` steps or simulated time ``t_end`` under
     supervision; returns ``(final_state, SupervisorReport)``.
@@ -121,6 +127,18 @@ def supervise_run(
     time step is not the problem), so a recovered run reproduces the
     un-faulted trajectory bit-for-bit.
 
+    Every completed chunk emits a ``progress`` telemetry event (step
+    rate, MLUPS, ETA, last mass drift) and feeds the rolling step-time
+    watch (:mod:`telemetry.live`): a chunk whose per-step wall time
+    breaches the robust median+MAD threshold emits ``perf:outlier`` —
+    the live fingerprint of preemption stalls, SDC re-execution and
+    thermal jitter. ``progress`` (a callable) additionally receives
+    each event's fields — the CLI's ``--progress`` status line. The
+    final step-time histogram lands in ``report.perf`` and as one
+    ``perf:histogram`` event. Chunk wall time is host-observed between
+    chunk boundaries; checkpoint-write seconds are excluded (the probe
+    is not — it is part of the cadence being watched).
+
     ``coordinated`` (default: auto — on whenever ``jax.process_count()
     > 1``) makes every rollback and checkpoint decision an explicit
     cross-rank agreement (:func:`parallel.multihost.agree`): all ranks
@@ -146,6 +164,71 @@ def supervise_run(
         sdc_every=int(sdc_every),
         coordinated=coordinate,
     )
+
+    from multigpu_advectiondiffusion_tpu.telemetry.live import (
+        StepTimeWatch,
+        emit_histogram,
+    )
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+
+    watch = StepTimeWatch()
+    _cells = getattr(solver.grid, "num_cells", 0)
+    _stages = STAGES.get(getattr(solver.cfg, "integrator", ""), 3)
+    # per-chunk checkpoint-write seconds, excluded from the watched
+    # chunk time (disk latency is not step-time jitter)
+    _chunk_io = [0.0]
+
+    def _progress(nxt, chunk_steps: int, chunk_seconds: float) -> None:
+        chunk_seconds -= _chunk_io[0]
+        _chunk_io[0] = 0.0
+        if chunk_steps <= 0 or chunk_seconds <= 0:
+            return
+        watch.observe(chunk_steps, chunk_seconds, step=int(nxt.it))
+        per_step = watch.median() or (chunk_seconds / chunk_steps)
+        steps_done = int(nxt.it) - start_it
+        if iters is not None:
+            eta = max(0, int(iters) - steps_done) * per_step
+        else:
+            # t_end mode: remaining simulated time over the measured
+            # per-step pace (dt from this chunk's actual advance)
+            dt_chunk = (float(nxt.t) - t_prev[0]) / chunk_steps
+            eta = (
+                max(0.0, float(t_end) - float(nxt.t)) / dt_chunk * per_step
+                if dt_chunk > 0 else None
+            )
+        t_prev[0] = float(nxt.t)
+        fields = {
+            "step": int(nxt.it),
+            "steps_done": steps_done,
+            "steps_total": int(iters) if iters is not None else None,
+            "time": float(nxt.t),
+            "t_end": float(t_end) if t_end is not None else None,
+            "step_seconds": round(chunk_seconds / chunk_steps, 6),
+            "rate_steps_per_s": round(chunk_steps / chunk_seconds, 3),
+            "mlups": (
+                round(_cells * _stages * chunk_steps
+                      / chunk_seconds / 1e6, 3)
+                if _cells else None
+            ),
+            "eta_seconds": round(eta, 3) if eta is not None else None,
+            "mass_drift": report.mass_drift,
+            "retries": report.retries,
+            "outliers": watch.outliers,
+        }
+        telemetry.event("progress", "chunk", **fields)
+        if progress is not None:
+            p = dict(fields)
+            p["t"] = p.pop("time")  # the sink reserves "t" for itself
+            progress(p)
+
+    t_prev = [float(state.t)]
+
+    def _finish(final_state):
+        if watch.chunks:
+            report.perf = emit_histogram(watch)
+        return final_state, report
 
     def _agree(tag: str, *values):
         """Assert every rank proposes the same decision (no-op in
@@ -218,7 +301,9 @@ def supervise_run(
             # checkpoint iteration before any shard byte is written
             _agree("checkpoint", int(nxt.it))
             if save_checkpoint is not None:
+                io_t0 = time.monotonic()
                 save_checkpoint(nxt)
+                _chunk_io[0] += time.monotonic() - io_t0
             last_ckpt_it = int(nxt.it)
             last_good = nxt
         elif sentinel is not None and probe_due and not checkpoint_every:
@@ -284,6 +369,8 @@ def supervise_run(
                 )
                 break
             n = min(chunk, target_it - int(state.it))
+            prev_it = int(state.it)
+            c0 = time.monotonic()
             try:
                 nxt = solver.run(state, n)
                 done = int(nxt.it) - start_it
@@ -291,9 +378,13 @@ def supervise_run(
                     done % sentinel_every == 0 or int(nxt.it) >= target_it
                 )
                 state = _after_chunk(nxt, probe_due=probe_due)
+                _progress(
+                    nxt, int(nxt.it) - prev_it, time.monotonic() - c0
+                )
             except SolverDivergedError as err:
                 state = _recover(err)
-        return state, report
+                _chunk_io[0] = 0.0
+        return _finish(state)
 
     import jax.numpy as jnp
 
@@ -332,12 +423,15 @@ def supervise_run(
             tk = min(float(state.t) + sentinel_every * float(dt_est), te)
         else:
             tk = te
+        prev_it = int(state.it)
+        c0 = time.monotonic()
         try:
             nxt = solver.advance_to(state, tk)
             steps = int(nxt.it) - int(state.it)
             if steps > 0:
                 dt_est = (float(nxt.t) - float(state.t)) / steps
             state = _after_chunk(nxt, probe_due=bool(sentinel_every))
+            _progress(nxt, int(nxt.it) - prev_it, time.monotonic() - c0)
             if steps == 0 and tk >= te:
                 # the device loop can no longer advance toward te (the
                 # remainder is below the time dtype's resolution): done
@@ -345,4 +439,5 @@ def supervise_run(
         except SolverDivergedError as err:
             state = _recover(err)
             dt_est = getattr(solver, "dt", None)
-    return state, report
+            _chunk_io[0] = 0.0
+    return _finish(state)

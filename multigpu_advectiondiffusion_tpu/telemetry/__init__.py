@@ -8,7 +8,15 @@ into (the upgrade of the reference's ``nvprof`` + hand-read
   counters, domain events), installed via the CLI ``--metrics PATH``
   flag or :func:`capture`;
 * :mod:`costmodel` — HBM bytes / FLOPs per step for every stepper rung,
-  turning measured seconds into a roofline-efficiency percentage.
+  turning measured seconds into a roofline-efficiency percentage;
+* :mod:`analyze` / :mod:`export` — the consumable layer: merge
+  per-rank streams onto one aligned timeline, phase breakdown,
+  critical path, Chrome/Perfetto ``trace_event`` export (CLI:
+  ``tpucfd-trace`` / ``python -m ... cli trace``);
+* :mod:`live` — chunk-cadence step-time watch (``perf:outlier``
+  events) and the ``--progress`` terminal status line;
+* :mod:`schema` — the event-kind registry tier-1 tests hold every
+  emission site (and README's event table) against.
 """
 
 from multigpu_advectiondiffusion_tpu.telemetry.sink import (  # noqa: F401
@@ -25,8 +33,14 @@ from multigpu_advectiondiffusion_tpu.telemetry.sink import (  # noqa: F401
     uninstall,
 )
 from multigpu_advectiondiffusion_tpu.telemetry import costmodel  # noqa: F401
+from multigpu_advectiondiffusion_tpu.telemetry import schema  # noqa: F401
+
+# analyze/export/live are imported lazily by their consumers (the trace
+# CLI, the supervisor) — keeping the package import light for the hot
+# instrumentation path.
 
 __all__ = [
+    "schema",
     "EVENT_SCHEMA",
     "NULL_SINK",
     "NullSink",

@@ -1,0 +1,593 @@
+"""Trace analysis: merge per-process JSONL telemetry streams into one
+cross-rank view of a run.
+
+The sink (:mod:`telemetry.sink`) writes each process's events against
+its OWN monotonic clock (``t`` = seconds since that process's sink
+opened) — the per-rank ``nvprof`` output files of the reference's
+``profile.sh``, machine-readable. This module is the merge/analysis
+layer the reference never had:
+
+* :func:`load_streams` reads one or many per-process JSONL files
+  (rotated ``.1`` predecessors included), tolerating truncated tails —
+  a crashed rank's stream is evidence, not a parse error;
+* :func:`align_clocks` maps every stream onto one global timeline:
+  coarse alignment from the ``meta:open`` wall-clock epoch, then a
+  median-of-anchors refinement over events that are *synchronization
+  points by construction* — ``dist_init:ok`` (every rank returns from
+  the distributed join together), ``sync:barrier``, and
+  ``resilience:agree`` (an allgather completes everywhere at the last
+  arrival);
+* :func:`build_spans` reconstructs each process's span forest from the
+  ``begin``/``end`` pairs (explicit ``id``/``parent`` links — no stack
+  guessing), keeping still-open spans from crashed runs;
+* :func:`analyze` produces a :class:`TraceReport`: per-phase wall-clock
+  breakdown (compile vs step vs halo vs checkpoint vs rollback), every
+  run's measured throughput against the static cost-model roofline,
+  the cross-rank critical path, and the step-time outlier record.
+
+The Perfetto exporter (:mod:`telemetry.export`) consumes the same
+aligned streams; ``tpucfd-trace`` (cli/trace.py) is the front end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import statistics
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Span names the drivers emit for actual solve work (models/base.py
+# _dispatch_span); the first such span per process is the untimed
+# compile + warm-up call of cli/drivers.py.
+SOLVE_SPAN_PREFIX = "solver."
+
+
+# --------------------------------------------------------------------- #
+# Loading
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass
+class Stream:
+    """One process's event stream (possibly reassembled from a rotated
+    pair), plus its alignment onto the merged timeline."""
+
+    path: str
+    events: List[dict]
+    proc: int
+    # wall-clock epoch of this stream's monotonic t=0 (from meta:open /
+    # sink:rotate wall_time); None when the stream carries no epoch
+    epoch: Optional[float]
+    # seconds added to a local ``t`` to place it on the global timeline
+    offset: float = 0.0
+    skipped_lines: int = 0
+
+    def gt(self, ev: dict) -> float:
+        """Global (aligned) time of one of this stream's events."""
+        return self.offset + float(ev.get("t", 0.0))
+
+    @property
+    def t_last(self) -> float:
+        return max((float(e.get("t", 0.0)) for e in self.events),
+                   default=0.0)
+
+
+def _parse_lines(text: str) -> Tuple[List[dict], int]:
+    events, skipped = [], 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            skipped += 1  # torn tail of a crashed rank: keep going
+            continue
+        if isinstance(ev, dict) and "kind" in ev:
+            events.append(ev)
+        else:
+            skipped += 1
+    return events, skipped
+
+
+def load_stream(path: str, include_rotated: bool = True) -> Stream:
+    """One JSONL file -> :class:`Stream`. When the sink's size-capped
+    rotation left a ``<path>.1`` predecessor, its events are prepended
+    (same monotonic clock — rotation never resets ``t``)."""
+    texts = []
+    prev = path + ".1"
+    if include_rotated and os.path.exists(prev):
+        with open(prev) as f:
+            texts.append(f.read())
+    with open(path) as f:
+        texts.append(f.read())
+    events: List[dict] = []
+    skipped = 0
+    for text in texts:
+        evs, sk = _parse_lines(text)
+        events.extend(evs)
+        skipped += sk
+    events.sort(key=lambda e: float(e.get("t", 0.0)))
+    procs = [int(e.get("proc", 0)) for e in events]
+    proc = max(set(procs), key=procs.count) if procs else 0
+    epoch = None
+    for ev in events:
+        # meta:open (fresh sink) and sink:rotate (tail-only file after a
+        # rotation) both record wall_time at a known local t
+        if ev.get("wall_time") is not None and (
+            (ev["kind"], ev["name"]) in (("meta", "open"), ("sink", "rotate"))
+        ):
+            epoch = float(ev["wall_time"]) - float(ev.get("t", 0.0))
+            break
+    return Stream(path=path, events=events, proc=proc, epoch=epoch,
+                  skipped_lines=skipped)
+
+
+def load_streams(paths: Sequence[str]) -> List[Stream]:
+    """Expand files/directories into Streams, one per JSONL file.
+    Directories contribute every ``*.jsonl`` inside (rotated ``.1``
+    files ride along with their owner, never as separate streams)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no telemetry streams under {list(paths)!r}")
+    return [load_stream(f) for f in files]
+
+
+# --------------------------------------------------------------------- #
+# Clock alignment
+# --------------------------------------------------------------------- #
+def _anchor_key(ev: dict) -> Optional[tuple]:
+    """Key identifying a cross-rank synchronization event family; the
+    k-th occurrence of a family on one rank matches the k-th on every
+    other (all are emitted immediately after a completed collective)."""
+    kind, name = ev.get("kind"), ev.get("name")
+    if kind == "dist_init" and name == "ok":
+        return ("dist_init", "ok")
+    if kind == "sync" and name == "barrier":
+        return ("sync", ev.get("tag"))
+    if kind == "resilience" and name == "agree":
+        return ("agree", ev.get("tag"))
+    return None
+
+
+def _anchors(stream: Stream) -> Dict[tuple, List[float]]:
+    out: Dict[tuple, List[float]] = {}
+    for ev in stream.events:
+        key = _anchor_key(ev)
+        if key is not None:
+            out.setdefault(key, []).append(stream.gt(ev))
+    return out
+
+
+def align_clocks(streams: List[Stream]) -> dict:
+    """Place every stream on one timeline (mutates ``stream.offset``).
+
+    Coarse pass: offsets from each stream's wall-clock epoch (exact when
+    all ranks share a host clock, NTP-close otherwise). Refinement:
+    match sync-anchor families across ranks and shift each stream by
+    the median anchor disagreement against the reference stream (lowest
+    process index), so collective-completion events coincide. Returns
+    alignment diagnostics (matched anchor counts, applied corrections,
+    worst post-correction residual)."""
+    if not streams:
+        return {"streams": 0}
+    epochs = [s.epoch for s in streams if s.epoch is not None]
+    wall0 = min(epochs) if epochs else 0.0
+    for s in streams:
+        s.offset = (s.epoch - wall0) if s.epoch is not None else 0.0
+    ref = min(streams, key=lambda s: (s.proc, s.path))
+    ref_anchors = _anchors(ref)
+    corrections: Dict[str, float] = {}
+    matched: Dict[str, int] = {}
+    residual = 0.0
+    for s in streams:
+        if s is ref:
+            continue
+        deltas = []
+        for key, times in _anchors(s).items():
+            for t_ref, t_s in zip(ref_anchors.get(key, ()), times):
+                deltas.append(t_ref - t_s)
+        if not deltas:
+            matched[f"proc{s.proc}"] = 0
+            continue
+        corr = statistics.median(deltas)
+        s.offset += corr
+        corrections[f"proc{s.proc}"] = round(corr, 6)
+        matched[f"proc{s.proc}"] = len(deltas)
+        residual = max(
+            residual, max(abs(d - corr) for d in deltas)
+        )
+    return {
+        "streams": len(streams),
+        "reference_proc": ref.proc,
+        "matched_anchors": matched,
+        "corrections_s": corrections,
+        "max_residual_s": round(residual, 6),
+    }
+
+
+def merged_events(streams: List[Stream]) -> List[dict]:
+    """All events on the aligned timeline, each annotated with ``gt``
+    (global seconds) — the cross-rank interleaving, sorted."""
+    out = []
+    for s in streams:
+        for ev in s.events:
+            e = dict(ev)
+            e["gt"] = round(s.gt(ev), 6)
+            e["proc"] = s.proc if "proc" not in ev else ev["proc"]
+            out.append(e)
+    out.sort(key=lambda e: e["gt"])
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Span forest
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass
+class Span:
+    name: str
+    proc: int
+    sid: int
+    parent: Optional[int]
+    t0: float  # local stream time
+    t1: Optional[float]  # None while open (crash evidence)
+    fields: dict
+    children: List["Span"] = dataclasses.field(default_factory=list)
+
+    def seconds(self, t_last: float = 0.0) -> float:
+        end = self.t1 if self.t1 is not None else max(t_last, self.t0)
+        return max(0.0, end - self.t0)
+
+    @property
+    def open(self) -> bool:
+        return self.t1 is None
+
+
+_SPAN_META = {"t", "proc", "kind", "name", "phase", "id", "parent",
+              "depth", "seconds"}
+
+
+def build_spans(stream: Stream) -> List[Span]:
+    """Reconstruct the span forest from begin/end pairs (explicit
+    id/parent links). Returns the roots; spans whose end never arrived
+    (a crashed/killed rank) stay open."""
+    by_id: Dict[int, Span] = {}
+    roots: List[Span] = []
+    for ev in stream.events:
+        if ev.get("kind") != "span":
+            continue
+        if ev.get("phase") == "begin":
+            span = Span(
+                name=ev.get("name", "?"),
+                proc=stream.proc,
+                sid=int(ev.get("id", -1)),
+                parent=ev.get("parent"),
+                t0=float(ev.get("t", 0.0)),
+                t1=None,
+                fields={k: v for k, v in ev.items()
+                        if k not in _SPAN_META},
+            )
+            by_id[span.sid] = span
+            parent = by_id.get(span.parent) if span.parent else None
+            (parent.children if parent else roots).append(span)
+        elif ev.get("phase") == "end":
+            span = by_id.get(int(ev.get("id", -1)))
+            if span is not None:
+                span.t1 = float(ev.get("t", 0.0))
+    return roots
+
+
+def _walk(spans: List[Span]):
+    for s in spans:
+        yield s
+        yield from _walk(s.children)
+
+
+# --------------------------------------------------------------------- #
+# Phase breakdown
+# --------------------------------------------------------------------- #
+def phase_breakdown(stream: Stream) -> dict:
+    """Wall-clock accounting of one process's run: compile+warm-up (the
+    first ``solver.*`` span — cli/drivers.py's untimed warm call), the
+    solve itself (the remaining ``solver.*`` spans), checkpoint/file
+    I/O (``io`` events' own ``seconds``), rollback re-execution (steps
+    re-covered after each ``resilience:rollback``, priced at the
+    measured per-step rate), and modeled halo-exchange time (traced
+    per-execution bytes through the cost model's latency/bandwidth
+    terms — modeled, not measured: the exchange runs inside the
+    compiled program)."""
+    roots = build_spans(stream)
+    t_last = stream.t_last
+    solve = [s for s in _walk(roots)
+             if s.name.startswith(SOLVE_SPAN_PREFIX)]
+    solve.sort(key=lambda s: s.t0)
+    compile_s = solve[0].seconds(t_last) if solve else 0.0
+    step_s = sum(s.seconds(t_last) for s in solve[1:])
+    root = next((s for s in roots if s.name == "run_solver"), None)
+    total_s = root.seconds(t_last) if root else t_last
+
+    io_s = 0.0
+    rollbacks = 0
+    re_steps = 0
+    steps_seen = 0
+    chunk_step_times = []
+    halo_bytes_per_exec = 0
+    halo_sites = 0
+    for ev in stream.events:
+        kind, name = ev.get("kind"), ev.get("name")
+        if kind == "io" and ev.get("seconds") is not None:
+            io_s += float(ev["seconds"])
+        elif kind == "resilience" and name == "rollback":
+            rollbacks += 1
+            re_steps += max(
+                0, int(ev.get("step", 0)) - int(ev.get("rollback_to_it", 0))
+            )
+        elif kind == "progress" and name == "chunk":
+            if ev.get("step_seconds"):
+                chunk_step_times.append(float(ev["step_seconds"]))
+            steps_seen = max(steps_seen, int(ev.get("step", 0)))
+        elif kind == "physics" and name == "probe":
+            steps_seen = max(steps_seen, int(ev.get("step", 0)))
+        elif kind == "counter" and name == "halo.bytes_per_execution":
+            halo_bytes_per_exec = max(
+                halo_bytes_per_exec, int(ev.get("total", 0))
+            )
+        elif kind == "counter" and name == "halo.exchanges_traced":
+            halo_sites = max(halo_sites, int(ev.get("total", 0)))
+
+    per_step = statistics.median(chunk_step_times) if chunk_step_times \
+        else None
+    rollback_s = (re_steps * per_step) if per_step is not None else None
+    halo_model_s = None
+    if halo_bytes_per_exec and steps_seen:
+        from multigpu_advectiondiffusion_tpu.telemetry import costmodel
+
+        # per-execution bytes x executions (~steps) through the same
+        # latency+bandwidth model the tuner prunes with
+        halo_model_s = costmodel.halo_exchange_seconds(
+            float(halo_bytes_per_exec) * steps_seen,
+            messages=max(1, halo_sites) * steps_seen,
+        )
+    accounted = compile_s + step_s + io_s
+    return {
+        "proc": stream.proc,
+        "total_s": round(total_s, 6),
+        "compile_s": round(compile_s, 6),
+        "step_s": round(step_s, 6),
+        "checkpoint_io_s": round(io_s, 6),
+        "rollbacks": rollbacks,
+        "rollback_steps_reexecuted": re_steps,
+        "rollback_s_est": (
+            round(rollback_s, 6) if rollback_s is not None else None
+        ),
+        "halo_model_s": (
+            round(halo_model_s, 6) if halo_model_s is not None else None
+        ),
+        "other_s": round(max(0.0, total_s - accounted), 6),
+        "open_spans": sum(1 for s in _walk(roots) if s.open),
+    }
+
+
+# --------------------------------------------------------------------- #
+# Throughput vs roofline, critical path, outliers
+# --------------------------------------------------------------------- #
+def rung_throughput(streams: List[Stream]) -> List[dict]:
+    """One row per ``summary`` event: the run's measured rate next to
+    the static cost-model roofline of the rung that produced it."""
+    rows = []
+    for s in streams:
+        for ev in s.events:
+            if ev.get("kind") != "summary":
+                continue
+            rows.append({
+                "proc": s.proc,
+                "run": ev.get("name"),
+                "stepper": ev.get("stepper"),
+                "seconds": ev.get("seconds"),
+                "mlups": ev.get("mlups"),
+                "roofline_pct": ev.get("roofline_pct"),
+                "mass_drift": ev.get("mass_drift"),
+            })
+    return rows
+
+
+def critical_path(streams: List[Stream]) -> dict:
+    """The chain of spans that bounds the merged run's wall clock: the
+    rank whose root span ends last on the aligned timeline, descended
+    through its longest children. Also reports every rank's root extent
+    so cross-rank skew (stragglers) is visible at a glance."""
+    per_proc = []
+    bounding = None
+    bounding_end = -1.0
+    for s in streams:
+        roots = build_spans(s)
+        root = next((sp for sp in roots if sp.name == "run_solver"),
+                    roots[0] if roots else None)
+        if root is None:
+            continue
+        end = s.offset + (
+            root.t1 if root.t1 is not None else s.t_last
+        )
+        per_proc.append({
+            "proc": s.proc,
+            "root": root.name,
+            "begin_s": round(s.offset + root.t0, 6),
+            "end_s": round(end, 6),
+            "seconds": round(root.seconds(s.t_last), 6),
+            "open": root.open,
+        })
+        if end > bounding_end:
+            bounding_end = end
+            bounding = (s, root)
+    chain = []
+    if bounding is not None:
+        s, span = bounding
+        while span is not None:
+            chain.append({
+                "proc": s.proc,
+                "name": span.name,
+                "seconds": round(span.seconds(s.t_last), 6),
+                "stepper": span.fields.get("stepper"),
+            })
+            span = max(
+                span.children,
+                key=lambda c: c.seconds(s.t_last),
+                default=None,
+            )
+    skew = 0.0
+    if len(per_proc) > 1:
+        ends = [p["end_s"] for p in per_proc]
+        skew = max(ends) - min(ends)
+    return {
+        "ranks": sorted(per_proc, key=lambda p: p["proc"]),
+        "critical_rank": bounding[0].proc if bounding else None,
+        "chain": chain,
+        "end_skew_s": round(skew, 6),
+    }
+
+
+def perf_events(streams: List[Stream]) -> dict:
+    """Step-time outlier record: every ``perf:outlier`` the live watch
+    emitted, plus the final ``perf:histogram`` per process."""
+    outliers = []
+    histograms = {}
+    for s in streams:
+        for ev in s.events:
+            if ev.get("kind") != "perf":
+                continue
+            if ev.get("name") == "outlier":
+                outliers.append({
+                    "proc": s.proc,
+                    "gt": round(s.gt(ev), 6),
+                    "step": ev.get("step"),
+                    "step_seconds": ev.get("step_seconds"),
+                    "threshold": ev.get("threshold"),
+                    "median": ev.get("median"),
+                })
+            elif ev.get("name") == "histogram":
+                histograms[f"proc{s.proc}"] = {
+                    k: ev.get(k)
+                    for k in ("edges", "counts", "chunks",
+                              "median_step_s", "outliers")
+                }
+    return {"outliers": outliers, "histograms": histograms}
+
+
+# --------------------------------------------------------------------- #
+# The report
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass
+class TraceReport:
+    streams: List[dict]
+    alignment: dict
+    phases: List[dict]
+    rungs: List[dict]
+    critical_path: dict
+    perf: dict
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format_text(self) -> str:
+        lines = []
+        add = lines.append
+        add("=" * 68)
+        add(" tpucfd-trace: merged run analysis")
+        add("=" * 68)
+        for s in self.streams:
+            note = (f", {s['skipped_lines']} unparseable line(s) skipped"
+                    if s["skipped_lines"] else "")
+            add(f" proc {s['proc']}: {s['path']} "
+                f"({s['events']} events{note})")
+        al = self.alignment
+        if al.get("streams", 0) > 1:
+            add(f" clock alignment    : ref proc {al['reference_proc']}, "
+                f"anchors {al['matched_anchors']}, "
+                f"corrections {al['corrections_s']} s, "
+                f"residual {al['max_residual_s']} s")
+        add("-" * 68)
+        add(" phase breakdown (wall seconds per rank)")
+        hdr = (f"   {'proc':>4} {'total':>9} {'compile':>9} {'step':>9} "
+               f"{'ckpt io':>9} {'rollback':>9} {'halo~':>9} {'other':>9}")
+        add(hdr)
+        for p in self.phases:
+            rb = p["rollback_s_est"]
+            halo = p["halo_model_s"]
+            add(
+                f"   {p['proc']:>4} {p['total_s']:>9.3f} "
+                f"{p['compile_s']:>9.3f} {p['step_s']:>9.3f} "
+                f"{p['checkpoint_io_s']:>9.3f} "
+                f"{(f'{rb:.3f}' if rb is not None else '-'):>9} "
+                f"{(f'{halo:.3f}' if halo is not None else '-'):>9} "
+                f"{p['other_s']:>9.3f}"
+            )
+            if p["rollbacks"]:
+                add(f"        proc {p['proc']}: {p['rollbacks']} "
+                    f"rollback(s), {p['rollback_steps_reexecuted']} "
+                    "step(s) re-executed")
+            if p["open_spans"]:
+                add(f"        proc {p['proc']}: {p['open_spans']} span(s) "
+                    "never closed (crashed/killed rank?)")
+        add("   (compile = first solver call incl. warm-up; halo~ = "
+            "modeled from traced bytes, runs inside the compiled step)")
+        if self.rungs:
+            add("-" * 68)
+            add(" measured throughput vs cost-model roofline")
+            add(f"   {'run':<24} {'stepper':<22} {'MLUPS':>9} "
+                f"{'roofline':>9}")
+            for r in self.rungs:
+                roof = r.get("roofline_pct")
+                add(
+                    f"   {str(r['run']):<24} {str(r['stepper']):<22} "
+                    f"{(r['mlups'] if r['mlups'] is not None else 0):>9} "
+                    f"{(f'{roof:.1f}%' if roof is not None else '-'):>9}"
+                )
+        cp = self.critical_path
+        if cp.get("ranks"):
+            add("-" * 68)
+            add(f" critical path (rank {cp['critical_rank']}; "
+                f"cross-rank end skew {cp['end_skew_s']} s)")
+            for i, hop in enumerate(cp["chain"]):
+                extra = (f" [{hop['stepper']}]" if hop.get("stepper")
+                         else "")
+                add(f"   {'  ' * i}{hop['name']}{extra}: "
+                    f"{hop['seconds']:.3f} s (proc {hop['proc']})")
+        if self.perf.get("outliers"):
+            add("-" * 68)
+            add(f" step-time outliers ({len(self.perf['outliers'])})")
+            for o in self.perf["outliers"][:20]:
+                add(f"   proc {o['proc']} step {o['step']}: "
+                    f"{o['step_seconds']:.4f} s/step "
+                    f"(median {o['median']:.4f}, "
+                    f"threshold {o['threshold']:.4f})")
+        add("=" * 68)
+        return "\n".join(lines)
+
+
+def analyze(paths: Sequence[str]) -> TraceReport:
+    """Load, align and analyze one or many per-process streams."""
+    streams = load_streams(paths)
+    alignment = align_clocks(streams)
+    return TraceReport(
+        streams=[
+            {
+                "path": s.path,
+                "proc": s.proc,
+                "events": len(s.events),
+                "offset_s": round(s.offset, 6),
+                "skipped_lines": s.skipped_lines,
+            }
+            for s in streams
+        ],
+        alignment=alignment,
+        phases=[phase_breakdown(s)
+                for s in sorted(streams, key=lambda s: s.proc)],
+        rungs=rung_throughput(streams),
+        critical_path=critical_path(streams),
+        perf=perf_events(streams),
+    )

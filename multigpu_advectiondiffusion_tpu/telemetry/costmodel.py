@@ -314,6 +314,42 @@ def summarize_run(
     return out
 
 
+def solver_memory_cross_check(solver, state,
+                              stepper: Optional[str] = None) -> Optional[dict]:
+    """Cross-check the static model against XLA's OWN memory accounting
+    for one compiled step of ``solver`` (tests/test_telemetry.py holds
+    the two within documented bounds — the tier-1 promotion of the
+    dormant :func:`xla_memory_analysis` hook).
+
+    Returns ``None`` where the backend exposes no accounting; otherwise
+    a dict with the model's :class:`StepCost`, XLA's byte attributes,
+    the single-field byte size, and ``min_traffic_bytes`` — the
+    argument+output footprint the compiled step cannot avoid moving,
+    which the model must never undercut."""
+    cost = solver_step_cost(
+        solver, stepper or solver.engaged_path()["stepper"]
+    )
+    if cost is None:
+        return None
+    mem = xla_memory_analysis(solver.step, state)
+    if mem is None:
+        return None
+    import numpy as np
+
+    field_bytes = math.prod(solver.grid.shape) * np.dtype(
+        solver.dtype
+    ).itemsize
+    return {
+        "model": cost.to_dict(),
+        "xla": mem,
+        "field_bytes": int(field_bytes),
+        "min_traffic_bytes": int(
+            mem.get("argument_size_in_bytes", 0)
+            + mem.get("output_size_in_bytes", 0)
+        ),
+    }
+
+
 def xla_memory_analysis(fn, *args) -> Optional[dict]:
     """Cross-check hook: lower+compile ``fn(*args)`` and read XLA's own
     ``memory_analysis()`` where the backend provides one (TPU does;

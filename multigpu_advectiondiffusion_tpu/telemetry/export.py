@@ -1,0 +1,170 @@
+"""Chrome/Perfetto ``trace_event`` export of merged telemetry streams.
+
+Converts the aligned per-process JSONL streams
+(:mod:`telemetry.analyze`) into the Trace Event Format JSON that
+``ui.perfetto.dev`` and ``chrome://tracing`` open directly — the
+upgrade of hand-reading per-rank ``nvprof`` files in the Visual
+Profiler (reference ``profile.sh``): one merged timeline where every
+rank's spans, rollbacks, probes and counters sit on a shared clock.
+
+Mapping (one JSON object per event, ``ts``/``dur`` in microseconds):
+
+* span begin/end pairs  -> complete events (``ph="X"``) on the
+  process's ``spans`` track; spans that never closed (killed rank)
+  export as lone ``ph="B"`` begins — visible crash evidence;
+* counters              -> ``ph="C"`` counter tracks (running total);
+* every other kind      -> ``ph="i"`` instants on the ``events`` track,
+  full payload in ``args``;
+* per-stream metadata   -> ``ph="M"`` ``process_name``/``thread_name``
+  records (``rank<K>``).
+
+:func:`validate_trace` is the schema gate tests (and the exporter
+itself) run over the produced object — export never silently emits a
+file Perfetto would reject.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+from multigpu_advectiondiffusion_tpu.telemetry.analyze import (
+    Stream,
+    _walk,
+    build_spans,
+)
+
+TID_SPANS = 1
+TID_EVENTS = 2
+
+_PH = {"X", "B", "E", "i", "C", "M"}
+
+
+def _us(seconds: float) -> float:
+    return round(seconds * 1e6, 3)
+
+
+def to_chrome_trace(streams: Sequence[Stream]) -> dict:
+    """Aligned streams -> Trace Event Format object (JSON-ready)."""
+    events: List[dict] = []
+    for s in streams:
+        pid = int(s.proc)
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"rank{pid}"},
+        })
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid,
+            "tid": TID_SPANS, "args": {"name": "spans"},
+        })
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid,
+            "tid": TID_EVENTS, "args": {"name": "events"},
+        })
+        for span in _walk(build_spans(s)):
+            base = {
+                "name": span.name,
+                "cat": "span",
+                "pid": pid,
+                "tid": TID_SPANS,
+                "ts": _us(s.offset + span.t0),
+                "args": dict(span.fields),
+            }
+            if span.t1 is None:
+                base["ph"] = "B"  # never closed: crash evidence
+            else:
+                base["ph"] = "X"
+                base["dur"] = _us(span.t1 - span.t0)
+            events.append(base)
+        for ev in s.events:
+            kind, name = ev.get("kind"), ev.get("name")
+            ts = _us(s.gt(ev))
+            if kind == "span":
+                continue  # handled above
+            if kind == "counter":
+                events.append({
+                    "ph": "C",
+                    "name": name,
+                    "cat": "counter",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": ts,
+                    "args": {"value": ev.get("total", 0)},
+                })
+                continue
+            args = {k: v for k, v in ev.items()
+                    if k not in ("t", "proc", "kind", "name")}
+            events.append({
+                "ph": "i",
+                "s": "t",
+                "name": f"{kind}:{name}",
+                "cat": kind,
+                "pid": pid,
+                "tid": TID_EVENTS,
+                "ts": ts,
+                "args": args,
+            })
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "tpucfd-trace",
+            "streams": [s.path for s in streams],
+        },
+    }
+
+
+def validate_trace(obj) -> List[str]:
+    """Schema problems in a trace_event object (empty list = valid):
+    the structural contract Perfetto's JSON importer requires."""
+    problems: List[str] = []
+    if not isinstance(obj, dict) or not isinstance(
+        obj.get("traceEvents"), list
+    ):
+        return ["top level must be a dict with a traceEvents list"]
+    for i, ev in enumerate(obj["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PH:
+            problems.append(f"{where}: bad ph {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str):
+            problems.append(f"{where}: missing name")
+        if ph != "M":
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"{where}: missing numeric ts")
+            if not isinstance(ev.get("pid"), int):
+                problems.append(f"{where}: missing integer pid")
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            problems.append(f"{where}: X event missing dur")
+        if ph == "X" and isinstance(ev.get("dur"), (int, float)) \
+                and ev["dur"] < 0:
+            problems.append(f"{where}: negative dur")
+        if ph == "C" and not isinstance(ev.get("args"), dict):
+            problems.append(f"{where}: C event missing args")
+        if "args" in ev:
+            try:
+                json.dumps(ev["args"])
+            except (TypeError, ValueError):
+                problems.append(f"{where}: args not JSON-serializable")
+    return problems
+
+
+def write_chrome_trace(path: str, streams: Sequence[Stream]) -> dict:
+    """Export ``streams`` to ``path`` as validated trace_event JSON;
+    raises ``ValueError`` (listing the problems) rather than writing a
+    file Perfetto would reject. Returns the exported object."""
+    obj = to_chrome_trace(streams)
+    problems = validate_trace(obj)
+    if problems:
+        raise ValueError(
+            "refusing to write invalid trace_event JSON: "
+            + "; ".join(problems[:5])
+        )
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return obj

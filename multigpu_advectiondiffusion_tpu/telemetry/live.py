@@ -1,0 +1,222 @@
+"""Live run monitoring: rolling step-time statistics with outlier
+detection, and the ``--progress`` terminal status line.
+
+The resilience stack reacts to failures *after* they surface (a NaN, a
+dead rank, an SDC mismatch); this layer watches the one signal that
+precedes most of them — wall time per step. A preemption stall, an SDC
+re-execution, thermal throttling or a wedged peer all show up first as
+a step that took too long. :class:`StepTimeWatch` keeps a rolling
+per-step-time window at the supervisor's chunk cadence and emits a
+``perf:outlier`` event the moment a chunk's per-step time exceeds a
+robust (median + k·MAD) threshold — the observability hook a future
+scheduler daemon subscribes to.
+
+:class:`ProgressLine` renders the supervisor's ``progress`` events as a
+single updating terminal line (step, rate, MLUPS, ETA, mass drift) —
+``--progress`` on the CLI. On a TTY it redraws in place; piped into a
+log it prints at a bounded cadence so logs stay readable.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+import sys
+import time
+from typing import Optional
+
+from multigpu_advectiondiffusion_tpu import telemetry
+
+# Robust-threshold parameters: a chunk is an outlier when its per-step
+# time exceeds median + MAD_FACTOR * 1.4826 * MAD AND at least
+# REL_FLOOR x the median (the second guard keeps near-zero-MAD runs —
+# bit-identical chunk times — from flagging 1-ulp jitter).
+MAD_FACTOR = 5.0
+REL_FLOOR = 1.5
+_MAD_TO_SIGMA = 1.4826
+
+
+class StepTimeWatch:
+    """Rolling per-step wall-time histogram + robust outlier detection,
+    fed once per supervisor chunk with (steps, seconds)."""
+
+    def __init__(
+        self,
+        window: int = 256,
+        min_samples: int = 8,
+        mad_factor: float = MAD_FACTOR,
+        rel_floor: float = REL_FLOOR,
+    ):
+        self._window = collections.deque(maxlen=window)
+        self._all = collections.deque(maxlen=4096)  # histogram evidence
+        self.min_samples = int(min_samples)
+        self.mad_factor = float(mad_factor)
+        self.rel_floor = float(rel_floor)
+        self.chunks = 0
+        self.outliers = 0
+
+    # ------------------------------------------------------------------ #
+    def threshold(self) -> Optional[float]:
+        """Current outlier bound (None until enough samples)."""
+        if len(self._window) < self.min_samples:
+            return None
+        med = statistics.median(self._window)
+        mad = statistics.median(
+            abs(x - med) for x in self._window
+        )
+        return max(
+            med + self.mad_factor * _MAD_TO_SIGMA * mad,
+            self.rel_floor * med,
+        )
+
+    def median(self) -> Optional[float]:
+        if not self._window:
+            return None
+        return statistics.median(self._window)
+
+    def observe(self, steps: int, seconds: float, step: int = 0) -> bool:
+        """Record one chunk (``steps`` advanced in ``seconds`` of wall
+        time). Returns True — and emits a ``perf:outlier`` event — when
+        the chunk's per-step time breaches the robust threshold.
+        Outlier chunks do NOT enter the rolling window (a stall must
+        not drag the baseline up and mask the next one)."""
+        if steps <= 0 or seconds < 0:
+            return False
+        per_step = seconds / steps
+        bound = self.threshold()
+        self.chunks += 1
+        if bound is not None and per_step > bound:
+            self.outliers += 1
+            self._all.append(per_step)
+            telemetry.event(
+                "perf", "outlier",
+                step=int(step),
+                step_seconds=round(per_step, 6),
+                median=round(self.median() or 0.0, 6),
+                threshold=round(bound, 6),
+            )
+            return True
+        self._window.append(per_step)
+        self._all.append(per_step)
+        return False
+
+    # ------------------------------------------------------------------ #
+    def histogram(self) -> dict:
+        """Step-time histogram over the retained samples: fixed
+        relative-to-median bucket edges, so a bimodal run (healthy
+        steps + stall band) is visible at a glance."""
+        med = (
+            statistics.median(self._all) if self._all else 0.0
+        )
+        rel_edges = [0.5, 0.8, 0.95, 1.05, 1.25, 1.5, 2.0, 4.0]
+        edges = [round(r * med, 6) for r in rel_edges]
+        counts = [0] * (len(edges) + 1)
+        for x in self._all:
+            i = 0
+            while i < len(edges) and x > edges[i]:
+                i += 1
+            counts[i] += 1
+        return {"edges": edges, "counts": counts}
+
+    def summary(self) -> dict:
+        """Final record (also emitted as a ``perf:histogram`` event by
+        the supervisor): chunk count, robust center/scale, outliers,
+        histogram."""
+        med = self.median()
+        out = {
+            "chunks": self.chunks,
+            "outliers": self.outliers,
+            "median_step_s": round(med, 6) if med is not None else None,
+        }
+        out.update(self.histogram())
+        return out
+
+
+def emit_histogram(watch: StepTimeWatch) -> dict:
+    """Emit the final ``perf:histogram`` event for a finished run and
+    return the summary dict (lands in ``SupervisorReport.perf``)."""
+    summary = watch.summary()
+    telemetry.event("perf", "histogram", **summary)
+    return summary
+
+
+def _fmt_eta(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "--"
+    seconds = max(0.0, seconds)
+    if seconds < 90:
+        return f"{seconds:.0f}s"
+    if seconds < 5400:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+class ProgressLine:
+    """Terminal renderer for the supervisor's ``progress`` events.
+
+    On a TTY the line redraws in place (carriage return); otherwise
+    each update prints as a full line at most every ``log_interval``
+    seconds (and always on :meth:`close`), so redirected logs keep a
+    readable cadence instead of megabytes of ``\\r`` frames."""
+
+    def __init__(self, label: str = "", out=None,
+                 log_interval: float = 2.0):
+        self.label = label
+        self.out = out if out is not None else sys.stderr
+        self.log_interval = float(log_interval)
+        self._tty = bool(getattr(self.out, "isatty", lambda: False)())
+        self._last_render = 0.0
+        self._last_fields: Optional[dict] = None
+        self._width = 0
+
+    def _format(self, p: dict) -> str:
+        step = p.get("step")
+        total = p.get("steps_total")
+        bits = [self.label or "run"]
+        if total:
+            done = p.get("steps_done", 0)
+            pct = 100.0 * done / total if total else 0.0
+            bits.append(f"step {step} ({pct:.0f}%)")
+        else:
+            bits.append(f"step {step}")
+            if p.get("t") is not None and p.get("t_end") is not None:
+                bits.append(f"t={p['t']:.4g}/{p['t_end']:.4g}")
+        if p.get("rate_steps_per_s"):
+            bits.append(f"{p['rate_steps_per_s']:.1f} steps/s")
+        if p.get("mlups"):
+            bits.append(f"{p['mlups']:.4g} MLUPS")
+        bits.append(f"ETA {_fmt_eta(p.get('eta_seconds'))}")
+        if p.get("mass_drift") is not None:
+            bits.append(f"drift {p['mass_drift']:+.2e}")
+        if p.get("retries"):
+            bits.append(f"retries {p['retries']}")
+        if p.get("outliers"):
+            bits.append(f"outliers {p['outliers']}")
+        return " | ".join(bits)
+
+    def update(self, p: dict) -> None:
+        self._last_fields = p
+        now = time.monotonic()
+        if self._tty:
+            line = self._format(p)
+            pad = max(0, self._width - len(line))
+            self.out.write("\r" + line + " " * pad)
+            self.out.flush()
+            self._width = len(line)
+            self._last_render = now
+        elif now - self._last_render >= self.log_interval:
+            self.out.write(self._format(p) + "\n")
+            self.out.flush()
+            self._last_render = now
+
+    def close(self) -> None:
+        """Final render (the last update always lands) + newline."""
+        if self._last_fields is not None:
+            line = self._format(self._last_fields)
+            if self._tty:
+                pad = max(0, self._width - len(line))
+                self.out.write("\r" + line + " " * pad + "\n")
+            else:
+                self.out.write(line + "\n")
+            self.out.flush()
+        self._last_fields = None

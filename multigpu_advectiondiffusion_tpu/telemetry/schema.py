@@ -1,0 +1,159 @@
+"""Event-schema registry: the single source of truth for what the
+telemetry stream may contain.
+
+Every event kind (and, where the set is closed, every event name) the
+instrumented layers emit is registered here with its required fields.
+Two consumers:
+
+* :func:`validate_event` — structural validation of a live/loaded
+  event (the analyzer and tests run it over real streams);
+* :func:`scan_emitted` — a *static* scan of the package source for
+  ``telemetry.event(<kind>, <name>, ...)`` / ``sink.event(...)`` /
+  ``.counter(<name>, ...)`` call sites, so a tier-1 test
+  (tests/test_schema.py) fails the moment someone emits a kind or name
+  this registry (or README's event table) does not know — the guard
+  against silent schema drift.
+
+``None`` as a name key is the wildcard: the kind carries open-ended
+names (``summary`` events are named after the run, ``crash`` events
+after the exception type, ``tune`` names arrive via a variable).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+# kind -> name -> required fields (beyond the sink's own t/proc/kind/
+# name envelope). name None = wildcard for that kind.
+EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
+    "meta": {"open": {"schema", "wall_time"}},
+    "sink": {"rotate": {"schema", "wall_time", "previous",
+                        "rotated_bytes"}},
+    "span": {None: {"phase", "id", "depth"}},
+    "counter": {None: {"inc", "total"}},
+    "dispatch": {"build": {"key", "impl"}},
+    "ladder": {"degrade": {"from", "to", "reason"}},
+    "physics": {"probe": {"step", "time"}},
+    "resilience": {
+        "sentinel_armed": {"cadence", "growth"},
+        "rollback": {"retry", "step", "rollback_to_it", "action"},
+        "retries_exhausted": {"step", "retries"},
+        "preempt": {"step"},
+        "agree": {"tag", "values"},
+        "elastic_resume": {"checkpoint", "saved_processes", "processes"},
+    },
+    "rank": {
+        "watchdog_armed": {"timeout", "interval", "processes"},
+        "failure": {"reason", "exit_code"},
+    },
+    "sdc": {"detect": {"step", "mismatched_cells"}},
+    "io": {
+        "checkpoint_write": {"path", "bytes", "seconds"},
+        "binary_write": {"path", "bytes", "seconds"},
+    },
+    "dist_init": {
+        "attempt": {"attempt", "attempts"},
+        "retry": {"attempt", "backoff_seconds"},
+        "ok": {"attempt"},
+        "failed": {"attempts", "error"},
+    },
+    "sync": {"barrier": {"tag"}},
+    "tune": {
+        "lookup": set(),
+        "candidates": set(),
+        "measure": set(),
+        "decision": set(),
+        "fallback": set(),
+        None: set(),
+    },
+    "progress": {"chunk": {"step", "steps_done", "step_seconds"}},
+    "perf": {
+        "outlier": {"step", "step_seconds", "median", "threshold"},
+        "histogram": {"edges", "counts", "chunks"},
+    },
+    "summary": {None: {"seconds", "mlups"}},
+    "crash": {None: {"message"}},
+}
+
+
+def validate_event(ev: dict) -> List[str]:
+    """Structural problems with one event dict (empty list = valid)."""
+    problems = []
+    for key in ("t", "proc", "kind", "name"):
+        if key not in ev:
+            problems.append(f"missing envelope field {key!r}")
+    kind = ev.get("kind")
+    if kind not in EVENT_REGISTRY:
+        problems.append(f"unregistered kind {kind!r}")
+        return problems
+    names = EVENT_REGISTRY[kind]
+    name = ev.get("name")
+    if name in names:
+        required = names[name]
+    elif None in names:
+        required = names[None]
+    else:
+        problems.append(f"unregistered name {name!r} for kind {kind!r}")
+        return problems
+    for field in required:
+        if field not in ev:
+            problems.append(f"{kind}:{name} missing field {field!r}")
+    return problems
+
+
+# Counter names the instrumented layers emit (halo.py).
+COUNTER_NAMES: Set[str] = {
+    "halo.exchanges_traced",
+    "halo.bytes_per_execution",
+}
+
+# `.event("kind"[, "name"]` on any sink-ish receiver. DOTALL-free: \s*
+# already spans newlines between the arguments.
+_EVENT_RE = re.compile(
+    r"""\.event\(\s*
+        ["']([a-z_]+)["']\s*,\s*        # literal kind
+        (?:["']([\w:.-]+)["'])?         # literal name (absent if dynamic)
+    """,
+    re.VERBOSE,
+)
+_COUNTER_RE = re.compile(r"""\.counter\(\s*["']([\w.]+)["']""", re.VERBOSE)
+
+
+def scan_emitted(
+    root: Optional[str] = None,
+) -> Tuple[Set[Tuple[str, Optional[str]]], Set[str]]:
+    """Statically scan the package source for emission sites. Returns
+    ``(event_pairs, counter_names)`` where each pair is
+    ``(kind, name-or-None)`` — name ``None`` when the call site passes
+    a variable. Test files are out of scope (they emit arbitrary
+    events on purpose)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pairs: Set[Tuple[str, Optional[str]]] = set()
+    counters: Set[str] = set()
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                text = f.read()
+            for m in _EVENT_RE.finditer(text):
+                pairs.add((m.group(1), m.group(2)))
+            for m in _COUNTER_RE.finditer(text):
+                counters.add(m.group(1))
+    return pairs, counters
+
+
+def registered(kind: str, name: Optional[str]) -> bool:
+    """True when the (kind, name) pair — name possibly unknown — is
+    covered by the registry."""
+    names = EVENT_REGISTRY.get(kind)
+    if names is None:
+        return False
+    if name is None:
+        return True  # dynamic name: the kind itself is the contract
+    return name in names or None in names

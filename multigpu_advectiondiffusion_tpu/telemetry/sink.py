@@ -35,6 +35,7 @@ import collections
 import contextlib
 import itertools
 import json
+import os
 import sys
 import threading
 import time
@@ -112,9 +113,17 @@ class TelemetrySink:
 
     active = True
 
-    def __init__(self, path: str, tail_events: int = 512):
+    def __init__(self, path: str, tail_events: int = 512,
+                 max_bytes: int = 0):
         self.path = path
+        # size-capped rotation: when the stream file exceeds max_bytes,
+        # it is renamed to <path>.1 (replacing any previous rotation)
+        # and a fresh file continues at <path> — long supervised runs
+        # keep the newest ~2*max_bytes of evidence instead of growing
+        # without bound. 0 = unbounded (the default).
+        self.max_bytes = int(max_bytes or 0)
         self._f = open(path, "a", buffering=1)  # line-buffered
+        self._bytes = os.path.getsize(path)
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._ids = itertools.count(1)
@@ -147,8 +156,41 @@ class TelemetrySink:
             self._tail.append(ev)
             try:
                 self._f.write(line + "\n")
+                self._bytes += len(line) + 1
+                if self.max_bytes and self._bytes >= self.max_bytes:
+                    self._rotate_locked()
             except ValueError:
                 pass  # closed sink: keep the tail, drop the write
+
+    def _rotate_locked(self) -> None:
+        """Rotate the stream file (caller holds the lock): the full
+        file becomes ``<path>.1`` (last rotation dropped), the fresh
+        tail file opens with a ``sink:rotate`` record that — like
+        ``meta:open`` — carries the schema version and a wall-clock
+        epoch, so a tail-only file still merges and aligns. The
+        monotonic clock is NOT reset: ``t`` stays comparable across
+        the rotation boundary."""
+        rotated = self._bytes
+        self._f.flush()
+        self._f.close()
+        prev = self.path + ".1"
+        os.replace(self.path, prev)
+        self._f = open(self.path, "a", buffering=1)
+        self._bytes = 0
+        ev = {
+            "t": round(time.monotonic() - self._t0, 6),
+            "proc": _process_index(),
+            "kind": "sink",
+            "name": "rotate",
+            "schema": EVENT_SCHEMA,
+            "wall_time": time.time(),
+            "previous": prev,
+            "rotated_bytes": rotated,
+        }
+        self._tail.append(ev)
+        line = json.dumps(ev)
+        self._f.write(line + "\n")
+        self._bytes += len(line) + 1
 
     def counter(self, name: str, inc, **fields) -> None:
         """Accumulate ``inc`` into the named counter and log the event
@@ -260,17 +302,21 @@ def _install_crash_hooks() -> None:
     sys.excepthook = hook
 
 
-def install(path: str, tail_events: int = 512) -> TelemetrySink:
+def install(path: str, tail_events: int = 512,
+            max_bytes: int = 0) -> TelemetrySink:
     """Open a JSONL sink at ``path`` and make it the active sink. An
     already-active sink is closed first (last install wins). The first
     install also arms the crash-path flush hooks (atexit +
     ``sys.excepthook``), so the stream's tail survives uncaught errors
-    and preemption exits."""
+    and preemption exits. ``max_bytes`` > 0 arms size-capped rotation
+    (``<path>.1`` keeps the previous segment; a ``sink:rotate`` event
+    opens each fresh tail)."""
     global _active
     if _active.active:
         _active.close()
     _install_crash_hooks()
-    _active = TelemetrySink(path, tail_events=tail_events)
+    _active = TelemetrySink(path, tail_events=tail_events,
+                            max_bytes=max_bytes)
     return _active
 
 
@@ -288,9 +334,9 @@ def uninstall(sink: Optional[TelemetrySink] = None) -> None:
 
 
 @contextlib.contextmanager
-def capture(path: str, tail_events: int = 512):
+def capture(path: str, tail_events: int = 512, max_bytes: int = 0):
     """``with capture('events.jsonl') as sink: ...`` — scoped install."""
-    sink = install(path, tail_events=tail_events)
+    sink = install(path, tail_events=tail_events, max_bytes=max_bytes)
     try:
         yield sink
     finally:

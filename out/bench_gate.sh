@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Measured bench regression gate: diff a fresh bench artifact against
+# the newest archived round (BENCH_r0*.json) with bench/compare.py's
+# per-row noise thresholds; nonzero exit on any regression.
+#
+#   ./out/bench_gate.sh NEW.json          # gate NEW against newest round
+#   ./out/bench_gate.sh NEW.json PRIOR    # explicit prior round
+#   ./out/bench_gate.sh --selftest        # prove the gate trips on a
+#                                         # synthetic 20% slowdown AND
+#                                         # passes the unmodified round
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+newest_round() {
+  ls BENCH_r0*.json 2>/dev/null | sort | tail -1
+}
+
+if [[ "${1:-}" == "--selftest" ]]; then
+  PRIOR="$(newest_round)"
+  [[ -n "$PRIOR" ]] || { echo "bench_gate: no BENCH_r0*.json to self-test against" >&2; exit 1; }
+  TMP="$(mktemp -d)"
+  trap 'rm -rf "$TMP"' EXIT
+  # inject a 20% throughput regression into one row of the newest round
+  python - "$PRIOR" "$TMP/slowed.json" <<'PY'
+import json, sys
+rows = []
+from multigpu_advectiondiffusion_tpu.bench.compare import load_rows
+rows = list(load_rows(sys.argv[1]).values())
+assert rows, "no rows parsed from the prior round"
+slowed = False
+for row in rows:
+    if not slowed and "value" in row:
+        row["value"] = round(row["value"] * 0.8, 2)  # -20%
+        slowed = True
+assert slowed, "no value row to slow down"
+with open(sys.argv[2], "w") as f:
+    f.write("\n".join(json.dumps(r) for r in rows) + "\n")
+PY
+  echo "bench_gate selftest: unmodified round must PASS"
+  python -m multigpu_advectiondiffusion_tpu.bench.compare "$PRIOR" "$PRIOR"
+  echo "bench_gate selftest: injected 20% slowdown must FAIL"
+  if python -m multigpu_advectiondiffusion_tpu.bench.compare "$TMP/slowed.json" "$PRIOR"; then
+    echo "bench_gate selftest: gate FAILED to trip on a 20% regression" >&2
+    exit 1
+  fi
+  echo "bench_gate selftest: OK (gate trips on -20%, passes unmodified)"
+  exit 0
+fi
+
+NEW="${1:?usage: bench_gate.sh NEW.json [PRIOR.json] | --selftest}"
+PRIOR="${2:-$(newest_round)}"
+[[ -n "$PRIOR" ]] || { echo "bench_gate: no BENCH_r0*.json prior round found" >&2; exit 1; }
+echo "bench_gate: $NEW vs $PRIOR"
+exec python -m multigpu_advectiondiffusion_tpu.bench.compare "$NEW" "$PRIOR"

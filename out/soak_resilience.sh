@@ -6,6 +6,8 @@
 #   ./out/soak_resilience.sh        # 5 rounds of the fast chaos suite
 #   ./out/soak_resilience.sh 20     # longer soak
 #   SOAK_SLOW=1 ./out/soak_resilience.sh 3   # include the slow soak test
+#   BENCH_GATE=1 ./out/soak_resilience.sh    # also run the bench
+#                                   # regression-gate self-test after
 #
 # Runs on the virtual CPU backend (no TPU needed), same as tier-1.
 set -euo pipefail
@@ -24,3 +26,10 @@ for i in $(seq 1 "$N"); do
     || { echo "soak_resilience: FLAKE in round $i/$N" >&2; exit 1; }
 done
 echo "soak_resilience: $N round(s) clean"
+
+if [[ "${BENCH_GATE:-0}" == "1" ]]; then
+  # close the loop on the bench trajectory too: the regression gate's
+  # self-test (trips on an injected 20% slowdown, passes the newest
+  # unmodified round) — see out/bench_gate.sh
+  JAX_PLATFORMS=cpu ./out/bench_gate.sh --selftest
+fi

@@ -1,0 +1,184 @@
+"""Bench regression gate (ISSUE 6): bench/compare.py + out/bench_gate.sh.
+
+Acceptance: the gate flags an injected 20% throughput regression
+against the real archived r05 round while passing the unmodified round.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from multigpu_advectiondiffusion_tpu.bench import compare as cmp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _newest_round():
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json")))
+    if not rounds:
+        pytest.skip("no archived BENCH_r0*.json rounds in this checkout")
+    return rounds[-1]
+
+
+# --------------------------------------------------------------------- #
+# Loading: every artifact container the trajectory uses
+# --------------------------------------------------------------------- #
+def test_load_rows_jsonl(tmp_path):
+    p = tmp_path / "rows.json"
+    p.write_text(
+        '{"metric": "a_mlups", "value": 100.0, "spread": 0.01}\n'
+        '{"metric": "b_mlups", "value": 50.0}\n'
+        "not json at all\n"
+    )
+    rows = cmp.load_rows(str(p))
+    assert set(rows) == {"a_mlups", "b_mlups"}
+    assert cmp.row_value(rows["a_mlups"]) == 100.0
+    assert cmp.row_spread(rows["a_mlups"]) == 0.01
+
+
+def test_load_rows_driver_wrapper_with_torn_head(tmp_path):
+    tail = (
+        'alue": 1.0}\n'  # torn first line, as in the archived rounds
+        '{"metric": "a_mlups", "value": 100.0, "spread": 0.002}\n'
+    )
+    p = tmp_path / "wrap.json"
+    p.write_text(json.dumps({"n": 5, "cmd": "bench", "rc": 0,
+                             "tail": tail}))
+    rows = cmp.load_rows(str(p))
+    assert set(rows) == {"a_mlups"}
+
+
+def test_load_rows_matrix_name_mlups(tmp_path):
+    p = tmp_path / "matrix.json"
+    p.write_text('{"name": "diffusion3d", "mlups": 42000.5}\n')
+    rows = cmp.load_rows(str(p))
+    assert cmp.row_value(rows["diffusion3d"]) == 42000.5
+
+
+def test_load_rows_real_archived_round():
+    rows = cmp.load_rows(_newest_round())
+    assert rows, "the archived round parsed to zero rows"
+    assert all(cmp.row_value(r) is not None for r in rows.values())
+
+
+# --------------------------------------------------------------------- #
+# Comparison semantics
+# --------------------------------------------------------------------- #
+def _rows(**vals):
+    return {
+        k: {"metric": k, "value": v[0], "spread": v[1]}
+        for k, v in vals.items()
+    }
+
+
+def test_compare_flags_regression_beyond_threshold():
+    old = _rows(a=(100.0, 0.01))
+    new = _rows(a=(79.0, 0.01))  # -21%
+    res = cmp.compare(new, old)
+    assert not res.ok
+    assert res.rows[0].status == "regression"
+
+
+def test_compare_noise_threshold_scales_with_spread():
+    old = _rows(a=(100.0, 0.15))  # noisy row: 2x0.15 = 30% threshold
+    res = cmp.compare(_rows(a=(88.0, 0.01)), old)
+    assert res.ok, "a -12% move on a 15%-spread row is noise, not signal"
+    res = cmp.compare(_rows(a=(60.0, 0.01)), old)
+    assert not res.ok
+
+
+def test_compare_improvement_and_ok():
+    old = _rows(a=(100.0, 0.0), b=(100.0, 0.0))
+    res = cmp.compare(_rows(a=(120.0, 0.0), b=(101.0, 0.0)), old)
+    assert res.ok
+    statuses = {r.metric: r.status for r in res.rows}
+    assert statuses == {"a": "improved", "b": "ok"}
+
+
+def test_compare_missing_row_is_coverage_regression():
+    old = _rows(a=(100.0, 0.0), b=(50.0, 0.0))
+    res = cmp.compare(_rows(a=(100.0, 0.0)), old)
+    assert not res.ok
+    assert any(r.status == "missing" and r.metric == "b"
+               for r in res.rows)
+    # a NEW metric never fails the gate
+    res = cmp.compare(_rows(a=(100.0, 0.0), c=(1.0, 0.0)),
+                      _rows(a=(100.0, 0.0)))
+    assert res.ok
+    assert any(r.status == "added" for r in res.rows)
+
+
+def test_check_floors():
+    rows = {
+        "a": {"metric": "a", "value": 10.0, "vs_baseline": 1.2},
+        "b": {"metric": "b", "value": 10.0, "vs_baseline": 0.9},
+        "c": {"metric": "c", "value": 10.0},  # no baseline: skipped
+    }
+    res = cmp.check_floors(rows)
+    assert not res.ok
+    statuses = {r.metric: r.status for r in res.rows}
+    assert statuses == {"a": "ok", "b": "regression"}
+
+
+# --------------------------------------------------------------------- #
+# Acceptance: the r05 gate
+# --------------------------------------------------------------------- #
+def test_gate_passes_unmodified_r05_round():
+    rows = cmp.load_rows(_newest_round())
+    assert cmp.compare(rows, rows).ok
+
+
+def test_gate_trips_on_injected_20pct_regression():
+    rows = cmp.load_rows(_newest_round())
+    slowed = {k: dict(v) for k, v in rows.items()}
+    victim = sorted(slowed)[0]
+    slowed[victim]["value"] = cmp.row_value(slowed[victim]) * 0.8
+    res = cmp.compare(slowed, rows)
+    assert not res.ok
+    bad = [r for r in res.rows if r.status == "regression"]
+    assert [r.metric for r in bad] == [victim]
+    assert "REGRESSION" in res.format_text()
+    assert "FAIL" in res.format_text()
+
+
+# --------------------------------------------------------------------- #
+# CLI entry point
+# --------------------------------------------------------------------- #
+def test_cli_exits_nonzero_on_regression(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text('{"metric": "a", "value": 100.0, "spread": 0.0}\n')
+    new.write_text('{"metric": "a", "value": 80.0, "spread": 0.0}\n')
+    with pytest.raises(SystemExit) as exc:
+        cmp.main([str(new), str(old)])
+    assert exc.value.code == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # identical rounds pass (returns None, no SystemExit)
+    assert cmp.main([str(old), str(old)]) is None
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_cli_floors_mode(tmp_path):
+    new = tmp_path / "new.json"
+    new.write_text(
+        '{"metric": "a", "value": 10.0, "vs_baseline": 1.5}\n'
+    )
+    assert cmp.main([str(new), "--floors"]) is None
+    new.write_text(
+        '{"metric": "a", "value": 10.0, "vs_baseline": 0.5}\n'
+    )
+    with pytest.raises(SystemExit):
+        cmp.main([str(new), "--floors"])
+
+
+def test_cli_requires_exactly_one_mode(tmp_path):
+    new = tmp_path / "new.json"
+    new.write_text('{"metric": "a", "value": 1.0}\n')
+    with pytest.raises(SystemExit):
+        cmp.main([str(new)])  # neither prior nor --floors
+    with pytest.raises(SystemExit):
+        cmp.main([str(new), str(new), "--floors"])  # both

@@ -1,0 +1,106 @@
+"""Event-schema drift guard (ISSUE 6 satellite).
+
+The registry (telemetry/schema.py) is the single source of truth for
+event kinds/names/required fields. These tests hold three things to it:
+
+* every emission site in the package source (statically scanned);
+* README's Observability event table (both directions);
+* real emitted events (structural validation of a live stream).
+
+Someone adding a ``telemetry.event("newkind", ...)`` call — or a new
+README row — without registering it fails tier-1 here, not in a
+downstream consumer six months later.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from multigpu_advectiondiffusion_tpu import telemetry
+from multigpu_advectiondiffusion_tpu.telemetry import schema
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "multigpu_advectiondiffusion_tpu")
+
+
+def test_every_emitted_event_is_registered():
+    pairs, counters = schema.scan_emitted(PKG)
+    assert pairs, "the static scan found no emission sites at all?"
+    unregistered = sorted(
+        f"{kind}:{name}" for kind, name in pairs
+        if not schema.registered(kind, name)
+    )
+    assert not unregistered, (
+        "emission sites not covered by telemetry/schema.py "
+        f"EVENT_REGISTRY: {unregistered} — register the kind/name "
+        "(and document it in README's event table)"
+    )
+    unknown_counters = sorted(counters - schema.COUNTER_NAMES)
+    assert not unknown_counters, (
+        f"counters missing from schema.COUNTER_NAMES: {unknown_counters}"
+    )
+
+
+def _readme_kinds() -> set:
+    with open(os.path.join(REPO, "README.md")) as f:
+        text = f.read()
+    start = text.index("## Observability")
+    end = text.index("## ", start + 4)
+    section = text[start:end]
+    return set(re.findall(r"^\s*\|\s*`([a-z_]+)`", section, re.M))
+
+
+def test_readme_event_table_matches_registry():
+    readme = _readme_kinds()
+    registry = set(schema.EVENT_REGISTRY)
+    missing_from_readme = sorted(registry - readme)
+    assert not missing_from_readme, (
+        "event kinds registered but absent from README's Observability "
+        f"table: {missing_from_readme}"
+    )
+    unregistered_in_readme = sorted(readme - registry)
+    assert not unregistered_in_readme, (
+        "README documents event kinds the registry does not know: "
+        f"{unregistered_in_readme}"
+    )
+
+
+def test_validate_event_passes_real_stream(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with telemetry.capture(path) as sink:
+        with sink.span("run_solver", run="x"):
+            sink.counter("halo.exchanges_traced", 1, axis=0)
+            sink.event("physics", "probe", step=1, time=0.1)
+            sink.event("progress", "chunk", step=1, steps_done=1,
+                       step_seconds=0.01)
+    for line in open(path):
+        ev = json.loads(line)
+        assert schema.validate_event(ev) == [], (ev,
+                                                 schema.validate_event(ev))
+
+
+def test_validate_event_flags_drift():
+    assert any(
+        "unregistered kind" in p
+        for p in schema.validate_event(
+            {"t": 0, "proc": 0, "kind": "madeup", "name": "x"}
+        )
+    )
+    assert any(
+        "unregistered name" in p
+        for p in schema.validate_event(
+            {"t": 0, "proc": 0, "kind": "physics", "name": "nope"}
+        )
+    )
+    assert any(
+        "missing field" in p
+        for p in schema.validate_event(
+            {"t": 0, "proc": 0, "kind": "physics", "name": "probe"}
+        )
+    )
+    assert any(
+        "envelope" in p
+        for p in schema.validate_event({"kind": "meta", "name": "open"})
+    )
