@@ -359,6 +359,16 @@ def main() -> None:
         cost = costmodel.summarize_run(
             solver, engaged["stepper"], iters, timing.median_seconds
         )
+        # measured introspection beside the modeled columns: the
+        # compiled executable's own XLA-reported per-step flops/bytes
+        # and peak-footprint estimate (telemetry/xprof; None when no
+        # executable was captured). Coverage-checked but non-gating in
+        # bench/compare.py — measurement provenance, not a pass bar.
+        from multigpu_advectiondiffusion_tpu.telemetry import xprof
+
+        meas = xprof.measured_summary(
+            solver, iters, timing.median_seconds
+        ) or {}
         row = {
             "metric": metric,
             "value": round(rate, 2),
@@ -376,6 +386,11 @@ def main() -> None:
             "steps_per_exchange": engaged.get("steps_per_exchange", 1),
             "tuned": engaged.get("tuned"),
             "roofline_pct": (cost or {}).get("roofline_pct"),
+            # measured XLA columns (per step; peak_bytes = executable
+            # footprint estimate) beside the modeled roofline_pct
+            "xla_flops": meas.get("xla_flops_per_step"),
+            "xla_bytes": meas.get("xla_bytes_per_step"),
+            "peak_bytes": meas.get("peak_bytes"),
         }
         # engagement guard: a row running on an unexpected (slower)
         # stepper is recorded AND fails the run — a silent fallback to

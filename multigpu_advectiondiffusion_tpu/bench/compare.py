@@ -17,7 +17,10 @@ truncated first line is skipped, not fatal).
 
 Threshold per row: ``max(rel_tol, spread_factor * max(spread_old,
 spread_new))`` — a noisy row must move by more than its own observed
-dispersion before the gate calls it a regression. Usage::
+dispersion before the gate calls it a regression. The measured
+introspection columns (:data:`MEASURED_FIELDS` — ``xla_flops``/
+``xla_bytes``/``peak_bytes``) are coverage-checked (a dropped column
+prints a note) but never gate. Usage::
 
     python -m multigpu_advectiondiffusion_tpu.bench.compare NEW OLD
     python -m multigpu_advectiondiffusion_tpu.bench.compare NEW --floors
@@ -36,6 +39,13 @@ from typing import Dict, List, Optional
 
 DEFAULT_REL_TOL = 0.05
 DEFAULT_SPREAD_FACTOR = 2.0
+
+# Measured-introspection columns (telemetry/xprof via bench rows):
+# coverage-checked — a row that HAD them and silently lost them gets a
+# printed note — but never gating: they are measurement provenance, not
+# throughput, and XLA's counts legitimately shift across compiler
+# versions.
+MEASURED_FIELDS = ("xla_flops", "xla_bytes", "peak_bytes")
 
 
 def parse_rows(text: str) -> List[dict]:
@@ -125,6 +135,9 @@ class RowResult:
 @dataclasses.dataclass
 class CompareResult:
     rows: List[RowResult]
+    # non-gating coverage notes (e.g. a measured xla_* column that
+    # disappeared between rounds) — printed, never failing
+    notes: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def regressions(self) -> List[RowResult]:
@@ -139,11 +152,14 @@ class CompareResult:
         return {
             "ok": self.ok,
             "rows": [dataclasses.asdict(r) for r in self.rows],
+            "notes": list(self.notes),
         }
 
     def format_text(self) -> str:
         lines = ["bench compare:"]
         lines += [r.line() for r in self.rows]
+        for note in self.notes:
+            lines.append(f"        note  {note}")
         n_reg = len(self.regressions)
         lines.append(
             "bench compare: PASS"
@@ -164,6 +180,7 @@ def compare(
     dropped benchmark is a regression in coverage); a new metric is
     reported as ``added`` and never fails."""
     results: List[RowResult] = []
+    notes: List[str] = []
     for key in sorted(set(old_rows) | set(new_rows)):
         old = old_rows.get(key)
         new = new_rows.get(key)
@@ -175,6 +192,12 @@ def compare(
             results.append(RowResult(key, "missing",
                                      old=row_value(old)))
             continue
+        for field in MEASURED_FIELDS:
+            if old.get(field) is not None and new.get(field) is None:
+                notes.append(
+                    f"{key}: measured column {field!r} dropped "
+                    "(coverage note, non-gating)"
+                )
         ov, nv = row_value(old), row_value(new)
         threshold = max(
             rel_tol,
@@ -190,7 +213,7 @@ def compare(
         results.append(RowResult(key, status, new=nv, old=ov,
                                  ratio=round(ratio, 4),
                                  threshold=round(threshold, 4)))
-    return CompareResult(results)
+    return CompareResult(results, notes=notes)
 
 
 def check_floors(new_rows: Dict[str, dict],

@@ -201,6 +201,11 @@ def run_case(
     from multigpu_advectiondiffusion_tpu.telemetry import costmodel
 
     cost = costmodel.summarize_run(solver, engaged["stepper"], iters, best)
+    # measured introspection beside the modeled roofline: the compiled
+    # executable's own XLA-reported per-step numbers (telemetry/xprof)
+    from multigpu_advectiondiffusion_tpu.telemetry import xprof
+
+    meas = xprof.measured_summary(solver, iters, best) or {}
     result = {
         "name": case.name,
         "grid": "x".join(map(str, grid_xyz)),
@@ -221,6 +226,12 @@ def run_case(
         "compile_seconds": round(compile_s, 3),
         "mlups": round(rate, 1),
         "roofline_pct": (cost or {}).get("roofline_pct"),
+        # measured XLA columns (coverage-checked, non-gating in
+        # bench/compare.py): per-step flops/bytes the compiled
+        # executable reports, and its peak-footprint estimate
+        "xla_flops": meas.get("xla_flops_per_step"),
+        "xla_bytes": meas.get("xla_bytes_per_step"),
+        "peak_bytes": meas.get("peak_bytes"),
         "quick": quick,
         "mesh": mesh_spec,
     }

@@ -141,12 +141,17 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="stream structured telemetry to PATH as JSONL: "
                         "span/counter events from dispatch and halo "
-                        "exchanges, chunk-cadence physics probes "
-                        "(min/max/L2/mass drift, supervised runs), "
+                        "exchanges, per-executable XLA cost/memory "
+                        "capture (xla:cost — compiler-reported flops/"
+                        "bytes + compile seconds per compiled program), "
+                        "chunk-cadence physics probes and device-memory "
+                        "watermarks (mem:watermark, supervised runs), "
                         "resilience events (rollbacks, retries, "
-                        "preemption), checkpoint writes — see README "
-                        "'Observability' for the event schema; analyze "
-                        "or merge streams with the 'trace' subcommand")
+                        "preemption), checkpoint writes and calibration "
+                        "updates — see README 'Observability' for the "
+                        "event schema; analyze or merge streams with "
+                        "the 'trace' subcommand (incl. the measured-vs-"
+                        "modeled report section)")
     p.add_argument("--metrics-max-bytes", type=int, default=0,
                    metavar="N",
                    help="size-capped rotation for the --metrics stream: "

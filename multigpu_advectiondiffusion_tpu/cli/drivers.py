@@ -321,6 +321,13 @@ def _run_solver(
         state = solver.initial_state()
     start_it = int(state.it)
 
+    # measured introspection: run-scoped device-memory watermarks
+    # (supervised chunks sample at cadence; every run samples at the
+    # warm-up and final boundaries, so RunSummary.memory always lands)
+    from multigpu_advectiondiffusion_tpu.telemetry import xprof
+
+    xprof.reset_watermarks()
+
     if save_dir:
         os.makedirs(save_dir, exist_ok=True)
         u_host = _fetch(state.u)
@@ -335,6 +342,7 @@ def _run_solver(
         out = solver.step(state)
     sync(out.u)
     compile_s = time.perf_counter() - t0
+    xprof.sample_watermark(step=int(out.it))
 
     supervised = sentinel_every > 0
     periodic = (
@@ -386,10 +394,10 @@ def _run_solver(
     best = float("inf")
     io_s = None
     sup_report = None
-    # the trace context closes on every exit path, including exceptions
-    # raised inside the timed solve (a leaked jax.profiler trace poisons
-    # every later start_trace in the process)
-    profiled = contextlib.ExitStack()
+    # trace() itself is exception-safe and idempotent (utils/profiling):
+    # it closes on every exit path and a leaked predecessor can no
+    # longer poison start_trace — no extra guard logic needed here
+    profiled = contextlib.nullcontext()
     if profile_dir:
         from multigpu_advectiondiffusion_tpu.utils.profiling import trace
 
@@ -401,7 +409,7 @@ def _run_solver(
             profile_dir = os.path.join(
                 profile_dir, f"rank{jax.process_index()}"
             )
-        profiled.enter_context(trace(profile_dir))
+        profiled = trace(profile_dir)
     guard = PreemptionGuard()
     with profiled, guard:
         if supervised:
@@ -585,6 +593,7 @@ def _run_solver(
         t_final=float(out.t),
         devices=1 if solver.mesh is None else solver.mesh.devices.size,
         dtype=str(solver.cfg.dtype),
+        compile_seconds=compile_s,
         io_seconds=io_s,
         engaged=solver.engaged_path(
             mode="iters" if iters is not None else "t_end"
@@ -598,6 +607,12 @@ def _run_solver(
     summary.cost_model = costmodel.summarize_run(
         solver, summary.engaged["stepper"], n_iters, best
     )
+    # measured introspection: the final watermark sample plus the
+    # per-executable XLA capture reconciled against the modeled cost
+    sync(out.u)
+    xprof.sample_watermark(step=int(out.it))
+    summary.memory = xprof.watermark_summary()
+    summary.xla = xprof.measured_summary(solver, n_iters, best)
     from multigpu_advectiondiffusion_tpu import telemetry
 
     t_sink = telemetry.get_sink()
@@ -614,6 +629,39 @@ def _run_solver(
                 else None
             ),
         )
+        if summary.xla is not None:
+            # the per-run measured-vs-modeled record the trace report
+            # renders: XLA bytes/flops per step, model ratio + band
+            # flag, achieved vs peak bandwidth
+            t_sink.event("xla", "measured", run=name, **summary.xla)
+    if summary.xla is not None and summary.cost_model is not None:
+        # feed the measured-peak calibration with the run's achieved
+        # rate on its BINDING resource (the non-binding one never
+        # approaches its roof — calibrating it down would be noise);
+        # consumed by costmodel.peak_rates and the tuner's pruning
+        from multigpu_advectiondiffusion_tpu.telemetry import calibration
+
+        bound = summary.cost_model.get("bound")
+        kwargs = {}
+        if bound == "hbm" and summary.xla.get("achieved_gbs"):
+            kwargs["bytes_per_s"] = (
+                summary.xla["achieved_gbs"] * 1e9
+                / max(1, summary.xla.get("devices", 1))
+            )
+        elif bound == "flops" and summary.xla.get("achieved_gflops"):
+            kwargs["flops_per_s"] = (
+                summary.xla["achieved_gflops"] * 1e9
+                / max(1, summary.xla.get("devices", 1))
+            )
+        if kwargs and is_coord:
+            try:
+                kind = jax.local_devices()[0].device_kind
+            except Exception:
+                kind = None
+            calibration.observe(
+                jax.default_backend(), run=name, device_kind=kind,
+                **kwargs,
+            )
 
     if check_error and hasattr(solver, "error_norms"):
         # gathered first: eager norm arithmetic mixes the state with a

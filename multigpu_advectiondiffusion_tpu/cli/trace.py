@@ -12,6 +12,10 @@ daemon) actually asks of a run:
 * which rank (and which span chain) bounded the run — the cross-rank
   critical path and end skew;
 * which steps stalled (``perf:outlier`` record)?
+* did the cost model's bytes/FLOPs match what XLA actually compiled?
+  (the measured-vs-modeled section over ``xla:cost``/``xla:measured``
+  events — per-rung ratios flagged outside the tolerance band — plus
+  each rank's ``mem:watermark`` device-memory peak)
 
 Usage (also a ``trace`` subcommand of the main CLI)::
 

@@ -91,6 +91,10 @@ class SolverBase:
         # cfg.impl) and the downgrade events themselves
         self._requested_impl = getattr(cfg, "impl", "xla")
         self._degrade_events = []
+        # measured introspection (telemetry/xprof.py): one ExecRecord
+        # per compiled executable, appended at first call — survives
+        # _cache.clear() (records are history, not dispatch state)
+        self._xla_records = []
         self._tuned = None
         if self._requested_impl == "auto":
             # measured dispatch: the tuner resolves (rung, k) per
@@ -300,22 +304,32 @@ class SolverBase:
             )
         )
 
-    def _compiled(self, key, builder):
+    def _compiled(self, key, builder, steps=None):
+        """One dispatch-cache entry per program. ``steps`` is the
+        iteration count the program bakes in (None for data-dependent
+        trip counts, e.g. the t_end while_loop) — threaded to the
+        measured-introspection layer so the executable's XLA-reported
+        bytes/FLOPs read against the per-step cost model."""
         if key not in self._cache:
             from multigpu_advectiondiffusion_tpu import telemetry
+            from multigpu_advectiondiffusion_tpu.telemetry import xprof
 
             sink = telemetry.get_sink()
             if sink.active:
                 # rung-selection record: one event per program the
                 # dispatch layer builds (the compile itself happens at
-                # first call, inside the caller's span)
+                # first call, inside the caller's span — where the
+                # xprof wrapper captures the executable's cost/memory
+                # analyses and compile seconds as an xla:cost event)
                 sink.event(
                     "dispatch", "build",
                     key=str(key),
                     impl=getattr(self.cfg, "impl", "xla"),
                     requested_impl=self._requested_impl,
                 )
-            self._cache[key] = builder()
+            self._cache[key] = xprof.wrap_dispatch(
+                builder(), solver=self, key=str(key), steps=steps
+            )
         return self._cache[key]
 
     def _dispatch_span(self, op: str, mode: str = "iters", **fields):
@@ -418,7 +432,7 @@ class SolverBase:
         def call():
             with self._dispatch_span("step"):
                 f = self._compiled(
-                    "step", lambda: self._wrap(self._local_step)
+                    "step", lambda: self._wrap(self._local_step), steps=1
                 )
                 u, t = f(state.u, state.t)
                 return SolverState(u=u, t=t, it=state.it + 1)
@@ -717,7 +731,8 @@ class SolverBase:
                 return fused.run(u, t, num_iters, **kw)
 
             f = self._compiled(
-                ("fused_run", num_iters), lambda: self._wrap(block)
+                ("fused_run", num_iters), lambda: self._wrap(block),
+                steps=int(num_iters),
             )
             u, t = f(state.u, state.t)
             return SolverState(u=u, t=t, it=state.it + num_iters)
@@ -727,7 +742,8 @@ class SolverBase:
                 0, num_iters, lambda i, c: self._local_step(*c), (u, t)
             )
 
-        f = self._compiled(("run", num_iters), lambda: self._wrap(block))
+        f = self._compiled(("run", num_iters), lambda: self._wrap(block),
+                           steps=int(num_iters))
         u, t = f(state.u, state.t)
         return SolverState(u=u, t=t, it=state.it + num_iters)
 

@@ -128,7 +128,10 @@ def supervise_run(
     un-faulted trajectory bit-for-bit.
 
     Every completed chunk emits a ``progress`` telemetry event (step
-    rate, MLUPS, ETA, last mass drift) and feeds the rolling step-time
+    rate, MLUPS, ETA, last mass drift), samples a ``mem:watermark``
+    device-memory event (:mod:`telemetry.xprof` — backend memory stats
+    or the live-arrays census; the running peak lands in
+    ``RunSummary.memory``), and feeds the rolling step-time
     watch (:mod:`telemetry.live`): a chunk whose per-step wall time
     breaches the robust median+MAD threshold emits ``perf:outlier`` —
     the live fingerprint of preemption stalls, SDC re-execution and
@@ -165,6 +168,7 @@ def supervise_run(
         coordinated=coordinate,
     )
 
+    from multigpu_advectiondiffusion_tpu.telemetry import xprof
     from multigpu_advectiondiffusion_tpu.telemetry.live import (
         StepTimeWatch,
         emit_histogram,
@@ -186,6 +190,11 @@ def supervise_run(
         if chunk_steps <= 0 or chunk_seconds <= 0:
             return
         watch.observe(chunk_steps, chunk_seconds, step=int(nxt.it))
+        # chunk-cadence device-memory watermark (mem:watermark):
+        # device-reported where the backend provides memory_stats(),
+        # live-arrays census otherwise — the run-level peak lands in
+        # RunSummary.memory
+        xprof.sample_watermark(step=int(nxt.it))
         per_step = watch.median() or (chunk_seconds / chunk_steps)
         steps_done = int(nxt.it) - start_it
         if iters is not None:

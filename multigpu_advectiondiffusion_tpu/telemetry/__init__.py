@@ -15,6 +15,11 @@ into (the upgrade of the reference's ``nvprof`` + hand-read
   ``tpucfd-trace`` / ``python -m ... cli trace``);
 * :mod:`live` — chunk-cadence step-time watch (``perf:outlier``
   events) and the ``--progress`` terminal status line;
+* :mod:`xprof` — measured introspection: per-executable XLA cost/
+  memory capture at dispatch (``xla:cost``), device-memory watermarks
+  (``mem:watermark``) and the measured-vs-modeled reconciliation;
+* :mod:`calibration` — persisted measured-peak record the cost model
+  and autotuner consult ahead of the env-assumed peaks;
 * :mod:`schema` — the event-kind registry tier-1 tests hold every
   emission site (and README's event table) against.
 """
@@ -36,7 +41,8 @@ from multigpu_advectiondiffusion_tpu.telemetry import costmodel  # noqa: F401
 from multigpu_advectiondiffusion_tpu.telemetry import schema  # noqa: F401
 
 # analyze/export/live are imported lazily by their consumers (the trace
-# CLI, the supervisor) — keeping the package import light for the hot
+# CLI, the supervisor); xprof/calibration by the dispatch layer and the
+# drivers — keeping the package import light for the hot
 # instrumentation path.
 
 __all__ = [

@@ -23,7 +23,11 @@ layer the reference never had:
 * :func:`analyze` produces a :class:`TraceReport`: per-phase wall-clock
   breakdown (compile vs step vs halo vs checkpoint vs rollback), every
   run's measured throughput against the static cost-model roofline,
-  the cross-rank critical path, and the step-time outlier record.
+  the cross-rank critical path, the step-time outlier record, and the
+  measured-vs-modeled introspection section
+  (:func:`measured_introspection` — per-executable XLA bytes/flops
+  against the cost model's prediction, achieved bandwidth against the
+  configured peak, device-memory peaks per rank).
 
 The Perfetto exporter (:mod:`telemetry.export`) consumes the same
 aligned streams; ``tpucfd-trace`` (cli/trace.py) is the front end.
@@ -451,6 +455,84 @@ def critical_path(streams: List[Stream]) -> dict:
     }
 
 
+def measured_introspection(streams: List[Stream]) -> dict:
+    """The measured-vs-modeled section: per-executable ``xla:cost``
+    captures (XLA-reported bytes/flops next to the cost model's
+    per-step prediction, ratio flagged outside the tolerance band —
+    discrepancies reported, not hidden), per-run ``xla:measured``
+    reconciliations (achieved bandwidth vs the configured peak), and
+    each rank's ``mem:watermark`` peak."""
+    from multigpu_advectiondiffusion_tpu.telemetry.xprof import (
+        tolerance_factor,
+    )
+
+    tol = tolerance_factor()
+    executables = []
+    runs = []
+    memory: Dict[str, dict] = {}
+    for s in streams:
+        for ev in s.events:
+            kind, name = ev.get("kind"), ev.get("name")
+            if kind == "xla" and name == "cost":
+                devices = max(1, int(ev.get("devices", 1) or 1))
+                xla_bytes = float(ev.get("bytes_accessed", 0) or 0)
+                xla_bytes *= devices
+                model = ev.get("model_bytes_per_step")
+                ratio = (
+                    round(float(model) / xla_bytes, 4)
+                    if model and xla_bytes > 0 else None
+                )
+                executables.append({
+                    "proc": s.proc,
+                    "key": ev.get("key"),
+                    "stepper": ev.get("stepper"),
+                    "steps": ev.get("steps"),
+                    "xla_bytes": xla_bytes,
+                    "xla_flops": float(ev.get("flops", 0) or 0) * devices,
+                    "model_bytes": model,
+                    "model_bytes_ratio": ratio,
+                    "within_tolerance": (
+                        bool(1.0 / tol <= ratio <= tol)
+                        if ratio is not None else None
+                    ),
+                    "peak_bytes": ev.get("peak_bytes"),
+                    "compile_seconds": ev.get("compile_seconds"),
+                })
+            elif kind == "xla" and name == "measured":
+                runs.append({
+                    "proc": s.proc,
+                    "run": ev.get("run"),
+                    "stepper": ev.get("stepper"),
+                    "xla_bytes_per_step": ev.get("xla_bytes_per_step"),
+                    "model_bytes_ratio": ev.get("model_bytes_ratio"),
+                    "bytes_within_tolerance": ev.get(
+                        "bytes_within_tolerance"
+                    ),
+                    "achieved_gbs": ev.get("achieved_gbs"),
+                    "peak_gbs": ev.get("peak_gbs"),
+                    "measured_bw_pct": ev.get("measured_bw_pct"),
+                })
+            elif kind == "mem" and name == "watermark":
+                rec = memory.setdefault(
+                    f"proc{s.proc}",
+                    {"peak_bytes": 0, "limit_bytes": None,
+                     "source": None, "samples": 0},
+                )
+                rec["samples"] += 1
+                rec["peak_bytes"] = max(
+                    rec["peak_bytes"], int(ev.get("peak_bytes", 0) or 0)
+                )
+                if ev.get("limit_bytes") is not None:
+                    rec["limit_bytes"] = ev["limit_bytes"]
+                rec["source"] = ev.get("source") or rec["source"]
+    return {
+        "tolerance_factor": tol,
+        "executables": executables,
+        "runs": runs,
+        "memory": memory,
+    }
+
+
 def perf_events(streams: List[Stream]) -> dict:
     """Step-time outlier record: every ``perf:outlier`` the live watch
     emitted, plus the final ``perf:histogram`` per process."""
@@ -489,6 +571,10 @@ class TraceReport:
     rungs: List[dict]
     critical_path: dict
     perf: dict
+    # measured executable introspection (xla:cost / xla:measured /
+    # mem:watermark events) — empty lists/dicts on streams from runs
+    # that predate the capture layer
+    xla: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -565,6 +651,44 @@ class TraceReport:
                     f"{o['step_seconds']:.4f} s/step "
                     f"(median {o['median']:.4f}, "
                     f"threshold {o['threshold']:.4f})")
+        if self.xla.get("executables") or self.xla.get("memory"):
+            add("-" * 68)
+            add(" measured vs modeled (XLA executable introspection; "
+                f"band {self.xla.get('tolerance_factor')}x)")
+            if self.xla.get("executables"):
+                add(f"   {'key':<18} {'stepper':<20} {'xla B/step':>12} "
+                    f"{'model B':>12} {'ratio':>7} {'flag':>11}")
+                for e in self.xla["executables"][:20]:
+                    ratio = e.get("model_bytes_ratio")
+                    flag = (
+                        "-" if e.get("within_tolerance") is None
+                        else ("ok" if e["within_tolerance"]
+                              else "DISCREPANT")
+                    )
+                    add(
+                        f"   {str(e.get('key'))[:18]:<18} "
+                        f"{str(e.get('stepper'))[:20]:<20} "
+                        f"{e.get('xla_bytes', 0):>12,.0f} "
+                        f"{(e.get('model_bytes') or 0):>12,.0f} "
+                        f"{(f'{ratio:.2f}' if ratio is not None else '-'):>7} "
+                        f"{flag:>11}"
+                    )
+            for r in self.xla.get("runs", ()):
+                bw = r.get("measured_bw_pct")
+                add(
+                    f"   run {r.get('run')}: achieved "
+                    f"{r.get('achieved_gbs')} GB/s vs peak "
+                    f"{r.get('peak_gbs')} GB/s"
+                    + (f" ({bw}% of configured peak)"
+                       if bw is not None else "")
+                )
+            for proc, m in sorted(self.xla.get("memory", {}).items()):
+                line = (f"   {proc}: device-memory peak "
+                        f"{m['peak_bytes']:,} B [{m['source']}]")
+                if m.get("limit_bytes"):
+                    line += (f", headroom "
+                             f"{m['limit_bytes'] - m['peak_bytes']:,} B")
+                add(line)
         add("=" * 68)
         return "\n".join(lines)
 
@@ -590,4 +714,5 @@ def analyze(paths: Sequence[str]) -> TraceReport:
         rungs=rung_throughput(streams),
         critical_path=critical_path(streams),
         perf=perf_events(streams),
+        xla=measured_introspection(streams),
     )

@@ -51,6 +51,13 @@ code is VPU-bound and will not approach the compute roof, so the
 meaningful number on TPU is the HBM roofline). The CPU row is a nominal
 (50 GB/s, 100 GFLOP/s) placeholder so the plumbing is testable without
 hardware; CPU percentages are not performance claims.
+
+A *measured* calibration record (``telemetry/calibration.py`` — the
+best achieved rate any run's XLA-reported byte/FLOP counts have
+demonstrated on this rig) takes precedence over BOTH the defaults and
+the env assumptions (:func:`peak_rates` docstring); roofline
+percentages then read against demonstrated capability rather than a
+datasheet, and the autotuner prunes with measured peaks.
 """
 
 from __future__ import annotations
@@ -188,8 +195,7 @@ def deep_halo_recompute_factor(local_nz: int, G: int, k: int) -> float:
     return 1.0 + (k - 1) * G / float(local_nz)
 
 
-def peak_rates(backend: Optional[str] = None):
-    """(bytes/s, FLOP/s) peaks for a backend family, env-overridable."""
+def _backend_family(backend: Optional[str] = None) -> str:
     if backend is None:
         try:
             import jax
@@ -197,17 +203,55 @@ def peak_rates(backend: Optional[str] = None):
             backend = jax.default_backend()
         except Exception:
             backend = "cpu"
-    family = backend if backend in PEAKS else (
+    return backend if backend in PEAKS else (
         "tpu" if backend not in ("cpu", "gpu") else backend
     )
+
+
+def peak_rates(backend: Optional[str] = None):
+    """(bytes/s, FLOP/s) peaks for a backend family.
+
+    Precedence: a *measured* calibration record
+    (:mod:`telemetry.calibration` — the max achieved rate any run's XLA
+    byte/FLOP counts demonstrated on this rig) beats the env overrides
+    (``TPUCFD_PEAK_BYTES_PER_S``/``_FLOPS_PER_S``), which beat the
+    static per-backend defaults. Measured > assumed: set
+    ``TPUCFD_CALIBRATION_PATH=off`` to fall back to assumptions."""
+    info = peak_info(backend)
+    return info["bytes_per_s"], info["flops_per_s"]
+
+
+def peak_info(backend: Optional[str] = None) -> dict:
+    """:func:`peak_rates` plus provenance: where each peak came from
+    (``calibrated`` / ``env`` / ``default``) — carried in the tuner's
+    ``tune:candidates`` event so a pruning decision is auditable."""
+    family = _backend_family(backend)
     peak_b, peak_f = PEAKS[family]
+    src_b = src_f = "default"
     env_b = os.environ.get("TPUCFD_PEAK_BYTES_PER_S")
     env_f = os.environ.get("TPUCFD_PEAK_FLOPS_PER_S")
     if env_b:
-        peak_b = float(env_b)
+        peak_b, src_b = float(env_b), "env"
     if env_f:
-        peak_f = float(env_f)
-    return peak_b, peak_f
+        peak_f, src_f = float(env_f), "env"
+    try:
+        from multigpu_advectiondiffusion_tpu.telemetry import calibration
+
+        cal = calibration.lookup(family)
+    except Exception:
+        cal = None
+    if cal:
+        if cal.get("bytes_per_s"):
+            peak_b, src_b = float(cal["bytes_per_s"]), "calibrated"
+        if cal.get("flops_per_s"):
+            peak_f, src_f = float(cal["flops_per_s"]), "calibrated"
+    return {
+        "backend": family,
+        "bytes_per_s": peak_b,
+        "flops_per_s": peak_f,
+        "bytes_source": src_b,
+        "flops_source": src_f,
+    }
 
 
 def roofline(
@@ -314,12 +358,50 @@ def summarize_run(
     return out
 
 
+def _dispatch_step_memory(solver, state) -> Optional[dict]:
+    """XLA memory accounting of the solver's OWN dispatched step
+    executable — captured by the measured-introspection layer
+    (``telemetry/xprof.py``) at dispatch, so no second copy of the step
+    is lowered or compiled just to inspect it. Runs one step to
+    populate the dispatch cache when nothing has executed yet."""
+    from multigpu_advectiondiffusion_tpu.telemetry import xprof
+
+    def step_record():
+        for r in reversed(xprof.records(solver)):
+            if r.key == "step" and (
+                r.argument_bytes or r.output_bytes or r.temp_bytes
+            ):
+                return r
+        return None
+
+    rec = step_record()
+    if rec is None and xprof.enabled():
+        try:
+            solver.step(state)
+        except Exception:
+            return None
+        rec = step_record()
+    if rec is None:
+        return None
+    return {
+        "argument_size_in_bytes": rec.argument_bytes,
+        "output_size_in_bytes": rec.output_bytes,
+        "temp_size_in_bytes": rec.temp_bytes,
+        "generated_code_size_in_bytes": rec.generated_code_bytes,
+    }
+
+
 def solver_memory_cross_check(solver, state,
                               stepper: Optional[str] = None) -> Optional[dict]:
     """Cross-check the static model against XLA's OWN memory accounting
     for one compiled step of ``solver`` (tests/test_telemetry.py holds
-    the two within documented bounds — the tier-1 promotion of the
-    dormant :func:`xla_memory_analysis` hook).
+    the two within documented bounds).
+
+    The accounting comes from the dispatch layer's already-compiled
+    step executable (:func:`_dispatch_step_memory` — the measured
+    introspection captured at ``dispatch:build``); only when that layer
+    is disabled does the legacy :func:`xla_memory_analysis` hook
+    lower+compile a standalone copy.
 
     Returns ``None`` where the backend exposes no accounting; otherwise
     a dict with the model's :class:`StepCost`, XLA's byte attributes,
@@ -331,7 +413,9 @@ def solver_memory_cross_check(solver, state,
     )
     if cost is None:
         return None
-    mem = xla_memory_analysis(solver.step, state)
+    mem = _dispatch_step_memory(solver, state)
+    if mem is None:
+        mem = xla_memory_analysis(solver.step, state)
     if mem is None:
         return None
     import numpy as np
@@ -351,11 +435,15 @@ def solver_memory_cross_check(solver, state,
 
 
 def xla_memory_analysis(fn, *args) -> Optional[dict]:
-    """Cross-check hook: lower+compile ``fn(*args)`` and read XLA's own
-    ``memory_analysis()`` where the backend provides one (TPU does;
-    CPU's is often absent/empty → ``None``). Returns a plain dict of the
-    byte-sized attributes so tests can compare magnitudes against the
-    static model without depending on the exact HLO schedule."""
+    """Generic introspection hook: lower+compile ``fn(*args)`` and read
+    XLA's own ``memory_analysis()`` where the backend provides one.
+    This compiles a standalone copy of ``fn`` — for a solver's own step
+    the dispatch path reuses its already-compiled executable instead
+    (:func:`_dispatch_step_memory` via ``telemetry/xprof.py``); this
+    hook remains for ad-hoc callables and as the disabled-introspection
+    fallback. Returns a plain dict of the byte-sized attributes so
+    tests can compare magnitudes against the static model without
+    depending on the exact HLO schedule."""
     try:
         import jax
 

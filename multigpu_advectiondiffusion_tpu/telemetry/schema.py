@@ -74,6 +74,18 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "histogram": {"edges", "counts", "chunks"},
     },
     "summary": {None: {"seconds", "mlups"}},
+    # measured introspection (telemetry/xprof.py): per-executable XLA
+    # cost/memory capture at dispatch, and the per-run measured-vs-
+    # modeled reconciliation
+    "xla": {
+        "cost": {"key", "flops", "bytes_accessed", "compile_seconds"},
+        "measured": {"run", "xla_bytes_per_step", "xla_flops_per_step"},
+    },
+    # chunk-cadence device-memory watermarks (device.memory_stats or
+    # the live-arrays census fallback)
+    "mem": {"watermark": {"bytes_in_use", "peak_bytes", "source"}},
+    # measured-peak calibration writes (telemetry/calibration.py)
+    "calib": {"update": {"backend", "path", "persisted"}},
     "crash": {None: {"message"}},
 }
 

@@ -13,7 +13,12 @@ module replaces that last hand-tuned heuristic with *measurement*:
 2. seed with the PR 3 cost model (``telemetry/costmodel``): modeled
    step time = max(HBM, FLOP) roofline x the deep-halo recompute factor
    + the exchange latency/bandwidth term — candidates far off the
-   modeled best are pruned before any device time is spent;
+   modeled best are pruned before any device time is spent. The peak
+   rates behind that roofline consult the measured calibration record
+   (``telemetry/calibration.py``) ahead of the env-assumed defaults,
+   so once any run has demonstrated real bandwidth on this rig the
+   pruning runs on measured rather than assumed peaks (the
+   ``tune:candidates`` event carries the provenance);
 3. time the survivors with the bench harness's own ``timed_run``
    (median-of-reps, same sync discipline as every published number);
 4. persist the winner to the atomic JSON cache (``tuning/cache.py``),
@@ -264,6 +269,11 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
         )
     _emit(
         "candidates", key=key,
+        # pruning-peak provenance: modeled_us was computed against
+        # these rates — "calibrated" means a measured peak
+        # (telemetry/calibration.py) replaced the env/default
+        # assumption, i.e. the tuner pruned with measured numbers
+        peaks=costmodel.peak_info(backend),
         considered=[
             {k: c[k] for k in ("impl", "steps_per_exchange",
                                "modeled_us", "pruned")}

@@ -24,17 +24,50 @@ def _fetch_sync(x):
         sync(x)
 
 
+# jax.profiler supports exactly one trace per process; this flag makes
+# trace() idempotent (a nested/duplicate request no-ops instead of
+# raising) and lets the recovery path below distinguish "we hold the
+# trace" from "someone else leaked one".
+_trace_active = [False]
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Capture a device trace: ``with trace('/tmp/trace'): run(...)``.
 
     View with TensorBoard (profile plugin) or Perfetto.
+
+    Exception-safe and idempotent: the trace is stopped on EVERY exit
+    path (an exception raised mid-solve can never leak an open
+    ``jax.profiler`` trace that poisons the process's next
+    ``start_trace``); a nested ``trace()`` inside an active one is a
+    no-op (one capture, the outer owner closes it); and if a *previous*
+    context leaked an open trace anyway (e.g. a hard-killed thread),
+    the stale trace is stopped and the capture retried once instead of
+    failing every later profiling request in the process.
     """
-    jax.profiler.start_trace(log_dir)
+    if _trace_active[0]:
+        yield  # nested request: the outer trace already captures this
+        return
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception:
+        # a leaked open trace from a poisoned predecessor: close it and
+        # retry once — a second failure is a real error and propagates
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        jax.profiler.start_trace(log_dir)
+    _trace_active[0] = True
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        _trace_active[0] = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass  # teardown must never mask the body's exception
 
 
 class Stopwatch:

@@ -25,8 +25,10 @@ from multigpu_advectiondiffusion_tpu.utils.metrics import (
 # Version of the summary JSON layout. Bumped whenever fields change
 # meaning or move, so downstream BENCH tooling can branch instead of
 # guessing. History: 1 = implicit pre-schema layout (PRs 0-2);
-# 2 = adds schema/cost_model/roofline_pct/mass_drift.
-SUMMARY_SCHEMA = 2
+# 2 = adds schema/cost_model/roofline_pct/mass_drift; 3 = adds the
+# measured-introspection blocks (memory watermarks, per-executable XLA
+# cost capture: memory/xla fields).
+SUMMARY_SCHEMA = 3
 
 
 @dataclasses.dataclass
@@ -60,6 +62,16 @@ class RunSummary:
     # HBM bytes / FLOPs per step for the ENGAGED stepper plus the
     # roofline-efficiency percentage of the measured rate
     cost_model: Optional[dict] = None
+    # measured device-memory watermarks (telemetry.xprof): run-level
+    # peak bytes in use, backend limit and headroom, sample source
+    # (device_stats | live_arrays) — absent when nothing sampled
+    memory: Optional[dict] = None
+    # measured XLA introspection (telemetry.xprof.measured_summary):
+    # the primary executable's XLA-reported bytes/FLOPs per step next
+    # to the cost model's prediction (ratio + tolerance-band flag),
+    # achieved rates vs the configured peaks, compile seconds — absent
+    # when no executable was captured (TPUCFD_XPROF=0)
+    xla: Optional[dict] = None
 
     @property
     def num_cells(self) -> int:
@@ -169,6 +181,33 @@ class RunSummary:
                 f"({c.get('achieved_gbs', 0)} GB/s, "
                 f"{c.get('achieved_gflops', 0)} GFLOP/s modeled)"
             )
+        if self.xla is not None:
+            x = self.xla
+            line = (
+                f"{x.get('xla_bytes_per_step', 0):,.0f} B/step, "
+                f"{x.get('xla_flops_per_step', 0):,.0f} FLOP/step "
+                f"(compile {x.get('compile_seconds', 0):.3f} s)"
+            )
+            print(f" xla measured       : {line}")
+            ratio = x.get("model_bytes_ratio")
+            if ratio is not None:
+                flag = (
+                    "ok" if x.get("bytes_within_tolerance")
+                    else "DISCREPANT"
+                )
+                print(
+                    f" model/measured B   : {ratio:.2f}x ({flag}, "
+                    f"band {x.get('tolerance_factor')}x)"
+                )
+        if self.memory is not None:
+            m = self.memory
+            line = (
+                f"peak {m.get('peak_bytes_in_use', 0):,} B in use "
+                f"[{m.get('source')}]"
+            )
+            if m.get("headroom_bytes") is not None:
+                line += f", headroom {m['headroom_bytes']:,} B"
+            print(f" device memory      : {line}")
         if self.error_l1 is not None:
             print(
                 f" error L1/L2/Linf   : {self.error_l1:.4e} / "

@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Measured-introspection smoke: run a short supervised solve end-to-end
+# and prove the xprof layer produced its evidence — per-executable
+# xla:cost events, chunk-cadence mem:watermark samples, the
+# xla:measured reconciliation and a persisted calibration write — in
+# the --metrics stream, the summary JSON and the calibration file.
+# Exits nonzero the moment any of them is missing.
+#
+#   ./out/profile_smoke.sh            # CPU (JAX_PLATFORMS honored)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export TPUCFD_CALIBRATION_PATH="$TMP/calibration.json"
+
+echo "profile_smoke: supervised diffusion3d solve (metrics -> $TMP)"
+python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+    --n 16 12 8 --iters 8 --sentinel-every 2 \
+    --save "$TMP/run" --metrics "$TMP/events.jsonl"
+
+python - "$TMP/events.jsonl" "$TMP/run/summary.json" \
+         "$TMP/calibration.json" <<'PY'
+import json, sys
+
+events_path, summary_path, calib_path = sys.argv[1:4]
+events = [json.loads(line) for line in open(events_path)]
+have = {(e["kind"], e["name"]) for e in events}
+
+missing = []
+def need(kind, name, check=None, what=""):
+    rows = [e for e in events if (e["kind"], e["name"]) == (kind, name)]
+    if not rows or (check and not all(check(e) for e in rows)):
+        missing.append(f"{kind}:{name} {what}".strip())
+    return rows
+
+need("xla", "cost",
+     lambda e: e.get("flops", 0) > 0 and e.get("bytes_accessed", 0) > 0,
+     "(nonzero XLA flops/bytes)")
+need("mem", "watermark", lambda e: e.get("bytes_in_use", 0) > 0,
+     "(nonzero bytes in use)")
+need("xla", "measured")
+need("calib", "update", lambda e: e.get("backend"), "(calibration write)")
+
+summary = json.load(open(summary_path))
+if not (summary.get("memory") or {}).get("peak_bytes_in_use"):
+    missing.append("summary.memory.peak_bytes_in_use")
+if not (summary.get("xla") or {}).get("xla_bytes_per_step"):
+    missing.append("summary.xla.xla_bytes_per_step")
+try:
+    calib = json.load(open(calib_path))
+    if not calib.get("entries"):
+        missing.append("calibration file has no entries")
+except Exception as exc:
+    missing.append(f"calibration file unreadable: {exc}")
+
+if missing:
+    print("profile_smoke: FAIL — missing measured evidence:")
+    for m in missing:
+        print(f"  - {m}")
+    sys.exit(1)
+print("profile_smoke: OK — xla:cost, mem:watermark, xla:measured and "
+      "the calibration write all present")
+PY
+
+echo "profile_smoke: tpucfd-trace measured-vs-modeled section"
+python -m multigpu_advectiondiffusion_tpu.cli trace "$TMP/events.jsonl" \
+    > "$TMP/trace_report.txt"
+grep -q "measured vs modeled" "$TMP/trace_report.txt" \
+    || { echo "profile_smoke: trace report lacks the measured section" >&2; exit 1; }
+echo "profile_smoke: OK"

@@ -34,6 +34,17 @@ os.environ.setdefault(
 os.environ.setdefault("TPUCFD_TUNE_ITERS", "2")
 os.environ.setdefault("TPUCFD_TUNE_REPS", "1")
 
+# measured-peak calibration must never read or write the user-level
+# record from tests. The per-test fixture below gives each in-process
+# test a fresh store; this session-level default covers SUBPROCESSES
+# whose env is snapshotted at module-import time (test_examples._ENV),
+# before any fixture runs.
+os.environ.setdefault(
+    "TPUCFD_CALIBRATION_PATH",
+    os.path.join(tempfile.mkdtemp(prefix="tpucfd_test_calib_"),
+                 "calibration.json"),
+)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -47,6 +58,24 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _isolate_calibration(tmp_path, monkeypatch):
+    """Measured-peak calibration (telemetry/calibration.py) takes
+    precedence over the env-assumed peaks in costmodel.peak_rates; a
+    record written by one test (any run_solver call observes one) must
+    never leak into another test's rooflines or tuner pruning — each
+    test gets a fresh, empty store. Also zero the watermark tracker so
+    one test's device-memory peak cannot bleed into the next."""
+    monkeypatch.setenv(
+        "TPUCFD_CALIBRATION_PATH", str(tmp_path / "calibration.json")
+    )
+    from multigpu_advectiondiffusion_tpu.telemetry import xprof
+
+    xprof.reset_watermarks()
+    yield
+    xprof.reset_watermarks()
 
 
 @pytest.fixture(autouse=True)
