@@ -129,6 +129,39 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "recovers via rollback WITHOUT a dt backoff "
                         "(0 = off; needs --sentinel-every; costs two "
                         "extra steps per check)")
+    p.add_argument("--diag-every", type=int, default=0, metavar="M",
+                   help="in-situ physics diagnostics: every M-th "
+                        "sentinel probe evaluates the fused observable "
+                        "suite (conservation budgets, total variation, "
+                        "spectral high-wavenumber tail, per-solver "
+                        "extras — all inside the sentinel's ONE jitted "
+                        "probe) and emits a phys:diag event; tolerance-"
+                        "rule breaches (max-principle, TV growth) emit "
+                        "phys:violation warnings; the trajectory lands "
+                        "in summary.json's diagnostics block for the "
+                        "science gate (0 = off; needs --sentinel-every)")
+    p.add_argument("--diag-strict", action="store_true",
+                   help="escalate a phys:violation into the rollback + "
+                        "dt-backoff retry path instead of a warning "
+                        "(needs --diag-every)")
+    p.add_argument("--snapshots", type=int, default=0, metavar="N",
+                   help="supervised field-snapshot streaming: write a "
+                        "downsampled snap_NNNNNN.bin every N steps "
+                        "through the double-buffered background writer "
+                        "(atomic publish, io:snapshot_write events; "
+                        "needs --sentinel-every — unsupervised runs use "
+                        "--snapshot-every)")
+    p.add_argument("--snapshot-stride", type=int, default=1, metavar="S",
+                   help="downsample snapshots by striding every axis "
+                        "(u[::S, ::S, ...]) before writing — 1/S^d of "
+                        "the field's bytes per snapshot (default 1)")
+    p.add_argument("--snapshot-max-bytes", type=int, default=0,
+                   metavar="N",
+                   help="rotation cap for snapshot files (both "
+                        "--snapshots and --snapshot-every): delete the "
+                        "oldest snapshots once their total exceeds N "
+                        "bytes, keeping the newest — the --metrics-max-"
+                        "bytes discipline for fields (0 = unbounded)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace of the timed "
                         "solve into DIR (TensorBoard/Perfetto viewable) — "
@@ -275,6 +308,11 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       watchdog_timeout=args.watchdog_timeout,
                       sdc_every=args.sdc_every,
                       progress=args.progress,
+                      diag_every=args.diag_every,
+                      diag_strict=args.diag_strict,
+                      snapshots=args.snapshots,
+                      snapshot_stride=args.snapshot_stride,
+                      snapshot_max_bytes=args.snapshot_max_bytes,
                       metrics_path=getattr(args, "metrics", None),
                       metrics_max_bytes=args.metrics_max_bytes)
 
@@ -322,6 +360,11 @@ def _run_burgers(args, ndim):
                       watchdog_timeout=args.watchdog_timeout,
                       sdc_every=args.sdc_every,
                       progress=args.progress,
+                      diag_every=args.diag_every,
+                      diag_strict=args.diag_strict,
+                      snapshots=args.snapshots,
+                      snapshot_stride=args.snapshot_stride,
+                      snapshot_max_bytes=args.snapshot_max_bytes,
                       metrics_path=getattr(args, "metrics", None),
                       metrics_max_bytes=args.metrics_max_bytes)
 

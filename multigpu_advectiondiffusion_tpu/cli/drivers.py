@@ -185,6 +185,11 @@ def _run_solver(
     dt_backoff: float = 0.5,
     sdc_every: int = 0,
     progress: bool = False,
+    diag_every: int = 0,
+    diag_strict: bool = False,
+    snapshots: int = 0,
+    snapshot_stride: int = 1,
+    snapshot_max_bytes: int = 0,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
@@ -365,7 +370,25 @@ def _run_solver(
             "--progress renders the supervisor's chunk-cadence events; "
             "it needs --sentinel-every > 0"
         )
-    if (periodic or (supervised and checkpoint_every)) and not save_dir:
+    if diag_every and not supervised:
+        raise ValueError(
+            "--diag-every rides the sentinel's jitted probe cadence; "
+            "it needs --sentinel-every > 0"
+        )
+    if diag_strict and not diag_every:
+        raise ValueError(
+            "--diag-strict escalates diagnostic violations; it needs "
+            "--diag-every > 0"
+        )
+    if snapshots and not supervised:
+        raise ValueError(
+            "--snapshots streams at the supervised chunk cadence; it "
+            "needs --sentinel-every > 0 (unsupervised periodic output: "
+            "--snapshot-every)"
+        )
+    if (
+        periodic or (supervised and (checkpoint_every or snapshots))
+    ) and not save_dir:
         raise ValueError("snapshot/checkpoint output needs save_dir")
 
     def _write_checkpoint(st):
@@ -424,6 +447,28 @@ def _run_solver(
                 _write_checkpoint(st)
                 io_acc[0] += time.perf_counter() - io_t0
 
+            # --snapshots: downsampled field-snapshot streaming through
+            # the double-buffered background writer (atomic publishes,
+            # rotation-capped by --snapshot-max-bytes). _fetch is a
+            # collective when sharded — every process calls, only the
+            # coordinator writes.
+            snap_streamer = None
+            save_snap = None
+            if snapshots:
+                if is_coord:
+                    snap_streamer = io_utils.SnapshotStreamer(
+                        save_dir, stride=snapshot_stride,
+                        max_bytes=snapshot_max_bytes,
+                    )
+
+                def save_snap(st):
+                    sync(st.u)
+                    io_t0 = time.perf_counter()
+                    u_host = _fetch(st.u)
+                    if snap_streamer is not None:
+                        snap_streamer.write(u_host, int(st.it))
+                    io_acc[0] += time.perf_counter() - io_t0
+
             # --progress: the coordinator renders the supervisor's
             # chunk-cadence progress events as one status line (other
             # ranks still emit the events into their own streams)
@@ -452,17 +497,28 @@ def _run_solver(
                     progress=(
                         progress_line.update if progress_line else None
                     ),
+                    diag_every=diag_every,
+                    diag_strict=diag_strict,
+                    snapshot_every=snapshots,
+                    save_snapshot=save_snap,
                 )
             finally:
                 if progress_line is not None:
                     progress_line.close()
+                if snap_streamer is not None:
+                    snap_streamer.close()
             sync(out.u)
-            io_s = io_acc[0] if checkpoint_every else None
+            io_s = io_acc[0] if (checkpoint_every or snapshots) else None
             best = time.perf_counter() - t0 - (io_s or 0.0)
         elif periodic:
             chunk = min(x for x in (snapshot_every, checkpoint_every) if x)
             io_s = 0.0  # shadows the outer None: periodic runs report it
-            with io_utils.AsyncBinaryWriter() as writer:
+            # the streamer wraps the async writer with atomic publishes,
+            # optional striding and the --snapshot-max-bytes rotation cap
+            with io_utils.SnapshotStreamer(
+                save_dir, stride=snapshot_stride,
+                max_bytes=snapshot_max_bytes,
+            ) as writer:
                 t0 = time.perf_counter()
                 out, done = state, 0
                 while done < iters:
@@ -495,12 +551,7 @@ def _run_solver(
                     )
                     if snap_now:
                         if is_coord:
-                            writer.submit(
-                                u_host,
-                                os.path.join(
-                                    save_dir, f"snap_{glob_it:06d}.bin"
-                                ),
-                            )
+                            writer.write(u_host, glob_it)
                     if ckpt_now:
                         if checkpoint_sharded:
                             # per-shard directory: no gather to one host

@@ -1,0 +1,29 @@
+"""In-situ physics diagnostics: the numerical-health layer.
+
+The reference's MATLAB ``Run.m`` harness plots fields and eyeballs
+every solver change against known solutions; this package is the
+machine-checked counterpart:
+
+* :mod:`physics` — the per-solver observable registry (conservation
+  budgets, total variation, max-principle bounds, spectral tail) whose
+  observables are fused into the divergence sentinel's ONE jitted
+  mesh-aware probe (``resilience/sentinel.py``) so the whole suite
+  costs at most one extra HBM pass and zero extra compiled programs,
+  plus the tolerance-guarded violation rules and the Gaussian-diffusion
+  decay-rate fit;
+* :mod:`compare` — the science regression gate: diff two rounds'
+  diagnostic trajectories with per-observable tolerance bands and exit
+  nonzero on drift (``out/science_gate.sh`` is the wrapper; the
+  numerics analog of ``bench/compare.py``).
+"""
+
+from multigpu_advectiondiffusion_tpu.diagnostics.physics import (  # noqa: F401
+    Observable,
+    ViolationRule,
+    check_violations,
+    gaussian_decay_fit,
+    max_principle_rule,
+    observables_for,
+    rules_for,
+    tv_monotone_rule,
+)

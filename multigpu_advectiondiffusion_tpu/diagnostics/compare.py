@@ -1,0 +1,332 @@
+"""Science regression gate: diff diagnostic trajectories between rounds.
+
+``bench/compare.py`` gates *throughput* between rounds; nothing gated
+the *numerics* — a perturbed stencil coefficient, a wrong dt, a broken
+flux split can leave MLUPS (and even smooth-case convergence order)
+intact while silently changing the physics. This module is the
+numerics gate: it diffs the per-observable diagnostic trajectories two
+rounds recorded (the supervisor's ``phys:diag`` suite, landed in
+``summary.json``'s ``diagnostics`` block) with per-observable relative
+tolerance bands, and exits nonzero on drift.
+
+Artifact format (produced by ``--extract`` from one or more
+``summary.json`` files; the committed rounds are ``SCIENCE_r0*.json``)::
+
+    {"schema": 1,
+     "runs": {"diffusion3d": {
+         "meta": {"solver": "DiffusionSolver", "ndim": 3, ...},
+         "observables": {"mass": [[step, value], ...], ...}}}}
+
+Comparison: trajectories align on common step indices; per observable
+the deviation is ``max_t |new - old| / max_t |old|`` (trajectory-scale
+relative — robust near zero crossings) and must sit inside the
+observable's band (:data:`TOLERANCE_BANDS`, default
+:data:`DEFAULT_BAND`). A run or observable present in the old round but
+absent from the new one is a coverage regression and fails; new ones
+are reported as ``added`` and never fail. ``time`` is itself an
+observable — a dt change drifts the time trajectory at fixed step
+indices and trips the gate even when the fields look plausible.
+
+Usage::
+
+    python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \\
+        --extract run_a/summary.json run_b/summary.json -o NEW.json
+    python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \\
+        NEW.json SCIENCE_r01.json
+
+Wrapper: ``out/science_gate.sh`` (canonical rounds + the
+injected-perturbation self-test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+ARTIFACT_SCHEMA = 1
+
+#: Per-observable relative tolerance bands. Conserved quantities sit at
+#: round-off; decaying budgets and the TV/spectral detectors get wider
+#: bands (platform-dependent reduction order, f32 accumulation).
+TOLERANCE_BANDS: Dict[str, float] = {
+    "mass": 1e-6,
+    "time": 1e-6,
+    "l1": 1e-4,
+    "l2": 1e-4,
+    "energy": 1e-4,
+    "max_abs": 1e-4,
+    "max": 1e-4,
+    "min": 1e-3,
+    "tv": 1e-3,
+    "spectral_tail": 5e-3,
+}
+DEFAULT_BAND = 1e-3
+
+#: Observables excluded from gating: ``mass_drift`` is the difference
+#: of two near-equal numbers (its relative scale is meaningless — the
+#: ``mass`` trajectory itself gates conservation).
+SKIP_OBSERVABLES = {"mass_drift"}
+
+
+# --------------------------------------------------------------------- #
+# Extraction: summary.json -> round artifact
+# --------------------------------------------------------------------- #
+def extract_run(summary: dict) -> Optional[dict]:
+    """One summary.json dict -> a run entry, or ``None`` when the run
+    recorded no diagnostics (unsupervised / --diag-every absent)."""
+    diag = summary.get("diagnostics") or (
+        (summary.get("resilience") or {}).get("diagnostics")
+    )
+    if not diag or not diag.get("trajectory"):
+        return None
+    observables: Dict[str, List[list]] = {}
+    for point in diag["trajectory"]:
+        step = point.get("step")
+        if step is None:
+            continue
+        for key, value in point.items():
+            if key == "step" or key in SKIP_OBSERVABLES:
+                continue
+            if isinstance(value, (int, float)):
+                observables.setdefault(key, []).append(
+                    [int(step), float(value)]
+                )
+    if not observables:
+        return None
+    return {"meta": dict(diag.get("meta") or {}), "observables": observables}
+
+
+def extract(summary_paths: List[str]) -> dict:
+    """Several runs' summary.json files -> one round artifact."""
+    runs = {}
+    for path in summary_paths:
+        with open(path) as f:
+            summary = json.load(f)
+        entry = extract_run(summary)
+        if entry is None:
+            raise SystemExit(
+                f"{path}: no diagnostic trajectory (run it supervised "
+                "with --sentinel-every and --diag-every)"
+            )
+        runs[summary.get("name", path)] = entry
+    return {"schema": ARTIFACT_SCHEMA, "runs": runs}
+
+
+def load_round(path: str) -> dict:
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or "runs" not in obj:
+        raise SystemExit(f"{path}: not a science-round artifact")
+    return obj
+
+
+# --------------------------------------------------------------------- #
+# Comparison
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass
+class GateRow:
+    run: str
+    observable: str
+    status: str  # ok | drift | missing | added | no_overlap
+    deviation: Optional[float] = None
+    band: Optional[float] = None
+    steps: int = 0
+
+    def line(self) -> str:
+        name = f"{self.run}/{self.observable}"
+        if self.status in ("missing", "added", "no_overlap"):
+            return f"  {self.status.upper():>10}  {name}"
+        tag = "DRIFT" if self.status == "drift" else "ok"
+        return (
+            f"  {tag:>10}  {name}: max rel deviation "
+            f"{self.deviation:.3e} (band {self.band:.1e}, "
+            f"{self.steps} step(s))"
+        )
+
+
+@dataclasses.dataclass
+class GateResult:
+    rows: List[GateRow]
+    notes: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def regressions(self) -> List[GateRow]:
+        return [r for r in self.rows
+                if r.status in ("drift", "missing", "no_overlap")]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "rows": [dataclasses.asdict(r) for r in self.rows],
+            "notes": list(self.notes),
+        }
+
+    def format_text(self) -> str:
+        lines = ["science gate:"]
+        lines += [r.line() for r in self.rows]
+        for note in self.notes:
+            lines.append(f"        note  {note}")
+        lines.append(
+            "science gate: PASS"
+            if self.ok
+            else f"science gate: FAIL ({len(self.regressions)} "
+                 "regression(s))"
+        )
+        return "\n".join(lines)
+
+
+def _band_for(observable: str, bands: Dict[str, float],
+              default_band: float) -> float:
+    return bands.get(observable, default_band)
+
+
+def compare(
+    new_round: dict,
+    old_round: dict,
+    bands: Optional[Dict[str, float]] = None,
+    default_band: float = DEFAULT_BAND,
+) -> GateResult:
+    """Per-(run, observable) trajectory diff of two rounds."""
+    bands = dict(TOLERANCE_BANDS, **(bands or {}))
+    rows: List[GateRow] = []
+    notes: List[str] = []
+    old_runs = old_round.get("runs", {})
+    new_runs = new_round.get("runs", {})
+    for run in sorted(set(old_runs) | set(new_runs)):
+        old = old_runs.get(run)
+        new = new_runs.get(run)
+        if old is None:
+            rows.append(GateRow(run, "*", "added"))
+            continue
+        if new is None:
+            rows.append(GateRow(run, "*", "missing"))
+            continue
+        old_obs = old.get("observables", {})
+        new_obs = new.get("observables", {})
+        for obs in sorted(set(old_obs) | set(new_obs)):
+            if obs in SKIP_OBSERVABLES:
+                continue
+            if obs not in old_obs:
+                notes.append(f"{run}/{obs}: new observable (added)")
+                continue
+            if obs not in new_obs:
+                rows.append(GateRow(run, obs, "missing"))
+                continue
+            old_t = {int(s): float(v) for s, v in old_obs[obs]}
+            new_t = {int(s): float(v) for s, v in new_obs[obs]}
+            common = sorted(set(old_t) & set(new_t))
+            if not common:
+                rows.append(GateRow(run, obs, "no_overlap"))
+                continue
+            scale = max(abs(old_t[s]) for s in common)
+            dev = max(abs(new_t[s] - old_t[s]) for s in common) / max(
+                scale, 1e-30
+            )
+            band = _band_for(obs, bands, default_band)
+            rows.append(
+                GateRow(
+                    run, obs,
+                    "drift" if dev > band else "ok",
+                    deviation=round(dev, 10), band=band,
+                    steps=len(common),
+                )
+            )
+    result = GateResult(rows, notes=notes)
+    # the verdict is itself telemetry when a sink is installed (the
+    # soak/CI hook's stream records every gate run it performed)
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    sink = telemetry.get_sink()
+    if sink.active:
+        sink.event(
+            "science", "gate",
+            ok=result.ok, regressions=len(result.regressions),
+            rows=len(result.rows),
+        )
+    return result
+
+
+# --------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------- #
+def main(argv=None) -> None:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="multigpu_advectiondiffusion_tpu.diagnostics.compare",
+        description="science regression gate: diff diagnostic "
+                    "trajectories between rounds (nonzero exit on "
+                    "drift)",
+    )
+    ap.add_argument("new", nargs="?", default=None,
+                    help="fresh round artifact (see --extract)")
+    ap.add_argument("old", nargs="?", default=None,
+                    help="prior round to diff against (e.g. the newest "
+                         "SCIENCE_r0*.json)")
+    ap.add_argument("--extract", nargs="+", default=None,
+                    metavar="SUMMARY",
+                    help="build a round artifact from one or more "
+                         "summary.json files (runs recorded with "
+                         "--diag-every) instead of comparing")
+    ap.add_argument("-o", "--out", default=None, metavar="PATH",
+                    help="write the extracted artifact (with --extract) "
+                         "or the JSON result (compare mode) to PATH")
+    ap.add_argument("--band", action="append", default=[],
+                    metavar="OBS=TOL",
+                    help="override one observable's relative tolerance "
+                         "band (repeatable)")
+    ap.add_argument("--default-band", type=float, default=DEFAULT_BAND,
+                    help="band for observables without a specific entry "
+                         f"(default {DEFAULT_BAND})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable result on stdout")
+    args = ap.parse_args(argv)
+
+    if args.extract:
+        if args.new is not None or args.old is not None:
+            ap.error("--extract takes summary.json paths only")
+        artifact = extract(args.extract)
+        text = json.dumps(artifact, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+            print(
+                f"science round: {len(artifact['runs'])} run(s) -> "
+                f"{args.out}"
+            )
+        else:
+            print(text)
+        return
+
+    if not args.new or not args.old:
+        ap.error("provide NEW and OLD round artifacts (or --extract)")
+    bands = {}
+    for spec in args.band:
+        name, _, val = spec.partition("=")
+        try:
+            bands[name.strip()] = float(val)
+        except ValueError:
+            ap.error(f"bad --band {spec!r} (want OBS=TOL)")
+    result = compare(
+        load_round(args.new), load_round(args.old),
+        bands=bands, default_band=args.default_band,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result.to_dict(), f, indent=2)
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        print(result.format_text())
+    if not result.ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,300 @@
+"""Per-solver physics-observable registry + violation rules.
+
+The design constraint is the one the TPU scientific-computing framework
+(PAPERS arXiv 2108.11076) imposes on its own analysis observables:
+diagnostics are computed *on device, inside the program that is already
+running* — here, fused into the divergence sentinel's single jitted
+mesh-aware probe (``resilience/sentinel.py make_health_probe``), so the
+whole suite rides the probe's existing HBM pass and adds ZERO extra
+compiled programs (proven by ``tests/test_diagnostics.py``'s
+compile-count test).
+
+An :class:`Observable` contributes device-side scalar reductions (the
+shard-local ``local`` closure runs inside the probe's jitted block; its
+raw values are reduced across the mesh by the solver's own
+``mesh_reduce_sum``/``mesh_reduce_max``) plus a host-side ``finalize``
+mapping raw reductions to named physical quantities. A
+:class:`ViolationRule` is a host-side tolerance check of the finalized
+stats against the baseline armed on the initial state — the supervisor
+turns breaches into ``phys:violation`` events (and, under
+``--diag-strict``, into the rollback path).
+
+Standard suite (every solver):
+
+* conservation budgets — ``mass`` (the sentinel's own ∫u), ``l1``
+  (∫|u|), ``energy`` (∫u²), ``l2``/``max_abs`` (the sentinel's own);
+* ``tv`` — total variation, summed over axes. Computed shard-local
+  (jumps across shard interfaces are excluded — bounded by the
+  interface values, well inside the monotonicity tolerance);
+* ``spectral_tail`` — the fraction of spectral energy in the top third
+  of wavenumbers along the innermost axis: the cheapest
+  under-resolution detector (a resolved field's tail decays; energy
+  piling up at the grid cutoff precedes the blow-ups the divergence
+  sentinel only sees later). Registered only when the innermost axis
+  is unsharded (the rFFT is a local op there).
+
+Per-solver additions come from ``SolverBase.diagnostics_spec()``:
+diffusion registers the maximum-principle rule (pure diffusion with
+clamped boundaries can create no new extremum), WENO Burgers the
+TV-monotonicity rule (essentially non-oscillatory ⇒ total variation
+bounded by the initial data's), and the Gaussian-diffusion workload the
+analytic amplitude decay rate ``-d/2`` the measured fit
+(:func:`gaussian_decay_fit`) reads against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Observable:
+    """One fused diagnostic: device-side scalar contributions + the
+    host-side mapping to named physical quantities.
+
+    ``local(u)`` runs inside the probe's jitted block on the f32
+    shard-local field and returns a ``(len(keys),)`` vector; all of an
+    observable's scalars share one ``reduction`` ("sum" via
+    ``mesh_reduce_sum``, "max" via ``mesh_reduce_max``). ``finalize``
+    maps the dict of globally-reduced raw scalars to the dict of final
+    values (volume scaling, derived ratios); default = identity on
+    ``keys``."""
+
+    name: str
+    keys: Tuple[str, ...]
+    reduction: str  # "sum" | "max"
+    local: Callable
+    finalize: Optional[Callable] = None  # (solver, raw: dict) -> dict
+    # names of the FINALIZED values (what lands in stats/trajectories);
+    # None = same as ``keys`` (identity finalize / per-key scaling)
+    outputs: Optional[Tuple[str, ...]] = None
+
+    @property
+    def output_keys(self) -> Tuple[str, ...]:
+        return self.outputs if self.outputs is not None else self.keys
+
+    def finalize_raw(self, solver, raw: Dict[str, float]) -> Dict[str, float]:
+        if self.finalize is None:
+            return {k: raw[k] for k in self.keys}
+        return self.finalize(solver, raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViolationRule:
+    """Host-side tolerance check of finalized stats vs the armed
+    baseline. ``check(stats, baseline, tolerance)`` returns a violation
+    message, or ``None`` when the invariant holds."""
+
+    name: str
+    tolerance: float
+    check: Callable
+
+
+# --------------------------------------------------------------------- #
+# The standard fused observable suite
+# --------------------------------------------------------------------- #
+def _tv_local(u):
+    """Shard-local total variation: sum over axes of |forward diff|."""
+    import jax.numpy as jnp
+
+    tv = jnp.zeros((), jnp.float32)
+    for ax in range(u.ndim):
+        tv = tv + jnp.sum(jnp.abs(jnp.diff(u, axis=ax)))
+    return jnp.stack([tv])
+
+
+def _spectral_local(u):
+    """Spectral energy (total, high-wavenumber tail) along the innermost
+    axis — |rfft|² summed over the top third of wavenumbers and over
+    everything; the ratio is derived host-side from the two psums."""
+    import jax.numpy as jnp
+
+    spec = jnp.abs(jnp.fft.rfft(u, axis=-1)) ** 2
+    k = spec.shape[-1]
+    cut = max(1, (2 * k) // 3)
+    return jnp.stack(
+        [jnp.sum(spec), jnp.sum(spec[..., cut:])]
+    ).astype(jnp.float32)
+
+
+def standard_observables(solver) -> List[Observable]:
+    """The suite every solver gets; per-solver extras ride
+    ``diagnostics_spec()['observables']``."""
+    vol = math.prod(solver.grid.spacing)
+
+    def _vol_scale(key):
+        def fin(_solver, raw, _k=key, _v=vol):
+            return {_k: _v * raw[_k]}
+
+        return fin
+
+    def _l1_local(u):
+        import jax.numpy as jnp
+
+        return jnp.stack([jnp.sum(jnp.abs(u))])
+
+    def _energy_local(u):
+        import jax.numpy as jnp
+
+        return jnp.stack([jnp.sum(u * u)])
+
+    def _spec_finalize(_solver, raw):
+        total = raw["spec_total"]
+        tail = raw["spec_hi"]
+        ratio = tail / total if total > 0 and math.isfinite(total) else 0.0
+        return {"spectral_tail": ratio}
+
+    obs = [
+        Observable("l1", ("l1",), "sum", _l1_local, _vol_scale("l1")),
+        Observable("energy", ("energy",), "sum", _energy_local,
+                   _vol_scale("energy")),
+        Observable("tv", ("tv",), "sum", _tv_local),
+    ]
+    # the rFFT is local only along an unsharded axis; skip the detector
+    # (rather than gather) when the innermost axis is decomposed
+    innermost = solver.grid.ndim - 1
+    if innermost not in solver._sharded_axes() and (
+        solver.grid.shape[-1] >= 8
+    ):
+        obs.append(
+            Observable("spectral", ("spec_total", "spec_hi"), "sum",
+                       _spectral_local, _spec_finalize,
+                       outputs=("spectral_tail",))
+        )
+    return obs
+
+
+def observables_for(solver) -> List[Observable]:
+    """The fused diagnostic suite for one solver: the standard set plus
+    whatever ``solver.diagnostics_spec()`` registers."""
+    spec = diagnostics_spec(solver)
+    return standard_observables(solver) + list(spec.get("observables", ()))
+
+
+def diagnostics_spec(solver) -> dict:
+    spec = getattr(solver, "diagnostics_spec", None)
+    return spec() if callable(spec) else {}
+
+
+def rules_for(solver) -> List[ViolationRule]:
+    return list(diagnostics_spec(solver).get("rules", ()))
+
+
+def meta_for(solver) -> dict:
+    """Per-solver fields riding every ``phys:diag`` event (solver class,
+    ndim, the analytic decay rate where one exists) — what the trace
+    analyzer's physics section keys its fits on."""
+    meta = {"solver": type(solver).__name__, "ndim": solver.grid.ndim}
+    meta.update(diagnostics_spec(solver).get("meta", {}))
+    return meta
+
+
+# --------------------------------------------------------------------- #
+# Violation rules
+# --------------------------------------------------------------------- #
+def max_principle_rule(tolerance: float = 1e-3) -> ViolationRule:
+    """Pure diffusion with clamped/zero-gradient boundaries satisfies
+    the discrete maximum principle up to the 4th-order stencil's
+    non-monotone wiggle: no new global extremum beyond the initial
+    field's, within ``tolerance`` of the initial range."""
+
+    def check(stats, baseline, tol):
+        scale = max(
+            1.0, abs(baseline.get("max", 0.0)), abs(baseline.get("min", 0.0))
+        )
+        band = tol * scale
+        if stats["max"] > baseline["max"] + band:
+            return (
+                f"maximum principle: max {stats['max']:.6g} exceeds "
+                f"initial max {baseline['max']:.6g} + {band:.3g}"
+            )
+        if stats["min"] < baseline["min"] - band:
+            return (
+                f"maximum principle: min {stats['min']:.6g} undercuts "
+                f"initial min {baseline['min']:.6g} - {band:.3g}"
+            )
+        return None
+
+    return ViolationRule("max_principle", tolerance, check)
+
+
+def tv_monotone_rule(tolerance: float = 0.05) -> ViolationRule:
+    """WENO on a scalar conservation law is essentially non-oscillatory:
+    total variation stays bounded by the initial data's (the 'E' in
+    ENO). Growth past ``tolerance`` (relative) means spurious
+    oscillation — the regression the smooth-case convergence order
+    cannot see."""
+
+    def check(stats, baseline, tol):
+        tv0 = baseline.get("tv")
+        tv = stats.get("tv")
+        if tv0 is None or tv is None:
+            return None
+        bound = tv0 * (1.0 + tol) + 1e-12
+        if tv > bound:
+            return (
+                f"TV monotonicity: total variation {tv:.6g} grew past "
+                f"the initial {tv0:.6g} (+{100 * tol:.1f}% tolerance)"
+            )
+        return None
+
+    return ViolationRule("tv_monotone", tolerance, check)
+
+
+def check_violations(
+    rules: Sequence[ViolationRule], stats: dict, baseline: Optional[dict]
+) -> List[dict]:
+    """Evaluate every rule; returns violation records (empty = clean)."""
+    if not baseline:
+        return []
+    out = []
+    for rule in rules:
+        msg = rule.check(stats, baseline, rule.tolerance)
+        if msg:
+            out.append(
+                {"rule": rule.name, "message": msg,
+                 "tolerance": rule.tolerance}
+            )
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Gaussian-diffusion decay-rate fit
+# --------------------------------------------------------------------- #
+def gaussian_decay_fit(
+    times: Sequence[float], maxima: Sequence[float],
+    analytic_rate: Optional[float] = None,
+) -> Optional[dict]:
+    """Least-squares slope of ``log(max u)`` vs ``log t`` over a
+    diagnostic trajectory.
+
+    The heat-kernel workload's exact amplitude is
+    ``(t0/t)^{d/2}`` — a straight line of slope ``-d/2`` in log-log —
+    so the fitted slope is a *measured* decay rate read directly
+    against the analytic one (the machine-checked version of the
+    ``Run.m`` harness eyeballing the decaying field plots). ``None``
+    when fewer than 3 usable (t>0, max>0) points exist."""
+    pts = [
+        (math.log(t), math.log(m))
+        for t, m in zip(times, maxima)
+        if t > 0 and m > 0 and math.isfinite(m)
+    ]
+    if len(pts) < 3:
+        return None
+    n = len(pts)
+    mx = sum(x for x, _ in pts) / n
+    my = sum(y for _, y in pts) / n
+    var = sum((x - mx) ** 2 for x, _ in pts)
+    if var <= 0:
+        return None
+    cov = sum((x - mx) * (y - my) for x, y in pts)
+    slope = cov / var
+    out = {"measured_rate": slope, "points": n}
+    if analytic_rate is not None:
+        out["analytic_rate"] = float(analytic_rate)
+        out["rel_err"] = abs(slope - analytic_rate) / max(
+            abs(analytic_rate), 1e-30
+        )
+    return out

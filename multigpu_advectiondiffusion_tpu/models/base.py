@@ -150,6 +150,23 @@ class SolverBase:
     def build_local(self, ctx: StepContext) -> LocalPhysics:
         raise NotImplementedError
 
+    def diagnostics_spec(self) -> dict:
+        """Per-solver in-situ physics-diagnostics contract
+        (``diagnostics/physics.py``). Optional keys:
+
+        * ``observables`` — extra :class:`~.diagnostics.physics.
+          Observable` entries fused into the sentinel's jitted probe
+          beyond the standard suite (budgets/TV/spectral tail);
+        * ``rules`` — :class:`~.diagnostics.physics.ViolationRule`
+          tolerance checks of the probed stats against the run-initial
+          baseline (max-principle, TV-monotonicity, ...);
+        * ``meta`` — fields riding every ``phys:diag`` event (e.g. the
+          analytic decay rate the trace analyzer fits against).
+
+        The base class registers nothing: every solver still gets the
+        standard suite; overrides add what their physics guarantees."""
+        return {}
+
     # ------------------------------------------------------------------ #
     # Config plumbing
     # ------------------------------------------------------------------ #

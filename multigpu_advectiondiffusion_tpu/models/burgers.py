@@ -117,6 +117,20 @@ class BurgersSolver(SolverBase):
             return "xla"
         return impl
 
+    def diagnostics_spec(self) -> dict:
+        """In-situ diagnostics contract: WENO on the convex Burgers flux
+        is essentially non-oscillatory — total variation is bounded by
+        the initial data's, so the TV-monotonicity tolerance rule
+        (``diagnostics/physics.py``) catches spurious oscillation (a
+        flux-split sign error, a broken smoothness weight) that leaves
+        smooth-case convergence order intact."""
+        from multigpu_advectiondiffusion_tpu.diagnostics import physics
+
+        spec = {"rules": [], "meta": {}}
+        if self.cfg.flux == "burgers":
+            spec["rules"].append(physics.tv_monotone_rule())
+        return spec
+
     def build_local(self, ctx: StepContext) -> LocalPhysics:
         cfg = self.cfg
         spacing = cfg.grid.spacing

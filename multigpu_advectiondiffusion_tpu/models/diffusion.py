@@ -124,6 +124,28 @@ class DiffusionSolver(SolverBase):
             return name, {"t0": self.cfg.t0, "diffusivity": self.cfg.diffusivity}
         return name, {}
 
+    def diagnostics_spec(self) -> dict:
+        """In-situ diagnostics contract (``diagnostics/physics.py``):
+
+        * pure diffusion (no source) on a Cartesian grid satisfies the
+          discrete maximum principle — register the tolerance rule so a
+          new extremum (over-steep dt, broken stencil coefficient)
+          surfaces as a ``phys:violation`` before the norm sentinel
+          ever trips;
+        * the heat-kernel workload's amplitude decays at the analytic
+          rate ``-d/2`` in ``log max u`` vs ``log t`` — recorded as
+          ``decay_rate_analytic`` so the measured fit
+          (``gaussian_decay_fit``; trace-report "physics" section)
+          reads against it."""
+        from multigpu_advectiondiffusion_tpu.diagnostics import physics
+
+        spec = {"rules": [], "meta": {}}
+        if self.cfg.source is None and self.cfg.geometry == "cartesian":
+            spec["rules"].append(physics.max_principle_rule())
+        if self.cfg.ic == "heat_kernel" and self.cfg.geometry == "cartesian":
+            spec["meta"]["decay_rate_analytic"] = -self.grid.ndim / 2.0
+        return spec
+
     def build_local(self, ctx: StepContext) -> LocalPhysics:
         cfg = self.cfg
         grid = cfg.grid

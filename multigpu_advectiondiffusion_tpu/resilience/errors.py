@@ -50,6 +50,26 @@ class SDCDetectedError(SolverDivergedError):
         )
 
 
+class PhysicsViolationError(SolverDivergedError):
+    """A tolerance-guarded physics invariant broke (maximum-principle
+    breach, total-variation growth — ``diagnostics/physics.py``) while
+    the field was still finite and inside the norm bound.
+
+    Raised only under the opt-in ``--diag-strict`` escalation; it
+    subclasses :class:`SolverDivergedError` so the supervisor's
+    existing rollback-and-retry path recovers it WITH the dt backoff
+    (a broken invariant under WENO/RK3 usually means the step outran
+    the resolution — exactly what the backoff schedule treats)."""
+
+    def __init__(self, step: int, t: float, norm: float,
+                 violations=()):
+        self.violations = list(violations)
+        what = "; ".join(
+            v.get("message", v.get("rule", "?")) for v in self.violations
+        ) or "physics invariant violated"
+        super().__init__(step, t, norm, reason=f"physics violation: {what}")
+
+
 #: Documented CLI exit code when a peer rank died or stalled past the
 #: watchdog timeout: the survivor aborts instead of hanging in a
 #: collective forever. Restart the job (on the surviving topology if a

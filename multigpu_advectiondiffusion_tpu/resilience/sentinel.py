@@ -33,11 +33,22 @@ from multigpu_advectiondiffusion_tpu.resilience.errors import (
 )
 
 
-def make_health_probe(solver):
+def make_health_probe(solver, diagnostics: bool = False):
     """``state -> dict`` of replicated global scalars as one jitted
     (and, under a mesh, shard_mapped) call: ``max_abs`` (non-finite
     mapped to +inf), ``min``, ``max``, ``l2`` and ``mass`` (both
-    volume-weighted, matching ``utils.metrics`` conventions)."""
+    volume-weighted, matching ``utils.metrics`` conventions).
+
+    ``diagnostics=True`` fuses the solver's physics-observable suite
+    (``diagnostics/physics.py`` — conservation budgets, total
+    variation, the spectral high-wavenumber tail, per-solver extras)
+    into the SAME jitted block: the extra scalars ride the probe's
+    existing field pass and the two stacked mesh reductions (one psum
+    vector, one pmax vector), so the whole suite costs at most one
+    extra HBM read and ZERO additional compiled programs — the
+    compile-count proof lives in ``tests/test_diagnostics.py``. The
+    returned probe exposes ``probe.traces`` (trace-time counter) and
+    ``probe.observable_keys`` for that proof."""
     reduce_max = (
         solver.mesh_reduce_max() if solver.mesh is not None else None
     )
@@ -45,43 +56,68 @@ def make_health_probe(solver):
         solver.mesh_reduce_sum() if solver.mesh is not None else None
     )
     vol = math.prod(solver.grid.spacing)
+    observables = []
+    if diagnostics:
+        from multigpu_advectiondiffusion_tpu.diagnostics import physics
+
+        observables = physics.observables_for(solver)
+    sum_keys = [k for ob in observables if ob.reduction == "sum"
+                for k in ob.keys]
+    max_keys = [k for ob in observables if ob.reduction == "max"
+                for k in ob.keys]
+    traces = {"count": 0}
 
     def block(u, z):
         del z
+        traces["count"] += 1  # python side-effect: counts TRACES only
         a = jnp.abs(u).astype(jnp.float32)
         # NaN -> +inf BEFORE reducing: XLA's reduce-max combiner does
         # not reliably propagate NaN (observed dropped across shard
         # boundaries on CPU), while max(+inf, x) = +inf always — so one
         # non-finite cell anywhere makes the replicated probe +inf
         a = jnp.where(jnp.isnan(a), jnp.inf, a)
-        m = jnp.max(a)
         uf = u.astype(jnp.float32)
-        umin = jnp.min(uf)
-        umax = jnp.max(uf)
-        s = jnp.sum(uf)
-        s2 = jnp.sum(uf * uf)
+        maxes = [jnp.max(a), jnp.max(uf), jnp.max(-uf)]
+        sums = [jnp.sum(uf), jnp.sum(uf * uf)]
+        for ob in observables:
+            vals = ob.local(uf)
+            dst = sums if ob.reduction == "sum" else maxes
+            for i in range(len(ob.keys)):
+                dst.append(vals[i])
+        sv = jnp.stack(sums)
+        mv = jnp.stack(maxes)
         if reduce_max is not None:
-            m = reduce_max(m)
-            umax = reduce_max(umax)
-            umin = -reduce_max(-umin)
+            mv = reduce_max(mv)
         if reduce_sum is not None:
-            s = reduce_sum(s)
-            s2 = reduce_sum(s2)
-        return u, jnp.stack([m, umin, umax, s, s2])
+            sv = reduce_sum(sv)
+        return u, jnp.concatenate([mv, sv])
 
     f = solver._wrap(block)
 
     def probe(state) -> dict:
-        _, v = f(state.u, jnp.zeros((5,), jnp.float32))
-        m, umin, umax, s, s2 = (float(x) for x in v)
-        return {
+        nm = 3 + len(max_keys)
+        _, v = f(state.u, jnp.zeros((1,), jnp.float32))
+        vals = [float(x) for x in v]
+        m, umax, neg_umin = vals[0], vals[1], vals[2]
+        s, s2 = vals[nm], vals[nm + 1]
+        stats = {
             "max_abs": m,
-            "min": umin,
+            "min": -neg_umin,
             "max": umax,
             "l2": math.sqrt(max(vol * s2, 0.0)) if math.isfinite(s2) else s2,
             "mass": vol * s,
         }
+        if observables:
+            raw = dict(zip(max_keys, vals[3:nm]))
+            raw.update(zip(sum_keys, vals[nm + 2:]))
+            for ob in observables:
+                stats.update(ob.finalize_raw(solver, raw))
+        return stats
 
+    probe.traces = traces
+    probe.observable_keys = tuple(
+        k for ob in observables for k in ob.output_keys
+    )
     return probe
 
 
@@ -122,14 +158,34 @@ class DivergenceSentinel:
     the last checked state (min/max/l2/mass plus ``mass_drift``, the
     relative drift of the mass integral against the armed baseline) —
     which the supervisor streams as ``physics`` telemetry events.
+
+    ``diagnostics=True`` arms the full in-situ physics suite
+    (``diagnostics/physics.py``) inside the SAME jitted probe: every
+    checked state's stats then carry the fused observables
+    (conservation budgets, TV, spectral tail, per-solver extras), the
+    run-initial :attr:`baseline` is recorded once on first arm (the
+    reference the tolerance rules and drift reports read against — a
+    rollback re-arm does not move it, like ``mass0``), and
+    :meth:`check_violations` evaluates the solver's tolerance rules
+    (max-principle, TV-monotonicity) against it.
     """
 
-    def __init__(self, solver, growth: float = 1e3):
-        self._probe = make_health_probe(solver)
+    def __init__(self, solver, growth: float = 1e3,
+                 diagnostics: bool = False):
+        self._probe = make_health_probe(solver, diagnostics=diagnostics)
         self.growth = float(growth)
         self.bound = None
         self.mass0 = None
         self.stats = None
+        self.diagnostics = bool(diagnostics)
+        self.baseline = None
+        self.rules = []
+        self.meta = {}
+        if diagnostics:
+            from multigpu_advectiondiffusion_tpu.diagnostics import physics
+
+            self.rules = physics.rules_for(solver)
+            self.meta = physics.meta_for(solver)
 
     def _stats_with_drift(self, stats: dict) -> dict:
         if self.mass0 is not None:
@@ -155,8 +211,24 @@ class DivergenceSentinel:
         # drift is always reported against the run's initial state
         if self.mass0 is None:
             self.mass0 = stats["mass"]
+        if self.baseline is None:
+            self.baseline = dict(stats)
         self._stats_with_drift(stats)
         return norm0
+
+    def check_violations(self, stats=None):
+        """Evaluate the solver's tolerance rules against the run-initial
+        baseline (empty list when clean, diagnostics off, or not yet
+        armed). Host-side only — the scalars were already paid for by
+        the fused probe."""
+        if not self.rules or self.baseline is None:
+            return []
+        from multigpu_advectiondiffusion_tpu.diagnostics import physics
+
+        return physics.check_violations(
+            self.rules, stats if stats is not None else (self.stats or {}),
+            self.baseline,
+        )
 
     def check(self, state) -> float:
         """One probe; raises :class:`SolverDivergedError` on a

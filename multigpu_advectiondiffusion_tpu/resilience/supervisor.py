@@ -50,6 +50,13 @@ class SupervisorReport:
     # min/max/L2/mass scalars — the drift line in RunSummary.print_block
     mass_drift: Optional[float] = None
     physics: Optional[dict] = None
+    # in-situ physics-diagnostics record (diag_every > 0): the fused
+    # observable suite's per-probe trajectory, the armed baseline, every
+    # tolerance-rule violation, and the per-solver meta (analytic decay
+    # rate etc.) — the science gate (diagnostics/compare.py) diffs the
+    # trajectory between rounds
+    diag_every: int = 0
+    diagnostics: Optional[dict] = None
     # step-time record of the live watch (telemetry/live.py): chunk
     # count, robust median, outliers, histogram — the wall-clock health
     # the resilience stack otherwise only sees after a failure
@@ -96,6 +103,10 @@ def supervise_run(
     sdc_every: int = 0,
     coordinated: Optional[bool] = None,
     progress: Optional[Callable[[dict], None]] = None,
+    diag_every: int = 0,
+    diag_strict: bool = False,
+    snapshot_every: int = 0,
+    save_snapshot: Optional[Callable] = None,
 ):
     """Run to ``iters`` steps or simulated time ``t_end`` under
     supervision; returns ``(final_state, SupervisorReport)``.
@@ -149,6 +160,25 @@ def supervise_run(
     before acting, and the same checkpoint iteration before writing —
     a desync raises :class:`CoordinationError` loudly instead of ranks
     silently recovering to different states.
+
+    ``diag_every`` > 0 arms the in-situ physics-diagnostics suite
+    (``diagnostics/physics.py``) INSIDE the sentinel's one jitted probe
+    (no second compiled program): every ``diag_every``-th sentinel
+    probe emits a ``phys:diag`` event carrying the fused observables
+    (conservation budgets, total variation, spectral tail, per-solver
+    extras), appends the point to ``report.diagnostics['trajectory']``
+    (what the science gate diffs between rounds), and evaluates the
+    solver's tolerance rules against the run-initial baseline — each
+    breach is a ``phys:violation`` event. ``diag_strict`` escalates a
+    breach into :class:`PhysicsViolationError`, recovered through the
+    SAME rollback + dt-backoff path as a divergence.
+
+    ``snapshot_every`` > 0 (with ``save_snapshot``) streams a field
+    snapshot at that step cadence from the chunk boundaries —
+    ``save_snapshot(state)`` is the caller's writer (the CLI threads
+    the downsampled, rotation-capped async streamer of
+    ``utils/io.SnapshotStreamer``); snapshot seconds are excluded from
+    the watched chunk time like checkpoint writes.
     """
     if (iters is None) == (t_end is None):
         raise ValueError("provide exactly one of iters/t_end")
@@ -157,6 +187,13 @@ def supervise_run(
             "the SDC guard rides the sentinel cadence: sdc_every needs "
             "sentinel_every > 0"
         )
+    if diag_every and not sentinel_every:
+        raise ValueError(
+            "the diagnostics suite rides the sentinel's jitted probe: "
+            "diag_every needs sentinel_every > 0"
+        )
+    if snapshot_every and save_snapshot is None:
+        raise ValueError("snapshot_every > 0 needs a save_snapshot writer")
     import jax
 
     coordinate = (
@@ -166,6 +203,7 @@ def supervise_run(
         sentinel_every=int(sentinel_every),
         sdc_every=int(sdc_every),
         coordinated=coordinate,
+        diag_every=int(diag_every),
     )
 
     from multigpu_advectiondiffusion_tpu.telemetry import xprof
@@ -253,8 +291,20 @@ def supervise_run(
         )
     sentinel = None
     if sentinel_every:
-        sentinel = DivergenceSentinel(solver, growth=growth)
+        sentinel = DivergenceSentinel(
+            solver, growth=growth, diagnostics=diag_every > 0
+        )
         norm0 = sentinel.arm(state)
+        if diag_every:
+            report.diagnostics = {
+                "observables": list(sentinel._probe.observable_keys),
+                "rules": [r.name for r in sentinel.rules],
+                "strict": bool(diag_strict),
+                "meta": dict(sentinel.meta),
+                "baseline": dict(sentinel.baseline or {}),
+                "trajectory": [],
+                "violations": [],
+            }
         # every supervised run opens with one resilience event: the
         # armed sentinel's cadence/bound baseline (healthy runs are
         # attributable too, not only failing ones)
@@ -268,11 +318,12 @@ def supervise_run(
     last_good = state
     start_it = int(state.it)
     last_ckpt_it = start_it
+    last_snap_it = start_it
 
     def _after_chunk(nxt, probe_due: bool):
         """Sentinel + checkpoint bookkeeping; returns the accepted state
         or raises SolverDivergedError for the retry handler."""
-        nonlocal last_good, last_ckpt_it
+        nonlocal last_good, last_ckpt_it, last_snap_it
         if sentinel is not None and probe_due:
             report.probes += 1
             report.final_norm = sentinel.check(nxt)
@@ -285,6 +336,42 @@ def supervise_run(
                 "physics", "probe",
                 step=int(nxt.it), time=float(nxt.t), **stats,
             )
+            if diag_every and report.probes % diag_every == 0:
+                # the fused diagnostic suite: same probe, richer stats —
+                # the trajectory point is what the science gate diffs
+                point = {"step": int(nxt.it), "time": float(nxt.t)}
+                point.update(
+                    (k, v) for k, v in stats.items()
+                    if isinstance(v, (int, float))
+                )
+                report.diagnostics["trajectory"].append(point)
+                telemetry.event(
+                    "phys", "diag",
+                    step=int(nxt.it), time=float(nxt.t),
+                    **sentinel.meta, **stats,
+                )
+                violations = sentinel.check_violations(stats)
+                for v in violations:
+                    rec = {
+                        "step": int(nxt.it), "time": float(nxt.t), **v,
+                    }
+                    report.diagnostics["violations"].append(rec)
+                    telemetry.event(
+                        "phys", "violation",
+                        step=int(nxt.it), time=float(nxt.t),
+                        rule=v["rule"], message=v["message"],
+                        tolerance=v["tolerance"],
+                    )
+                if violations and diag_strict:
+                    from multigpu_advectiondiffusion_tpu.resilience.errors import (  # noqa: E501
+                        PhysicsViolationError,
+                    )
+
+                    raise PhysicsViolationError(
+                        int(nxt.it), float(nxt.t),
+                        stats.get("max_abs", float("nan")),
+                        violations=violations,
+                    )
             if sdc_every and report.probes % sdc_every == 0:
                 # opt-in SDC guard: one step re-executed twice from the
                 # probed state, compared bit-exact; runs BEFORE the
@@ -319,6 +406,15 @@ def supervise_run(
             # no checkpoint cadence: every probed-good state is the
             # rollback point (in-memory checkpointing)
             last_good = nxt
+        if snapshot_every and (
+            int(nxt.it) - last_snap_it >= snapshot_every
+        ):
+            # field-snapshot streaming at cadence; disk seconds join
+            # the checkpoint-I/O exclusion (not step-time jitter)
+            io_t0 = time.monotonic()
+            save_snapshot(nxt)
+            _chunk_io[0] += time.monotonic() - io_t0
+            last_snap_it = int(nxt.it)
         return nxt
 
     def _recover(err: SolverDivergedError):
@@ -365,7 +461,9 @@ def supervise_run(
             sentinel.arm(last_good)
         return last_good
 
-    cadences = [c for c in (sentinel_every, checkpoint_every) if c]
+    cadences = [
+        c for c in (sentinel_every, checkpoint_every, snapshot_every) if c
+    ]
     if iters is not None:
         target_it = start_it + int(iters)
         chunk = min(cadences) if cadences else int(iters)

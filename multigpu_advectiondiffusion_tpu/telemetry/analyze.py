@@ -533,6 +533,77 @@ def measured_introspection(streams: List[Stream]) -> dict:
     }
 
 
+_DIAG_META = {"t", "proc", "kind", "name", "gt", "step", "time",
+              "solver", "ndim", "decay_rate_analytic"}
+
+
+def physics_diagnostics(streams: List[Stream]) -> dict:
+    """The physics section: per-rank in-situ diagnostic trajectories
+    (``phys:diag`` events — the fused observable suite at the
+    supervisor's ``--diag-every`` cadence), every tolerance-rule breach
+    (``phys:violation``), and — for the Gaussian-diffusion workload,
+    whose events carry the analytic rate — the measured amplitude
+    decay-rate fit against it (``diagnostics/physics.py
+    gaussian_decay_fit``): the machine-checked version of the reference
+    ``Run.m`` harness eyeballing its decaying field plots."""
+    from multigpu_advectiondiffusion_tpu.diagnostics.physics import (
+        gaussian_decay_fit,
+    )
+
+    trajectories = []
+    violations = []
+    for s in streams:
+        points = []
+        meta: dict = {}
+        for ev in s.events:
+            if ev.get("kind") != "phys":
+                continue
+            if ev.get("name") == "diag":
+                points.append(ev)
+                for key in ("solver", "ndim", "decay_rate_analytic"):
+                    if ev.get(key) is not None:
+                        meta[key] = ev[key]
+            elif ev.get("name") == "violation":
+                violations.append({
+                    "proc": s.proc,
+                    "step": ev.get("step"),
+                    "time": ev.get("time"),
+                    "rule": ev.get("rule"),
+                    "message": ev.get("message"),
+                })
+        if not points:
+            continue
+        observables = sorted({
+            k for p in points for k, v in p.items()
+            if k not in _DIAG_META and isinstance(v, (int, float))
+        })
+        entry = {
+            "proc": s.proc,
+            "solver": meta.get("solver"),
+            "points": len(points),
+            "observables": observables,
+            "last": {
+                k: points[-1].get(k)
+                for k in observables
+                if points[-1].get(k) is not None
+            },
+            "last_step": points[-1].get("step"),
+        }
+        if meta.get("decay_rate_analytic") is not None:
+            fit = gaussian_decay_fit(
+                [float(p.get("time", 0.0)) for p in points],
+                [float(p.get("max", 0.0)) for p in points],
+                analytic_rate=float(meta["decay_rate_analytic"]),
+            )
+            if fit is not None:
+                entry["decay_fit"] = {
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in fit.items()
+                }
+        trajectories.append(entry)
+    return {"trajectories": trajectories, "violations": violations}
+
+
 def perf_events(streams: List[Stream]) -> dict:
     """Step-time outlier record: every ``perf:outlier`` the live watch
     emitted, plus the final ``perf:histogram`` per process."""
@@ -575,6 +646,10 @@ class TraceReport:
     # mem:watermark events) — empty lists/dicts on streams from runs
     # that predate the capture layer
     xla: dict = dataclasses.field(default_factory=dict)
+    # in-situ physics diagnostics (phys:diag / phys:violation events):
+    # per-rank observable trajectories, tolerance-rule breaches and the
+    # Gaussian decay-rate fit — empty on undiagnosed runs
+    physics: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -689,6 +764,42 @@ class TraceReport:
                     line += (f", headroom "
                              f"{m['limit_bytes'] - m['peak_bytes']:,} B")
                 add(line)
+        if self.physics.get("trajectories"):
+            add("-" * 68)
+            add(" physics diagnostics (in-situ observable suite, "
+                "phys:diag cadence)")
+            for tr in self.physics["trajectories"]:
+                add(f"   proc {tr['proc']} [{tr.get('solver')}]: "
+                    f"{tr['points']} point(s), observables "
+                    f"{', '.join(tr['observables'])}")
+                last = tr.get("last") or {}
+                shown = {
+                    k: last[k]
+                    for k in ("mass", "energy", "tv", "spectral_tail")
+                    if last.get(k) is not None
+                }
+                if shown:
+                    add("      last (step "
+                        f"{tr.get('last_step')}): "
+                        + ", ".join(f"{k}={v:.6g}"
+                                    for k, v in shown.items()))
+                fit = tr.get("decay_fit")
+                if fit:
+                    add(
+                        "      Gaussian decay rate: measured "
+                        f"{fit['measured_rate']:.4f} vs analytic "
+                        f"{fit['analytic_rate']:.4f} "
+                        f"({100 * fit['rel_err']:.2f}% off, "
+                        f"{fit['points']} point(s))"
+                    )
+            viols = self.physics.get("violations") or []
+            if viols:
+                add(f"   violations ({len(viols)}):")
+                for v in viols[:20]:
+                    add(f"     proc {v['proc']} step {v['step']} "
+                        f"[{v['rule']}]: {v['message']}")
+            else:
+                add("   no tolerance-rule violations")
         add("=" * 68)
         return "\n".join(lines)
 
@@ -715,4 +826,5 @@ def analyze(paths: Sequence[str]) -> TraceReport:
         critical_path=critical_path(streams),
         perf=perf_events(streams),
         xla=measured_introspection(streams),
+        physics=physics_diagnostics(streams),
     )

@@ -36,6 +36,15 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     "dispatch": {"build": {"key", "impl"}},
     "ladder": {"degrade": {"from", "to", "reason"}},
     "physics": {"probe": {"step", "time"}},
+    # in-situ physics diagnostics (diagnostics/physics.py via the
+    # supervisor's --diag-every cadence): the fused observable suite
+    # and tolerance-rule breaches
+    "phys": {
+        "diag": {"step", "time", "solver"},
+        "violation": {"step", "time", "rule", "message", "tolerance"},
+    },
+    # the science regression gate's verdict (diagnostics/compare.py)
+    "science": {"gate": {"ok", "regressions", "rows"}},
     "resilience": {
         "sentinel_armed": {"cadence", "growth"},
         "rollback": {"retry", "step", "rollback_to_it", "action"},
@@ -52,6 +61,10 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     "io": {
         "checkpoint_write": {"path", "bytes", "seconds"},
         "binary_write": {"path", "bytes", "seconds"},
+        # SnapshotStreamer publishes (utils/io.py): downsampled field
+        # snapshots, atomic + rotation-capped
+        "snapshot_write": {"path", "bytes", "seconds", "iteration",
+                           "stride"},
     },
     "dist_init": {
         "attempt": {"attempt", "attempts"},

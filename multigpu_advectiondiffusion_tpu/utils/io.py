@@ -154,6 +154,109 @@ class AsyncBinaryWriter:
         self.close()
 
 
+class SnapshotStreamer:
+    """Downsampled field-snapshot stream: atomic, rotation-capped,
+    background-written.
+
+    Wraps :class:`AsyncBinaryWriter` so the solver keeps stepping while
+    a snapshot drains, and adds the three properties raw ``submit``
+    lacks:
+
+    * **atomic** — bytes land in a ``.tmp`` sibling and are renamed to
+      ``snap_NNNNNN.bin`` only after the async writer flushed them, so
+      a reader (or a crash) never sees a torn snapshot;
+    * **downsampled** — ``stride`` > 1 strides every axis
+      (``u[::s, ::s, ...]``) before writing: visual-inspection
+      snapshots of a large run cost ``1/s^d`` of the field's bytes;
+    * **rotation-capped** — ``max_bytes`` > 0 bounds the TOTAL bytes of
+      published snapshots (the ``--metrics-max-bytes`` discipline for
+      fields): oldest snapshots are deleted first, the newest always
+      survives even when it alone exceeds the cap.
+
+    Every published snapshot emits an ``io:snapshot_write`` event
+    (path, bytes, drain seconds, iteration, stride). The pending
+    snapshot is published at the NEXT :meth:`write` or at
+    :meth:`close` — one write stays in flight, preserving the double
+    buffer's compute/IO overlap.
+    """
+
+    def __init__(self, directory: str, stride: int = 1,
+                 max_bytes: int = 0, prefix: str = "snap_"):
+        if stride < 1:
+            raise ValueError(f"snapshot stride must be >= 1, got {stride}")
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.stride = int(stride)
+        self.max_bytes = int(max_bytes)
+        self.prefix = prefix
+        self._writer = AsyncBinaryWriter()
+        self._pending = []  # (tmp, final, nbytes, iteration)
+        self._published = []  # (final, nbytes), oldest first
+
+    def write(self, u, iteration: int) -> str:
+        """Queue one snapshot; returns the final path it will publish
+        under. Publishes (flush + rename + rotate) whatever was pending
+        first, so at most one write is in flight."""
+        self.publish_pending()
+        arr = np.ascontiguousarray(
+            np.asarray(u, dtype=np.float32)[
+                (slice(None, None, self.stride),) * np.ndim(u)
+            ]
+        )
+        final = os.path.join(
+            self.directory, f"{self.prefix}{int(iteration):06d}.bin"
+        )
+        tmp = f"{final}.tmp.{os.getpid()}"
+        self._writer.submit(arr, tmp)
+        self._pending.append((tmp, final, arr.nbytes, int(iteration)))
+        return final
+
+    def publish_pending(self) -> None:
+        """Drain the async writer and atomically publish every pending
+        snapshot (rename + ``io:snapshot_write`` event), then rotate."""
+        if not self._pending:
+            return
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._writer.flush()
+        drain_s = _time.perf_counter() - t0
+        for tmp, final, nbytes, iteration in self._pending:
+            os.replace(tmp, final)
+            # seconds = the synchronous drain cost (≈0 when the
+            # background writer already finished during compute)
+            _io_event(
+                "snapshot_write", final, nbytes,
+                drain_s / len(self._pending),
+                iteration=iteration, stride=self.stride,
+            )
+            self._published.append((final, nbytes))
+        self._pending.clear()
+        self._rotate()
+
+    def _rotate(self) -> None:
+        if self.max_bytes <= 0:
+            return
+        total = sum(n for _, n in self._published)
+        while total > self.max_bytes and len(self._published) > 1:
+            stale, nbytes = self._published.pop(0)
+            try:
+                os.remove(stale)
+            except FileNotFoundError:
+                pass
+            total -= nbytes
+
+    def close(self) -> None:
+        self.publish_pending()
+        self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def save_binary(u, path: str) -> None:
     """Write float32 raw binary, reference ``SaveBinary3D`` layout."""
     import time as _time

@@ -27,8 +27,10 @@ from multigpu_advectiondiffusion_tpu.utils.metrics import (
 # guessing. History: 1 = implicit pre-schema layout (PRs 0-2);
 # 2 = adds schema/cost_model/roofline_pct/mass_drift; 3 = adds the
 # measured-introspection blocks (memory watermarks, per-executable XLA
-# cost capture: memory/xla fields).
-SUMMARY_SCHEMA = 3
+# cost capture: memory/xla fields); 4 = surfaces the in-situ physics
+# diagnostics block (observable trajectory, violations, baseline) at
+# the top level — the science gate's input.
+SUMMARY_SCHEMA = 4
 
 
 @dataclasses.dataclass
@@ -103,6 +105,11 @@ class RunSummary:
             d["roofline_pct"] = self.cost_model.get("roofline_pct")
         if self.resilience is not None:
             d["mass_drift"] = self.resilience.get("mass_drift")
+            # the in-situ diagnostics block (SupervisorReport) surfaces
+            # top-level: the science gate's extractor reads it without
+            # knowing the resilience layout
+            if self.resilience.get("diagnostics") is not None:
+                d["diagnostics"] = self.resilience["diagnostics"]
         return d
 
     def print_block(self) -> None:
@@ -169,6 +176,23 @@ class RunSummary:
                     f"({ev['reason']}) -> it={ev['rollback_to_it']}, "
                     f"{ev['action']}"
                 )
+            diag = r.get("diagnostics")
+            if diag is not None:
+                traj = diag.get("trajectory") or []
+                viols = diag.get("violations") or []
+                line = (
+                    f"{len(traj)} point(s), "
+                    f"{len(diag.get('observables') or [])} observable(s)"
+                    f", rules={','.join(diag.get('rules') or []) or '-'}"
+                )
+                if viols:
+                    line += f", {len(viols)} VIOLATION(S)"
+                print(f" physics diag       : {line}")
+                for v in viols[:5]:
+                    print(
+                        f"   violation        : step {v['step']} "
+                        f"[{v['rule']}] {v['message']}"
+                    )
         print(f" MLUPS              : {self.mlups:.1f}")
         print(f" GFLOPS (ref conv.) : {self.gflops:.3f}")
         if self.cost_model is not None and self.cost_model.get(

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Science regression gate: run the canonical diagnostic round (small
+# supervised diffusion + Burgers solves with the in-situ physics suite
+# armed) and diff its observable trajectories against the newest
+# archived round (SCIENCE_r0*.json) with diagnostics/compare.py's
+# per-observable tolerance bands; nonzero exit on any drift. The
+# numerics counterpart of out/bench_gate.sh — a perturbed coefficient
+# or dt that leaves MLUPS intact trips THIS gate.
+#
+#   ./out/science_gate.sh                 # fresh round vs newest SCIENCE_r0*.json
+#   ./out/science_gate.sh NEW.json        # gate an existing artifact
+#   ./out/science_gate.sh NEW.json PRIOR  # explicit prior round
+#   ./out/science_gate.sh --record OUT    # run the round, archive the artifact
+#   ./out/science_gate.sh --selftest      # prove the gate passes an
+#                                         # unmodified round AND trips on an
+#                                         # injected 2% diffusivity perturbation
+#
+# Runs on the virtual CPU backend (no TPU needed), same as tier-1.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+newest_round() {
+  ls SCIENCE_r0*.json 2>/dev/null | sort | tail -1
+}
+
+# run_round OUT.json — the canonical diagnostic round: one supervised
+# diffusion3d and one supervised burgers1d solve with --diag-every 1,
+# trajectories extracted into one artifact. SCIENCE_K / SCIENCE_CFL
+# override the physics knobs (the self-test's injection point).
+run_round() {
+  local out="$1"
+  local tmp
+  tmp="$(mktemp -d)"
+  python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+    --n 16 14 12 --iters 30 --K "${SCIENCE_K:-1.0}" \
+    --sentinel-every 5 --diag-every 1 --save "$tmp/d3" >/dev/null
+  python -m multigpu_advectiondiffusion_tpu.cli burgers1d \
+    --n 128 --iters 60 --fixed-dt --cfl "${SCIENCE_CFL:-0.4}" \
+    --sentinel-every 5 --diag-every 1 --save "$tmp/b1" >/dev/null
+  python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \
+    --extract "$tmp/d3/summary.json" "$tmp/b1/summary.json" -o "$out"
+  rm -rf "$tmp"
+}
+
+if [[ "${1:-}" == "--record" ]]; then
+  OUT="${2:?usage: science_gate.sh --record OUT.json}"
+  run_round "$OUT"
+  exit 0
+fi
+
+if [[ "${1:-}" == "--selftest" ]]; then
+  TMP="$(mktemp -d)"
+  trap 'rm -rf "$TMP"' EXIT
+  echo "science_gate selftest: recording the reference round"
+  run_round "$TMP/base.json"
+  echo "science_gate selftest: an unmodified round must PASS"
+  run_round "$TMP/clean.json"
+  python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \
+    "$TMP/clean.json" "$TMP/base.json"
+  echo "science_gate selftest: a 2% diffusivity perturbation must FAIL"
+  SCIENCE_K=1.02 run_round "$TMP/perturbed.json"
+  if python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \
+      "$TMP/perturbed.json" "$TMP/base.json"; then
+    echo "science_gate selftest: gate FAILED to trip on the perturbation" >&2
+    exit 1
+  fi
+  echo "science_gate selftest: OK (gate trips on the perturbation, passes unmodified)"
+  exit 0
+fi
+
+if [[ -n "${1:-}" ]]; then
+  NEW="$1"
+else
+  NEW="$(mktemp -d)/science_new.json"
+  echo "science_gate: running the canonical diagnostic round"
+  run_round "$NEW"
+fi
+PRIOR="${2:-$(newest_round)}"
+[[ -n "$PRIOR" ]] || { echo "science_gate: no SCIENCE_r0*.json prior round found (record one with --record SCIENCE_r01.json)" >&2; exit 1; }
+echo "science_gate: $NEW vs $PRIOR"
+exec python -m multigpu_advectiondiffusion_tpu.diagnostics.compare "$NEW" "$PRIOR"

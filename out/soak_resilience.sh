@@ -8,6 +8,8 @@
 #   SOAK_SLOW=1 ./out/soak_resilience.sh 3   # include the slow soak test
 #   BENCH_GATE=1 ./out/soak_resilience.sh    # also run the bench
 #                                   # regression-gate self-test after
+#   SCIENCE_GATE=1 ./out/soak_resilience.sh  # also run the science
+#                                   # regression-gate self-test after
 #
 # Runs on the virtual CPU backend (no TPU needed), same as tier-1.
 set -euo pipefail
@@ -32,4 +34,11 @@ if [[ "${BENCH_GATE:-0}" == "1" ]]; then
   # self-test (trips on an injected 20% slowdown, passes the newest
   # unmodified round) — see out/bench_gate.sh
   JAX_PLATFORMS=cpu ./out/bench_gate.sh --selftest
+fi
+
+if [[ "${SCIENCE_GATE:-0}" == "1" ]]; then
+  # and on the numerics: the science gate's self-test (trips on an
+  # injected 2% diffusivity perturbation, passes an unmodified round)
+  # — see out/science_gate.sh
+  JAX_PLATFORMS=cpu ./out/science_gate.sh --selftest
 fi

@@ -87,6 +87,60 @@ def test_axisymmetric_convergence():
 
 
 # --------------------------------------------------------------------- #
+# Measured Gaussian decay rate vs the analytic (t0/t)^{d/2} amplitude
+# (ISSUE 8: the in-situ diagnostics' decay fit as an accuracy gate —
+# the machine-checked version of Run.m eyeballing the decaying plots)
+# --------------------------------------------------------------------- #
+def _decay_fit(solver, iters):
+    from multigpu_advectiondiffusion_tpu.diagnostics import physics
+    from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+        supervise_run,
+    )
+
+    _, report = supervise_run(
+        solver, solver.initial_state(), iters=iters,
+        sentinel_every=5, diag_every=1,
+    )
+    traj = report.diagnostics["trajectory"]
+    assert report.diagnostics["violations"] == [], (
+        report.diagnostics["violations"]
+    )
+    return physics.gaussian_decay_fit(
+        [p["time"] for p in traj], [p["max"] for p in traj],
+        analytic_rate=-solver.grid.ndim / 2.0,
+    )
+
+
+def test_gaussian_decay_rate_generic():
+    """Fused-diagnostic amplitude trajectory on the generic XLA rung:
+    the fitted log-log slope must match the analytic -d/2 (f64, a
+    resolved Gaussian: the fit is tight)."""
+    grid = Grid.make(33, 33, 33, lengths=10.0)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float64", t0=0.5)
+    )
+    assert solver.engaged_path()["stepper"] == "generic-xla"
+    fit = _decay_fit(solver, 40)
+    assert fit is not None and fit["points"] >= 6
+    assert fit["rel_err"] < 1e-2, fit
+
+
+def test_gaussian_decay_rate_fused_slab():
+    """The same gate on the VMEM whole-run slab rung (f32, coarser
+    grid): a slab-pipeline defect that perturbed amplitudes would move
+    the measured rate off -3/2."""
+    grid = Grid.make(24, 16, 16, lengths=10.0)
+    solver = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", t0=1.0,
+                        impl="pallas_slab")
+    )
+    assert solver.engaged_path()["stepper"] == "fused-whole-run-slab"
+    fit = _decay_fit(solver, 30)
+    assert fit is not None and fit["points"] >= 5
+    assert fit["rel_err"] < 0.06, fit
+
+
+# --------------------------------------------------------------------- #
 # WENO linear-advection exactness checks
 # --------------------------------------------------------------------- #
 @pytest.mark.parametrize("order", [5, 7])

@@ -1,0 +1,492 @@
+"""In-situ physics diagnostics suite (ISSUE 8 tentpole, tier-1, CPU).
+
+Covers the diagnostics layer end to end: the fused observable suite's
+values against hand-computed references, the ONE-jitted-probe
+compile-count proof (the suite adds zero compiled programs beyond the
+sentinel's probe), the tolerance rules and their strict escalation
+through the rollback path, downsampled rotation-capped snapshot
+streaming, the science gate's trajectory comparator, and a real
+supervised CLI run whose ``--metrics`` stream carries ``phys:diag``
+events, whose ``summary.json`` gains the diagnostics block, and whose
+``tpucfd-trace`` report renders the physics section.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu import (
+    BurgersConfig,
+    BurgersSolver,
+    DiffusionConfig,
+    DiffusionSolver,
+    Grid,
+    telemetry,
+)
+from multigpu_advectiondiffusion_tpu.cli.__main__ import main as cli_main
+from multigpu_advectiondiffusion_tpu.diagnostics import (
+    compare as science,
+    physics,
+)
+from multigpu_advectiondiffusion_tpu.resilience.errors import (
+    PhysicsViolationError,
+)
+from multigpu_advectiondiffusion_tpu.resilience.sentinel import (
+    DivergenceSentinel,
+    make_health_probe,
+)
+from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+    supervise_run,
+)
+from multigpu_advectiondiffusion_tpu.telemetry import schema
+from multigpu_advectiondiffusion_tpu.utils import io as io_utils
+
+
+def _events(path) -> list:
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _diffusion3d(**kw):
+    kw.setdefault("grid", Grid.make(12, 10, 8, lengths=10.0))
+    return DiffusionSolver(DiffusionConfig(dtype="float32", **kw))
+
+
+# --------------------------------------------------------------------- #
+# Fused observables: values against hand-computed references
+# --------------------------------------------------------------------- #
+def test_probe_observables_match_numpy():
+    solver = _diffusion3d()
+    state = solver.initial_state()
+    probe = make_health_probe(solver, diagnostics=True)
+    stats = probe(state)
+    u = np.asarray(state.u, np.float64)
+    vol = float(np.prod(solver.grid.spacing))
+    assert stats["l1"] == pytest.approx(vol * np.abs(u).sum(), rel=1e-5)
+    assert stats["energy"] == pytest.approx(vol * (u * u).sum(), rel=1e-5)
+    tv = sum(np.abs(np.diff(u, axis=a)).sum() for a in range(u.ndim))
+    assert stats["tv"] == pytest.approx(tv, rel=1e-5)
+    spec = np.abs(np.fft.rfft(u, axis=-1)) ** 2
+    cut = max(1, (2 * spec.shape[-1]) // 3)
+    assert stats["spectral_tail"] == pytest.approx(
+        spec[..., cut:].sum() / spec.sum(), rel=1e-4
+    )
+    # the base probe scalars are unchanged by fusing the suite in
+    base = make_health_probe(solver, diagnostics=False)(state)
+    for key in ("max_abs", "min", "max", "l2", "mass"):
+        assert stats[key] == pytest.approx(base[key], rel=1e-6)
+
+
+def test_probe_observables_sharded_global(devices):
+    """The fused suite's sums reduce across the mesh: a 2-device z-slab
+    run reports the same global budgets as the unsharded probe (TV is
+    shard-local by construction — its one missing interface plane is
+    bounded by the field's values there and stays inside the
+    monotonicity tolerance; the budgets must be exact)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(12, 10, 8, lengths=10.0)
+    ref = DiffusionSolver(DiffusionConfig(grid=grid, dtype="float32"))
+    ref_stats = make_health_probe(ref, diagnostics=True)(
+        ref.initial_state()
+    )
+    sharded = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32"),
+        mesh=make_mesh({"dz": 2}, devices=devices[:2]),
+        decomp=Decomposition.slab("dz"),
+    )
+    stats = make_health_probe(sharded, diagnostics=True)(
+        sharded.initial_state()
+    )
+    for key in ("l1", "energy", "mass", "l2", "max", "min",
+                "spectral_tail"):
+        assert stats[key] == pytest.approx(ref_stats[key], rel=1e-5), key
+    # shard-local TV misses exactly the inter-shard interface planes
+    assert stats["tv"] == pytest.approx(ref_stats["tv"], rel=0.05)
+    assert stats["tv"] <= ref_stats["tv"] + 1e-6
+
+
+# --------------------------------------------------------------------- #
+# Compile-count proof: the suite adds NO second compiled probe program
+# --------------------------------------------------------------------- #
+def test_diagnostics_add_no_second_compiled_probe():
+    """The whole diagnostic suite rides the sentinel's ONE jitted probe:
+    constructing a diagnostics-armed sentinel calls the solver's _wrap
+    (= jax.jit) exactly once, and repeated probes never retrace — the
+    one-compile-per-program discipline of tests/test_xprof.py applied
+    to the probe."""
+    solver = _diffusion3d()
+    wraps = []
+    orig = solver._wrap
+
+    def counting_wrap(*a, **kw):
+        wraps.append(a)
+        return orig(*a, **kw)
+
+    solver._wrap = counting_wrap
+    sentinel = DivergenceSentinel(solver, diagnostics=True)
+    assert len(wraps) == 1, "the diagnostic suite built a second program"
+    state = solver.initial_state()
+    sentinel.arm(state)
+    for _ in range(3):
+        state = solver.run(state, 2)
+        sentinel.check(state)
+    # the block traced once: 4 probes, 1 compilation, full suite present
+    assert sentinel._probe.traces["count"] == 1
+    assert "tv" in (sentinel.stats or {})
+    assert "spectral_tail" in sentinel.stats
+
+
+def test_probe_keys_registered_and_events_validate(tmp_path):
+    """phys:diag / phys:violation / io:snapshot_write events pass the
+    schema registry's structural validation."""
+    ev = {"t": 0.0, "proc": 0, "kind": "phys", "name": "diag",
+          "step": 1, "time": 0.1, "solver": "DiffusionSolver"}
+    assert schema.validate_event(ev) == []
+    ev = {"t": 0.0, "proc": 0, "kind": "phys", "name": "violation",
+          "step": 1, "time": 0.1, "rule": "tv_monotone", "message": "x",
+          "tolerance": 0.05}
+    assert schema.validate_event(ev) == []
+    ev = {"t": 0.0, "proc": 0, "kind": "io", "name": "snapshot_write",
+          "path": "p", "bytes": 1, "seconds": 0.0, "iteration": 4,
+          "stride": 2}
+    assert schema.validate_event(ev) == []
+    assert schema.validate_event(
+        {"t": 0, "proc": 0, "kind": "phys", "name": "diag"}
+    )  # missing required fields flagged
+
+
+# --------------------------------------------------------------------- #
+# Violation rules
+# --------------------------------------------------------------------- #
+def test_max_principle_rule_trips_on_new_extremum():
+    rule = physics.max_principle_rule(tolerance=1e-3)
+    base = {"max": 1.0, "min": 0.0}
+    assert rule.check({"max": 1.0, "min": 0.0}, base, rule.tolerance) is None
+    assert rule.check({"max": 1.0005, "min": 0.0}, base,
+                      rule.tolerance) is None  # inside the band
+    assert "maximum principle" in rule.check(
+        {"max": 1.01, "min": 0.0}, base, rule.tolerance
+    )
+    assert "undercuts" in rule.check(
+        {"max": 1.0, "min": -0.01}, base, rule.tolerance
+    )
+
+
+def test_tv_monotone_rule_trips_on_growth():
+    rule = physics.tv_monotone_rule(tolerance=0.05)
+    base = {"tv": 10.0}
+    assert rule.check({"tv": 9.0}, base, rule.tolerance) is None
+    assert rule.check({"tv": 10.4}, base, rule.tolerance) is None
+    assert "total variation" in rule.check(
+        {"tv": 11.0}, base, rule.tolerance
+    )
+
+
+def test_supervised_clean_run_emits_diag_no_violation(tmp_path):
+    solver = _diffusion3d()
+    path = str(tmp_path / "ev.jsonl")
+    with telemetry.capture(path):
+        out, report = supervise_run(
+            solver, solver.initial_state(), iters=8,
+            sentinel_every=2, diag_every=2,
+        )
+    assert int(out.it) == 8
+    diag = report.diagnostics
+    assert diag is not None
+    assert diag["rules"] == ["max_principle"]
+    assert len(diag["trajectory"]) == 2  # probes 2,4,6,8 -> diag 4,8
+    assert diag["violations"] == []
+    assert "tv" in diag["baseline"]
+    evs = _events(path)
+    diags = [e for e in evs if (e["kind"], e["name"]) == ("phys", "diag")]
+    assert len(diags) == 2
+    assert diags[-1]["solver"] == "DiffusionSolver"
+    assert diags[-1]["decay_rate_analytic"] == -1.5
+    for e in diags:
+        assert schema.validate_event(e) == []
+    assert not [e for e in evs if e["kind"] == "phys"
+                and e["name"] == "violation"]
+
+
+def test_strict_violation_escalates_into_rollback(tmp_path):
+    """A tolerance breach under --diag-strict recovers through the SAME
+    rollback + dt-backoff path as a divergence (an always-firing
+    injected rule exhausts the budget and propagates), with the
+    violation and rollback both in the event stream; without strict it
+    is a warning event only (next test)."""
+    solver = _diffusion3d()
+    # an always-firing rule: deterministic injection without faking
+    # the field
+    rule = physics.ViolationRule(
+        "always", 0.0, lambda stats, base, tol: "injected breach"
+    )
+    orig_spec = solver.diagnostics_spec
+    solver.diagnostics_spec = lambda: {**orig_spec(), "rules": [rule]}
+    dt0 = solver.dt
+    path = str(tmp_path / "ev.jsonl")
+    with telemetry.capture(path):
+        with pytest.raises(PhysicsViolationError) as err:
+            supervise_run(
+                solver, solver.initial_state(), iters=6,
+                sentinel_every=2, diag_every=1, diag_strict=True,
+                max_retries=2,
+            )
+    assert "injected breach" in str(err.value)
+    assert solver.dt < dt0  # the dt backoff engaged before exhausting
+    evs = _events(path)
+    kinds = [(e["kind"], e["name"]) for e in evs]
+    assert ("phys", "violation") in kinds
+    assert ("resilience", "rollback") in kinds
+    assert ("resilience", "retries_exhausted") in kinds
+
+
+def test_non_strict_violation_is_warning_only(tmp_path):
+    solver = _diffusion3d()
+    rule = physics.ViolationRule(
+        "always", 0.0, lambda stats, base, tol: "injected breach"
+    )
+    orig_spec = solver.diagnostics_spec
+    solver.diagnostics_spec = lambda: {**orig_spec(), "rules": [rule]}
+    path = str(tmp_path / "ev.jsonl")
+    with telemetry.capture(path):
+        out, report = supervise_run(
+            solver, solver.initial_state(), iters=6,
+            sentinel_every=2, diag_every=1,
+        )
+    assert int(out.it) == 6 and report.retries == 0
+    assert len(report.diagnostics["violations"]) == 3
+    viols = [e for e in _events(path)
+             if (e["kind"], e["name"]) == ("phys", "violation")]
+    assert len(viols) == 3
+    for e in viols:
+        assert schema.validate_event(e) == []
+
+
+def test_diag_requires_sentinel_cadence():
+    solver = _diffusion3d()
+    with pytest.raises(ValueError, match="sentinel_every"):
+        supervise_run(solver, solver.initial_state(), iters=4,
+                      diag_every=1)
+
+
+# --------------------------------------------------------------------- #
+# Gaussian decay-rate fit
+# --------------------------------------------------------------------- #
+def test_gaussian_decay_fit_exact_power_law():
+    times = [0.1 * 1.3 ** i for i in range(6)]
+    maxima = [t ** -1.5 for t in times]
+    fit = physics.gaussian_decay_fit(times, maxima, analytic_rate=-1.5)
+    assert fit["measured_rate"] == pytest.approx(-1.5, abs=1e-9)
+    assert fit["rel_err"] < 1e-9
+    assert physics.gaussian_decay_fit([0.1], [1.0]) is None
+
+
+# --------------------------------------------------------------------- #
+# Snapshot streaming
+# --------------------------------------------------------------------- #
+def test_snapshot_streamer_atomic_downsampled_capped(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    u = np.arange(16 * 12, dtype=np.float32).reshape(16, 12)
+    with telemetry.capture(path):
+        with io_utils.SnapshotStreamer(
+            str(tmp_path / "snaps"), stride=2,
+            max_bytes=3 * (8 * 6 * 4),  # exactly three snapshots
+        ) as streamer:
+            for it in range(2, 12, 2):
+                streamer.write(u + it, it)
+    snaps = sorted(os.listdir(tmp_path / "snaps"))
+    # rotation kept the newest 3; no torn .tmp files left behind
+    assert snaps == ["snap_000006.bin", "snap_000008.bin",
+                     "snap_000010.bin"]
+    got = np.fromfile(tmp_path / "snaps" / "snap_000010.bin",
+                      dtype=np.float32)
+    np.testing.assert_array_equal(got, (u + 10)[::2, ::2].ravel())
+    evs = [e for e in _events(path)
+           if (e["kind"], e["name"]) == ("io", "snapshot_write")]
+    assert len(evs) == 5  # every write published exactly once
+    assert all(e["stride"] == 2 and e["bytes"] == 8 * 6 * 4 for e in evs)
+    assert [e["iteration"] for e in evs] == [2, 4, 6, 8, 10]
+
+
+def test_snapshot_streamer_keeps_newest_even_over_cap(tmp_path):
+    with io_utils.SnapshotStreamer(str(tmp_path), max_bytes=4) as s:
+        s.write(np.ones(64, np.float32), 1)
+        s.write(np.ones(64, np.float32), 2)
+    assert sorted(os.listdir(tmp_path)) == ["snap_000002.bin"]
+
+
+def test_cli_snapshots_need_sentinel(tmp_path):
+    with pytest.raises(ValueError, match="sentinel-every"):
+        cli_main([
+            "diffusion2d", "--n", "12", "10", "--iters", "4",
+            "--snapshots", "2", "--save", str(tmp_path / "run"),
+        ])
+    with pytest.raises(ValueError, match="sentinel-every"):
+        cli_main([
+            "diffusion2d", "--n", "12", "10", "--iters", "4",
+            "--diag-every", "1", "--save", str(tmp_path / "run"),
+        ])
+    with pytest.raises(ValueError, match="diag-every"):
+        cli_main([
+            "diffusion2d", "--n", "12", "10", "--iters", "4",
+            "--sentinel-every", "2", "--diag-strict",
+            "--save", str(tmp_path / "run"),
+        ])
+
+
+# --------------------------------------------------------------------- #
+# Heavy variants (slow-marked: tier-1 stays inside the 870 s window)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_spectral_tail_detects_steepening_slow():
+    """The under-resolution detector end to end: a smooth sine under
+    inviscid Burgers steepens into a shock — the spectral tail ratio
+    must grow by orders of magnitude as energy piles into the grid
+    cutoff, well before the divergence sentinel would see anything."""
+    grid = Grid.make_periodic(512, lengths=2.0, origin=-1.0)
+    solver = BurgersSolver(
+        BurgersConfig(grid=grid, flux="burgers", ic="sine",
+                      bc="periodic", dtype="float64")
+    )
+    state = solver.initial_state()
+    probe = make_health_probe(solver, diagnostics=True)
+    tail0 = probe(state)["spectral_tail"]
+    out = solver.advance_to(state, 0.4)  # shock forms at t = 1/pi
+    tail1 = probe(out)["spectral_tail"]
+    assert tail1 > max(tail0 * 100, 1e-9), (tail0, tail1)
+
+
+@pytest.mark.slow
+def test_snapshot_stream_long_run_stays_capped_slow(tmp_path):
+    """A long supervised run streaming many snapshots stays inside the
+    byte cap: the directory never holds more than cap + one snapshot."""
+    run = tmp_path / "run"
+    mpath = str(tmp_path / "events.jsonl")
+    nbytes = 64 * 48 * 4  # full-resolution f32 snapshot
+    cli_main([
+        "diffusion2d", "--n", "64", "48", "--iters", "60",
+        "--sentinel-every", "2", "--snapshots", "2",
+        "--snapshot-max-bytes", str(3 * nbytes),
+        "--save", str(run), "--metrics", mpath,
+    ])
+    snaps = [p for p in os.listdir(run) if p.startswith("snap_")]
+    assert len(snaps) == 3  # 30 written, rotation kept the newest 3
+    assert max(snaps) == "snap_000060.bin"
+    writes = [e for e in _events(mpath)
+              if (e["kind"], e["name"]) == ("io", "snapshot_write")]
+    assert len(writes) == 30
+
+
+# --------------------------------------------------------------------- #
+# Science gate comparator
+# --------------------------------------------------------------------- #
+def _round(**runs) -> dict:
+    return {"schema": 1, "runs": {
+        name: {"meta": {}, "observables": obs}
+        for name, obs in runs.items()
+    }}
+
+
+def test_science_compare_identical_passes():
+    traj = {"mass": [[5, 1.0], [10, 0.9]], "tv": [[5, 3.0], [10, 2.5]]}
+    result = science.compare(_round(d=traj), _round(d=traj))
+    assert result.ok
+    assert {r.status for r in result.rows} == {"ok"}
+
+
+def test_science_compare_trips_on_drift_and_coverage():
+    old = _round(d={"mass": [[5, 1.0], [10, 0.9]],
+                    "tv": [[5, 3.0], [10, 2.5]]})
+    drifted = _round(d={"mass": [[5, 1.0], [10, 0.89]],
+                        "tv": [[5, 3.0], [10, 2.5]]})
+    result = science.compare(drifted, old)
+    assert not result.ok
+    assert [r.observable for r in result.regressions] == ["mass"]
+    # a silently dropped observable is a coverage regression
+    missing = _round(d={"mass": [[5, 1.0], [10, 0.9]]})
+    result = science.compare(missing, old)
+    assert [r.observable for r in result.regressions] == ["tv"]
+    # a dropped run fails; an added run never does
+    result = science.compare(_round(), old)
+    assert not result.ok and result.rows[0].status == "missing"
+    result = science.compare(old, _round())
+    assert result.ok
+
+
+def test_science_compare_band_overrides():
+    old = _round(d={"tv": [[5, 10.0]]})
+    new = _round(d={"tv": [[5, 10.2]]})
+    assert not science.compare(new, old).ok  # 2% > 1e-3 band
+    assert science.compare(new, old, bands={"tv": 0.05}).ok
+
+
+def test_science_extract_roundtrip(tmp_path):
+    solver = _diffusion3d()
+    out, report = supervise_run(
+        solver, solver.initial_state(), iters=6,
+        sentinel_every=2, diag_every=1,
+    )
+    summary = {"name": "d3", "resilience": report.to_dict()}
+    # the CLI surfaces diagnostics top-level; both layouts must extract
+    p1 = tmp_path / "s1.json"
+    p1.write_text(json.dumps(summary))
+    artifact = science.extract([str(p1)])
+    obs = artifact["runs"]["d3"]["observables"]
+    assert "mass" in obs and "tv" in obs and "time" in obs
+    assert len(obs["mass"]) == 3
+    assert science.compare(artifact, artifact).ok
+
+
+# --------------------------------------------------------------------- #
+# CLI acceptance: events + summary block + trace-report section
+# --------------------------------------------------------------------- #
+def test_cli_supervised_diag_snapshot_stream(tmp_path):
+    run = tmp_path / "run"
+    mpath = str(tmp_path / "events.jsonl")
+    cli_main([
+        "diffusion3d", "--n", "12", "10", "8", "--iters", "8",
+        "--sentinel-every", "2", "--diag-every", "2",
+        "--snapshots", "4", "--snapshot-stride", "2",
+        "--snapshot-max-bytes", "4096",
+        "--save", str(run), "--metrics", mpath,
+    ])
+    evs = _events(mpath)
+    diags = [e for e in evs if (e["kind"], e["name"]) == ("phys", "diag")]
+    assert len(diags) == 2 and diags[-1]["tv"] > 0
+    snaps = [e for e in evs
+             if (e["kind"], e["name"]) == ("io", "snapshot_write")]
+    assert [e["iteration"] for e in snaps] == [4, 8]
+    assert (run / "snap_000004.bin").exists()
+    # stride 2 on (8, 10, 12) -> (4, 5, 6) f32
+    assert (run / "snap_000008.bin").stat().st_size == 4 * 5 * 6 * 4
+    summary = json.loads((run / "summary.json").read_text())
+    assert summary["schema"] >= 4
+    diag = summary["diagnostics"]
+    assert len(diag["trajectory"]) == 2
+    assert diag["rules"] == ["max_principle"]
+    assert diag["violations"] == []
+    assert "spectral_tail" in diag["trajectory"][-1]
+    # the extractor consumes the CLI summary directly
+    artifact = science.extract([str(run / "summary.json")])
+    assert "diffusion3d" in artifact["runs"]
+    # ... and the trace report renders the physics section with the fit
+    from multigpu_advectiondiffusion_tpu.telemetry.analyze import analyze
+
+    report = analyze([mpath])
+    assert report.physics["trajectories"], "no physics section"
+    tr = report.physics["trajectories"][0]
+    assert tr["solver"] == "DiffusionSolver"
+    assert "tv" in tr["observables"]
+    text = report.format_text()
+    assert "physics diagnostics" in text
+    assert "no tolerance-rule violations" in text
