@@ -277,6 +277,177 @@ def _cases(on_tpu: bool):
     ]
 
 
+def _ensemble_cases(on_tpu: bool):
+    """Batched-ensemble rows (ISSUE 9): (family, make_case) where
+    make_case() -> (solver_cls, cfg, iters, member_fn). Each family is
+    measured at B in {1, 8, 64} as ONE vmapped dispatch vs the looped
+    single-run baseline (same compiled single program dispatched B
+    times) — MLUPS*members against MLUPS*members."""
+    from multigpu_advectiondiffusion_tpu import (
+        BurgersConfig,
+        BurgersSolver,
+        DiffusionConfig,
+        DiffusionSolver,
+        Grid,
+    )
+
+    def diff3d():
+        g = (
+            Grid.make(256, 128, 64, lengths=(6.4, 3.2, 1.6))
+            if on_tpu
+            else Grid.make(16, 12, 10, lengths=(1.6, 1.2, 1.0))
+        )
+        cfg = DiffusionConfig(grid=g, diffusivity=1.0, dtype="float32",
+                              impl="pallas", ic="gaussian")
+        # member-varying ICs (a width sweep): the parameter-sweep
+        # workload, physics uniform so the fused rung engages
+        member = lambda i: {  # noqa: E731
+            "ic_params": (("width", 0.1 + 0.002 * i),)
+        }
+        return DiffusionSolver, cfg, (60 if on_tpu else 4), member
+
+    def burg3d():
+        g = (
+            Grid.make(128, 64, 64, lengths=2.0)
+            if on_tpu
+            else Grid.make(16, 8, 8, lengths=2.0)
+        )
+        cfg = BurgersConfig(grid=g, nu=1e-5, dtype="float32",
+                            adaptive_dt=False, impl="pallas")
+        member = lambda i: {  # noqa: E731
+            "ic_params": (("width", 0.1 + 0.002 * i),)
+        }
+        return BurgersSolver, cfg, (30 if on_tpu else 4), member
+
+    def diff3d_xla():
+        # the generic rung under batching in the many-small-problems
+        # regime (per-user scenarios; HipBone's batched-small-FEM
+        # argument, PAPERS arXiv 2202.12477): per-member programs small
+        # enough that launch/dispatch overhead is a real fraction of a
+        # run — the regime where one batched dispatch amortizes most
+        g = (
+            Grid.make(64, 48, 32, lengths=(6.4, 4.8, 3.2))
+            if on_tpu
+            else Grid.make(12, 10, 8, lengths=(1.2, 1.0, 0.8))
+        )
+        cfg = DiffusionConfig(grid=g, diffusivity=1.0, dtype="float32",
+                              impl="xla", ic="gaussian")
+        member = lambda i: {  # noqa: E731
+            "ic_params": (("width", 0.1 + 0.002 * i),)
+        }
+        return DiffusionSolver, cfg, (60 if on_tpu else 2), member
+
+    return [
+        ("ensemble_diffusion3d", diff3d, {"ensemble-vmap[fused-stage]"}),
+        ("ensemble_burgers3d", burg3d, {"ensemble-vmap[fused-stage]"}),
+        ("ensemble_diffusion3d_xla", diff3d_xla,
+         {"ensemble-vmap[generic-xla]"}),
+    ]
+
+
+def _wall_timed(fn, reps: int = 3):
+    """Raw wall seconds (median-of-reps, first call untimed warm-up) —
+    the ensemble rows compare WHOLE dispatches including their launch
+    overhead, because amortizing that overhead is the point."""
+    import statistics
+    import time
+
+    from multigpu_advectiondiffusion_tpu.bench.timing import sync
+
+    sync(fn())  # compile + warm-up
+    times = []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        sync(fn())
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    spread = (max(times) - min(times)) / med if med > 0 else 0.0
+    return med, spread
+
+
+def _ensemble_rows(on_tpu: bool):
+    """One row per (family, B): MLUPS*members of the batched dispatch,
+    with the looped single-run baseline measured on the SAME compiled
+    single program (compile excluded from both sides — the batched win
+    reported here is dispatch/streaming amortization, not compile; the
+    compile-amortization story is the AOT cache's, gated separately in
+    out/ensemble_gate.sh)."""
+    import jax.numpy as jnp
+
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.models.state import SolverState
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    rows = []
+    for family, make_case, expect in _ensemble_cases(on_tpu):
+        solver_cls, cfg, iters, member_fn = make_case()
+        for B in (1, 8, 64):
+            es = EnsembleSolver(
+                solver_cls, cfg, [member_fn(i) for i in range(B)]
+            )
+            est = es.initial_state()
+            batched_s, spread = _wall_timed(
+                lambda: es.run(est, iters).u, reps=3
+            )
+            # looped baseline: ONE single-run solver (compile paid
+            # once, outside the timing) dispatched B times over the
+            # same member initial states
+            single = es.member_solver(0)
+
+            def looped():
+                outs = [
+                    single.run(
+                        SolverState(u=est.u[i], t=est.t[i],
+                                    it=est.it[i]),
+                        iters,
+                    ).u
+                    for i in range(B)
+                ]
+                return jnp.stack(outs)
+
+            looped_s, looped_spread = _wall_timed(looped, reps=3)
+            engaged = es.engaged_path()
+            rate = mlups(
+                cfg.grid.num_cells * B, iters,
+                STAGES[cfg.integrator], batched_s,
+            )
+            looped_rate = mlups(
+                cfg.grid.num_cells * B, iters,
+                STAGES[cfg.integrator], looped_s,
+            )
+            row = {
+                "metric": f"{family}_b{B}_mlups_members",
+                "value": round(rate, 2),
+                "unit": "MLUPS*members",
+                "ensemble": B,
+                "iters": iters,
+                "seconds": round(batched_s, 5),
+                "spread": round(spread, 4),
+                "looped_mlups_members": round(looped_rate, 2),
+                "looped_seconds": round(looped_s, 5),
+                "looped_spread": round(looped_spread, 4),
+                # the amortization headline: batched throughput over
+                # the looped single-run baseline
+                "vs_looped": round(looped_s / batched_s, 3)
+                if batched_s > 0 else None,
+                "engaged": engaged["stepper"],
+                "tuned": engaged.get("tuned"),
+            }
+            ok = engaged["stepper"] in expect
+            if not ok:
+                row["engagement_error"] = {
+                    "expected": sorted(expect),
+                    "fallback": engaged.get("fallback"),
+                }
+            rows.append((row, ok))
+    return rows
+
+
 def main() -> None:
     import os
     import sys
@@ -391,6 +562,10 @@ def main() -> None:
             "xla_flops": meas.get("xla_flops_per_step"),
             "xla_bytes": meas.get("xla_bytes_per_step"),
             "peak_bytes": meas.get("peak_bytes"),
+            # single-run rows carry the member count explicitly so the
+            # bench gate reads one convention across rounds (older
+            # rounds without the field read as 1 — bench/compare.py)
+            "ensemble": 1,
         }
         # engagement guard: a row running on an unexpected (slower)
         # stepper is recorded AND fails the run — a silent fallback to
@@ -437,6 +612,16 @@ def main() -> None:
             row["engagement_error"] = {
                 "tuned_below_baseline": row.get("tuned")
             }
+            mismatches.append(row["metric"])
+        print(json.dumps(row), flush=True)
+
+    # Batched-ensemble rows (ISSUE 9): MLUPS*members of one vmapped
+    # dispatch vs the looped single-run baseline at B in {1, 8, 64} —
+    # engagement-guarded like every other row (a row that silently fell
+    # off the vmapped fused rung fails the run, it does not just
+    # publish a slow amortization ratio)
+    for row, ok in _ensemble_rows(on_tpu):
+        if not ok:
             mismatches.append(row["metric"])
         print(json.dumps(row), flush=True)
 

@@ -29,7 +29,11 @@ from multigpu_advectiondiffusion_tpu.models.burgers import (
     BurgersConfig,
     BurgersSolver,
 )
-from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu.models.state import (
+    EnsembleState,
+    SolverState,
+)
+from multigpu_advectiondiffusion_tpu.models.ensemble import EnsembleSolver
 from multigpu_advectiondiffusion_tpu import telemetry
 
 __version__ = "0.1.0"
@@ -42,6 +46,8 @@ __all__ = [
     "BurgersConfig",
     "BurgersSolver",
     "SolverState",
+    "EnsembleState",
+    "EnsembleSolver",
     "telemetry",
     "__version__",
 ]

@@ -47,6 +47,14 @@ DEFAULT_SPREAD_FACTOR = 2.0
 # versions.
 MEASURED_FIELDS = ("xla_flops", "xla_bytes", "peak_bytes")
 
+# Batched-ensemble columns (ISSUE 9): same coverage-note discipline as
+# MEASURED_FIELDS — ``ensemble`` (member count B) and ``vs_looped``
+# (batched-over-looped amortization ratio) are provenance, not gated
+# throughput. Rows from rounds BEFORE the ensemble engine (BENCH_r01 -
+# r05) carry neither field; :func:`row_members` reads them as B=1 and
+# their absence is never a coverage regression.
+ENSEMBLE_FIELDS = ("ensemble", "vs_looped")
+
 
 def parse_rows(text: str) -> List[dict]:
     """JSON-lines -> row dicts; unparseable lines (the truncated head
@@ -82,6 +90,16 @@ def row_spread(row: dict) -> float:
         return float(row.get("spread") or 0.0)
     except (TypeError, ValueError):
         return 0.0
+
+
+def row_members(row: dict) -> int:
+    """Ensemble member count of a row; rounds predating the batched
+    engine (BENCH_r01-r05) have no ``ensemble`` field and read as 1 —
+    never a parse error, never a coverage regression."""
+    try:
+        return max(1, int(row.get("ensemble") or 1))
+    except (TypeError, ValueError):
+        return 1
 
 
 def load_rows(path: str) -> Dict[str, dict]:
@@ -192,12 +210,21 @@ def compare(
             results.append(RowResult(key, "missing",
                                      old=row_value(old)))
             continue
-        for field in MEASURED_FIELDS:
+        for field in MEASURED_FIELDS + ENSEMBLE_FIELDS:
             if old.get(field) is not None and new.get(field) is None:
                 notes.append(
                     f"{key}: measured column {field!r} dropped "
                     "(coverage note, non-gating)"
                 )
+        if row_members(old) != row_members(new):
+            # a row measured at a different member count is a different
+            # workload: flag it as a note (the metric NAME carries the
+            # B by convention, so this only fires on drift)
+            notes.append(
+                f"{key}: ensemble member count changed "
+                f"{row_members(old)} -> {row_members(new)} "
+                "(coverage note, non-gating)"
+            )
         ov, nv = row_value(old), row_value(new)
         threshold = max(
             rel_tol,

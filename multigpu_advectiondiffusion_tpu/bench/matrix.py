@@ -105,6 +105,132 @@ CASES = [
 ]
 
 
+@dataclasses.dataclass(frozen=True)
+class EnsembleBenchCase:
+    """One batched-ensemble row (ISSUE 9): B members advanced by ONE
+    vmapped dispatch, reported as MLUPS*members next to the looped
+    single-run baseline (``vs_looped``)."""
+
+    name: str
+    kind: str  # diffusion | burgers
+    grid_xyz: Tuple[int, ...]
+    iters: int
+    members: int
+    quick_scale: int = 4
+    impl: str = "pallas"
+    nu: float = 0.0
+
+
+ENSEMBLE_CASES = [
+    EnsembleBenchCase("ensemble_diffusion3d_b8", "diffusion",
+                      (128, 128, 64), 60, 8),
+    EnsembleBenchCase("ensemble_diffusion3d_b64", "diffusion",
+                      (128, 128, 64), 20, 64),
+    EnsembleBenchCase("ensemble_burgers3d_b8", "burgers",
+                      (64, 64, 64), 30, 8, nu=1e-5),
+]
+
+
+def run_ensemble_case(case: EnsembleBenchCase, quick: bool = False,
+                      repeats: int = 3) -> dict:
+    """Time one batched-ensemble case: B members in ONE vmapped
+    dispatch, plus the looped single-run baseline on the same compiled
+    single program. Value convention: ``mlups`` is MLUPS*members (the
+    batch's aggregate stage-update rate), so the bench gate diffs it
+    like every other row."""
+    import statistics
+    import time
+
+    import jax.numpy as jnp
+
+    from multigpu_advectiondiffusion_tpu.bench.timing import sync
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+    from multigpu_advectiondiffusion_tpu.models.burgers import (
+        BurgersConfig,
+        BurgersSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionConfig,
+        DiffusionSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.models.state import SolverState
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    grid_xyz = case.grid_xyz
+    iters = case.iters
+    if quick:
+        grid_xyz = tuple(max(8, g // case.quick_scale) for g in grid_xyz)
+        iters = max(2, iters // case.quick_scale)
+    grid = Grid.make(*grid_xyz, lengths=[2.0] * len(grid_xyz))
+    if case.kind == "diffusion":
+        cls, cfg = DiffusionSolver, DiffusionConfig(
+            grid=grid, diffusivity=1.0, dtype="float32",
+            impl=case.impl, ic="gaussian",
+        )
+    else:
+        cls, cfg = BurgersSolver, BurgersConfig(
+            grid=grid, nu=case.nu, dtype="float32", adaptive_dt=False,
+            impl=case.impl,
+        )
+    members = [
+        {"ic_params": (("width", 0.1 + 0.002 * i),)}
+        for i in range(case.members)
+    ]
+    es = EnsembleSolver(cls, cfg, members)
+    est = es.initial_state()
+
+    def wall(fn):
+        sync(fn())  # compile + warm-up, untimed
+        times = []
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            sync(fn())
+            times.append(time.perf_counter() - t0)
+        med = statistics.median(times)
+        return med, (max(times) - min(times)) / med if med > 0 else 0.0
+
+    batched_s, spread = wall(lambda: es.run(est, iters).u)
+    single = es.member_solver(0)
+
+    def looped():
+        return jnp.stack([
+            single.run(
+                SolverState(u=est.u[i], t=est.t[i], it=est.it[i]), iters
+            ).u
+            for i in range(case.members)
+        ])
+
+    looped_s, _ = wall(looped)
+    engaged = es.engaged_path()
+    cells = 1
+    for g in grid_xyz:
+        cells *= g
+    rate = mlups(cells * case.members, iters, STAGES[cfg.integrator],
+                 batched_s)
+    return {
+        "name": case.name,
+        "grid": "x".join(map(str, grid_xyz)),
+        "iters": iters,
+        "dtype": "float32",
+        "impl": case.impl,
+        "ensemble": case.members,
+        "engaged": engaged["stepper"],
+        "seconds": round(batched_s, 4),
+        "spread": round(spread, 4),
+        "mlups": round(rate, 1),
+        "looped_seconds": round(looped_s, 4),
+        "vs_looped": round(looped_s / batched_s, 3) if batched_s else None,
+        "tuned": engaged.get("tuned"),
+        "quick": quick,
+    }
+
+
 def resolve_impl(case: BenchCase, dtype: str,
                  mesh_spec: Optional[str] = None) -> str:
     """Kernel strategy actually benchmarked: the Pallas rungs' DMA tiling
@@ -232,6 +358,9 @@ def run_case(
         "xla_flops": meas.get("xla_flops_per_step"),
         "xla_bytes": meas.get("xla_bytes_per_step"),
         "peak_bytes": meas.get("peak_bytes"),
+        # single-run rows carry the member count explicitly (older
+        # rounds without the field read as 1 — bench/compare.py)
+        "ensemble": 1,
         "quick": quick,
         "mesh": mesh_spec,
     }
@@ -285,9 +414,14 @@ def main(argv=None):
     tuning.configure(cache_path=args.tuning_cache, enabled=True)
 
     cases = [c for c in CASES if args.name is None or c.name == args.name]
-    if not cases:
+    ens_cases = [
+        c for c in ENSEMBLE_CASES
+        if args.name is None or c.name == args.name
+    ]
+    if not cases and not ens_cases:
         raise SystemExit(
-            f"no case {args.name!r}; have {[c.name for c in CASES]}"
+            f"no case {args.name!r}; have "
+            f"{[c.name for c in CASES + ENSEMBLE_CASES]}"
         )
     from jax.experimental import enable_x64
 
@@ -302,6 +436,14 @@ def main(argv=None):
         with enable_x64(dtype == "float64"):
             res = run_case(case, dtype=dtype, quick=args.quick,
                            mesh_spec=args.mesh, repeats=args.repeats)
+        line = json.dumps(res)
+        print(line, flush=True)
+        lines.append(line)
+    for case in ens_cases:
+        # batched-ensemble rows (ISSUE 9): the ensemble engine declines
+        # meshes, so these never take --mesh; f32 only
+        res = run_ensemble_case(case, quick=args.quick,
+                                repeats=args.repeats)
         line = json.dumps(res)
         print(line, flush=True)
         lines.append(line)

@@ -33,6 +33,7 @@ import sys
 from multigpu_advectiondiffusion_tpu.cli.drivers import (
     decomposition_for,
     parse_mesh_spec,
+    run_ensemble_solver,
     run_solver,
 )
 
@@ -235,6 +236,34 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "multigpu_advectiondiffusion_tpu/tuning.json); "
                         "atomic JSON, one audited decision per (solver, "
                         "shape, dtype, mesh, backend) key")
+    p.add_argument("--ensemble", type=int, default=0, metavar="B",
+                   help="batched ensemble engine: advance B independent "
+                        "members (varying ICs and/or swept scalars — see "
+                        "--sweep) in ONE compiled, vmapped dispatch "
+                        "instead of B serialized runs; per-member "
+                        "summaries (max|u|, mass drift) and member-"
+                        "attributed divergence ride the batch. Slab-rung "
+                        "pins and --mesh decline loudly (README "
+                        "'Ensemble engine'; 0 = off)")
+    p.add_argument("--sweep", action="append", default=[],
+                   metavar="NAME=a:b",
+                   help="member-varying parameter for --ensemble B: "
+                        "NAME=a:b sweeps linearly across the B members, "
+                        "NAME=v1,v2,... lists one value per member. NAME "
+                        "is a member-varying scalar (diffusion: K/"
+                        "diffusivity; burgers: cfl) or an IC parameter "
+                        "as ic.PARAM (e.g. ic.width, ic.left/ic.right "
+                        "for Riemann-state sweeps); repeatable")
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="persistent AOT executable cache (also "
+                        "$TPUCFD_AOT_CACHE): compiled dispatch programs "
+                        "are serialized here keyed by (solver, shape, "
+                        "dtype, mesh, impl, steps-per-exchange, ensemble "
+                        "B, operand avals, backend, jax version); a "
+                        "repeat request deserializes instead of "
+                        "recompiling (aot_cache:hit events; xla:cost "
+                        "records compile_seconds_saved). Corrupt/stale "
+                        "entries are misses, writes are atomic")
     p.add_argument("--overlap", default="padded",
                    choices=["padded", "split"],
                    help="sharded halo schedule: 'padded' exchanges before "
@@ -287,9 +316,16 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
         overlap=args.overlap,
         steps_per_exchange=args.steps_per_exchange,
     )
+    name = f"diffusion{ndim}d" if geometry == "cartesian" else "diffusion_axisym"
+    if args.ensemble and args.ensemble > 1:
+        # batched ensemble engine: one vmapped dispatch advances every
+        # member; sweeps map K -> diffusivity, ic.* -> ic_params
+        return run_ensemble_solver(
+            DiffusionSolver, cfg, name, args,
+            aliases={"K": "diffusivity"},
+        )
     mesh, decomp = _mesh_decomp(args, grid)
     solver = DiffusionSolver(cfg, mesh=mesh, decomp=decomp)
-    name = f"diffusion{ndim}d" if geometry == "cartesian" else "diffusion_axisym"
     iters = args.iters if args.t_end is None else None
     if iters is None and args.t_end is None:
         iters = 100
@@ -340,6 +376,9 @@ def _run_burgers(args, ndim):
         overlap=args.overlap,
         steps_per_exchange=args.steps_per_exchange,
     )
+    if args.ensemble and args.ensemble > 1:
+        return run_ensemble_solver(BurgersSolver, cfg, f"burgers{ndim}d",
+                                   args)
     mesh, decomp = _mesh_decomp(args, grid)
     solver = BurgersSolver(cfg, mesh=mesh, decomp=decomp)
     iters = args.iters if args.t_end is None else None
@@ -527,6 +566,13 @@ def main(argv=None):
             args.metrics,
             max_bytes=getattr(args, "metrics_max_bytes", 0),
         )
+    if getattr(args, "aot_cache", None):
+        # persistent AOT executable cache: every dispatch program this
+        # process compiles is serialized under DIR, and every repeat
+        # request (this process or a later one) deserializes instead
+        from multigpu_advectiondiffusion_tpu.tuning import aot_cache
+
+        aot_cache.configure(cache_dir=args.aot_cache, enabled=True)
     if getattr(args, "tune", False) or getattr(args, "tuning_cache", None):
         # tuner surface: --tune allows measurement on a cache miss,
         # --tuning-cache points both lookup and persistence at PATH

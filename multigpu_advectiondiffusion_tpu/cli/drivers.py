@@ -97,6 +97,178 @@ def physics_meta(solver: SolverBase) -> dict:
     return out
 
 
+def build_ensemble_members(sweeps, members: int, aliases=None):
+    """CLI ``--sweep`` specs -> per-member override dicts.
+
+    ``NAME=a:b`` sweeps linearly, ``NAME=v1,...`` lists one value per
+    member. ``aliases`` maps CLI names to config fields (``K`` ->
+    ``diffusivity``); an ``ic.PARAM`` name lands in the member's
+    ``ic_params`` (Riemann-state sweeps: ``ic.left=2:1``)."""
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        parse_sweep_spec,
+    )
+
+    aliases = aliases or {}
+    out = [dict() for _ in range(members)]
+    ic_params = [dict() for _ in range(members)]
+    for spec in sweeps or []:
+        name, values = parse_sweep_spec(spec, members)
+        if name.startswith("ic."):
+            key = name[3:]
+            for i, v in enumerate(values):
+                ic_params[i][key] = v
+            continue
+        name = aliases.get(name, name)
+        for i, v in enumerate(values):
+            out[i][name] = v
+    for i, p in enumerate(ic_params):
+        if p:
+            out[i]["ic_params"] = tuple(sorted(p.items()))
+    return out
+
+
+def run_ensemble_solver(solver_cls, cfg, name: str, args, aliases=None):
+    """The batched-ensemble CLI driver (``--ensemble B [--sweep ...]``):
+    ONE vmapped dispatch advances all B members; per-member summaries
+    (max|u|, mass drift) and member-attributed divergence come out of
+    the batch (models/ensemble.py). Supervision machinery that rolls
+    state back (checkpoints, SDC guard, diagnostics cadence) stays
+    single-run; ``--sentinel-every`` is served as a chunked per-member
+    health probe."""
+    import time as _time
+
+    import jax
+
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    B = int(args.ensemble)
+    unsupported = {
+        "--mesh": getattr(args, "mesh", None),
+        "--coordinator": getattr(args, "coordinator", None),
+        "--resume": getattr(args, "resume", None),
+        "--checkpoint-every": getattr(args, "checkpoint_every", 0),
+        "--snapshot-every": getattr(args, "snapshot_every", 0),
+        "--snapshots": getattr(args, "snapshots", 0),
+        "--sdc-every": getattr(args, "sdc_every", 0),
+        "--diag-every": getattr(args, "diag_every", 0),
+        "--progress": getattr(args, "progress", False),
+        "--watchdog-timeout": getattr(args, "watchdog_timeout", 0.0),
+    }
+    offending = [k for k, v in unsupported.items() if v]
+    if offending:
+        raise ValueError(
+            f"--ensemble does not compose with {offending} (single-run "
+            "supervision machinery); drop them or run members "
+            "individually"
+        )
+    members = build_ensemble_members(args.sweep, B, aliases=aliases)
+    es = EnsembleSolver(solver_cls, cfg, members)
+    estate = es.initial_state()
+    iters = args.iters
+    if iters is None and args.t_end is None:
+        iters = 100
+
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    scope = telemetry.get_sink()
+    span = (
+        scope.span("run_solver", run=name, ensemble=B)
+        if scope.active
+        else contextlib.nullcontext()
+    )
+    with span:
+        # untimed warm-up/compile of the batched program (the
+        # reference's untimed warm phase), then the timed dispatch
+        t0 = _time.perf_counter()
+        warm = es.run(estate, 1) if iters is not None else es.advance_to(
+            estate, float(estate.t.max())
+        )
+        sync(warm.u)
+        compile_s = _time.perf_counter() - t0
+
+        sentinel = int(getattr(args, "sentinel_every", 0) or 0)
+        t0 = _time.perf_counter()
+        if iters is not None:
+            if sentinel:
+                out, done = estate, 0
+                while done < iters:
+                    n = min(sentinel, iters - done)
+                    out = es.run(out, n)
+                    done += n
+                    # member-attributed divergence: one blown-up member
+                    # names its index, the batch result stays valid
+                    es.check_health(
+                        out, growth=getattr(args, "sentinel_growth", 1e3)
+                    )
+            else:
+                out = es.run(estate, iters)
+        else:
+            out = es.advance_to(estate, args.t_end)
+        sync(out.u)
+        seconds = _time.perf_counter() - t0
+
+        work = iters if iters is not None else int(
+            np.asarray(out.it).max()
+        )
+        rate = mlups(
+            cfg.grid.num_cells * B, max(1, work),
+            STAGES[cfg.integrator], seconds,
+        )
+        summaries = es.member_summaries(out)
+        if sentinel == 0:
+            es.check_health(
+                out, growth=getattr(args, "sentinel_growth", 1e3)
+            )
+        engaged = es.engaged_path()
+        result = {
+            "name": name,
+            "ensemble": B,
+            "grid_xyz": list(cfg.grid.shape_xyz),
+            "iters": work,
+            "seconds": round(seconds, 6),
+            "compile_seconds": round(compile_s, 4),
+            "mlups_members": round(rate, 2),
+            "engaged": engaged,
+            "members": summaries,
+        }
+        if scope.active:
+            scope.event(
+                "summary", name, seconds=round(seconds, 6),
+                mlups=round(rate, 3), ensemble=B,
+                stepper=engaged["stepper"],
+            )
+
+    if jax.process_index() == 0:
+        print(f"-- {name} ensemble: B={B} members, {work} iters, "
+              f"{seconds:.4f}s, {rate:,.1f} MLUPS*members "
+              f"({engaged['stepper']})")
+        for row in summaries:
+            drift = row.get("mass_drift")
+            print(
+                f"   member {row['member']:3d}: t={row['t']:.5g} "
+                f"max|u|={row['max_abs']:.5g}"
+                + (f" mass_drift={drift:+.3e}" if drift is not None
+                   else "")
+                + (f" {row['overrides']}" if row.get("overrides") else "")
+            )
+        if args.save:
+            os.makedirs(args.save, exist_ok=True)
+            io_utils.save_binary(
+                np.asarray(out.u),
+                os.path.join(args.save, "ensemble_result.bin"),
+            )
+            tmp = os.path.join(args.save, "ensemble_summary.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(result, f, indent=1)
+            os.replace(
+                tmp, os.path.join(args.save, "ensemble_summary.json")
+            )
+    return result
+
+
 def run_solver(
     solver: SolverBase,
     name: str,

@@ -1,12 +1,18 @@
-from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu.models.state import (
+    EnsembleState,
+    SolverState,
+)
 from multigpu_advectiondiffusion_tpu.models.diffusion import (
     DiffusionConfig,
     DiffusionSolver,
 )
 from multigpu_advectiondiffusion_tpu.models.burgers import BurgersConfig, BurgersSolver
+from multigpu_advectiondiffusion_tpu.models.ensemble import EnsembleSolver
 
 __all__ = [
     "SolverState",
+    "EnsembleState",
+    "EnsembleSolver",
     "DiffusionConfig",
     "DiffusionSolver",
     "BurgersConfig",

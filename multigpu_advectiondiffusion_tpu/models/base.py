@@ -31,7 +31,10 @@ from jax.sharding import PartitionSpec as P
 from multigpu_advectiondiffusion_tpu.core.bc import Boundary, pad_axis
 from multigpu_advectiondiffusion_tpu.core.dtypes import canonicalize
 from multigpu_advectiondiffusion_tpu.core.grid import Grid
-from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu.models.state import (
+    EnsembleState,
+    SolverState,
+)
 from multigpu_advectiondiffusion_tpu.ops.stencils import Padder
 from multigpu_advectiondiffusion_tpu.ops.stencils import slice_axis
 from multigpu_advectiondiffusion_tpu.parallel.halo import (
@@ -147,8 +150,22 @@ class SolverBase:
     # ------------------------------------------------------------------ #
     # To be provided by subclasses
     # ------------------------------------------------------------------ #
-    def build_local(self, ctx: StepContext) -> LocalPhysics:
+    def build_local(self, ctx: StepContext, overrides=None) -> LocalPhysics:
+        """Shard-local physics. ``overrides`` (ensemble mode only) maps
+        member-varying scalar names — the keys of
+        :meth:`ensemble_operands` — to *traced* 0-d values that must
+        enter the step as operands, not closure constants: the batched
+        dispatch vmaps one compiled program over the member axis, so a
+        per-member diffusivity/CFL arrives here as a traced scalar."""
         raise NotImplementedError
+
+    def ensemble_operands(self) -> dict:
+        """The member-varying scalar contract of the batched ensemble
+        engine: ``{name: default}`` for every scalar
+        :meth:`build_local` can take as a traced override. The base
+        class supports none — ensembles of such solvers vary initial
+        conditions only."""
+        return {}
 
     def diagnostics_spec(self) -> dict:
         """Per-solver in-situ physics-diagnostics contract
@@ -277,12 +294,14 @@ class SolverBase:
             ghost_fn=make_ghost_fn(self.decomp, sizes, self.bcs),
         )
 
-    def _local_step(self, u, t, t_end=None):
-        """One time step on a (possibly shard-local) block."""
+    def _local_step(self, u, t, t_end=None, overrides=None):
+        """One time step on a (possibly shard-local) block.
+        ``overrides`` threads member-varying traced scalars into
+        :meth:`build_local` (ensemble dispatch only)."""
         # named_scope: the generic step shows up as one labeled region
         # in --trace captures, matching the fused steppers' spans
         with jax.named_scope("tpucfd.step"):
-            phys = self.build_local(self._context(u))
+            phys = self.build_local(self._context(u), overrides=overrides)
             dt = phys.dt_fn(u) if phys.dt_fn is not None else phys.static_dt
             if t_end is not None:
                 dt = jnp.minimum(dt, t_end - t)
@@ -344,8 +363,17 @@ class SolverBase:
                     impl=getattr(self.cfg, "impl", "xla"),
                     requested_impl=self._requested_impl,
                 )
+            # persistent AOT executable cache (tuning/aot_cache.py):
+            # when enabled, the introspection wrapper resolves this key
+            # against the on-disk store before paying lower+compile
+            from multigpu_advectiondiffusion_tpu.tuning import aot_cache
+
+            aot_key = None
+            if aot_cache.enabled():
+                aot_key = aot_cache.dispatch_key(self, key, steps=steps)
             self._cache[key] = xprof.wrap_dispatch(
-                builder(), solver=self, key=str(key), steps=steps
+                builder(), solver=self, key=str(key), steps=steps,
+                aot_key=aot_key,
             )
         return self._cache[key]
 
@@ -819,3 +847,195 @@ class SolverBase:
             state.u, state.t, jnp.asarray(t_end, state.t.dtype)
         )
         return SolverState(u=u, t=t, it=state.it + steps)
+
+    # ------------------------------------------------------------------ #
+    # Ensemble (leading-member-axis) execution — one compiled
+    # executable advances B independent members per dispatch,
+    # amortizing compile, dispatch and HBM streaming across the batch
+    # (ROADMAP item 1; front end in models/ensemble.py)
+    # ------------------------------------------------------------------ #
+    def _ensemble_gate(self, operand_names=()) -> None:
+        """Loud eligibility gate for batched dispatch — mirror of the
+        impl/steps_per_exchange construction gates: a config the
+        ensemble engine cannot serve fails here instead of silently
+        running something else."""
+        if self.mesh is not None:
+            raise ValueError(
+                "ensemble batching composes members on ONE device; a "
+                "device mesh shards a single member's grid — run the "
+                "ensemble unsharded (members are the parallel axis)"
+            )
+        if int(getattr(self.cfg, "steps_per_exchange", 1) or 1) > 1:
+            raise ValueError(
+                "steps_per_exchange > 1 rides the sharded slab rung, "
+                "which declines ensemble batching"
+            )
+        if getattr(self.cfg, "impl", "xla") == "pallas_slab":
+            raise ValueError(
+                "the slab-pipelined whole-run rung declines ensemble "
+                "batching (its (timestep x z-slab) grid does not fold a "
+                "member axis); pin impl='pallas_stage' or let "
+                "impl='pallas' take the per-stage rung"
+            )
+        supported = set(self.ensemble_operands())
+        unknown = sorted(set(operand_names) - supported)
+        if unknown:
+            raise ValueError(
+                f"{type(self).__name__} has no member-varying operand(s) "
+                f"{unknown}; supported: {sorted(supported) or 'none'}"
+            )
+
+    def _ensemble_fused(self):
+        """The fused stepper the batched dispatch may ``vmap``, or
+        ``None`` (generic vmapped loop). Only the per-stage rung is
+        served: the slab rung's temporal blocking does not fold a
+        member axis (declined via the ``_decline`` choke point — the
+        ``"t_end"`` selection already skips it), and the 2-D whole-run
+        steppers' in-core padding is unproven under batching."""
+        fused = self._fused_stepper(mode="t_end")
+        if fused is None:
+            return None
+        if fused.engaged_label != "fused-stage" or getattr(
+            fused, "sharded", False
+        ):
+            return self._decline(
+                f"ensemble vmap serves the fused-stage rung only; "
+                f"{fused.engaged_label} declines batching"
+            )
+        return fused
+
+    def _ensemble_pack(self, operands, members: int):
+        """Normalize ``{name: (B,)-array}`` member-varying operands to
+        ``(names, (B, P) matrix)`` in a deterministic column order; an
+        empty/None dict packs to a zero-width matrix (uniform physics,
+        fused-eligible)."""
+        if not operands:
+            return (), jnp.zeros((members, 0), jnp.float32)
+        names = tuple(sorted(operands))
+        self._ensemble_gate(names)
+        cols = []
+        for n in names:
+            col = jnp.asarray(operands[n], jnp.float32).reshape(-1)
+            if col.shape[0] != members:
+                raise ValueError(
+                    f"operand {n!r} has {col.shape[0]} values for "
+                    f"{members} members"
+                )
+            cols.append(col)
+        return names, jnp.stack(cols, axis=1)
+
+    def _ensemble_record(self, members, stepper, mode, names):
+        """Record + emit the dispatch facts (``ensemble:dispatch``
+        events; ``engaged`` provenance for bench rows and the CLI
+        summary — the reference's PrintSummary discipline applied to
+        the batched engine)."""
+        self._ensemble_last = {
+            "members": int(members),
+            "stepper": stepper,
+            "mode": mode,
+            "operands": list(names),
+        }
+        from multigpu_advectiondiffusion_tpu import telemetry
+
+        telemetry.event(
+            "ensemble", "dispatch",
+            members=int(members), stepper=stepper, mode=mode,
+            operands=list(names),
+        )
+
+    def run_ensemble(self, estate: EnsembleState, num_iters: int,
+                     operands=None) -> EnsembleState:
+        """Advance every member ``num_iters`` steps in ONE dispatch.
+
+        Uniform-physics ensembles (no ``operands``) ``vmap`` the fused
+        per-stage stepper where the config engages it — bit-exact
+        against the looped single runs (tests/test_ensemble.py);
+        member-varying scalars (``{name: (B,) values}`` for the names
+        in :meth:`ensemble_operands`) ride the generic stepper with the
+        scalars as batched operands."""
+        B = estate.members
+        names, ops = self._ensemble_pack(operands, B)
+        self._ensemble_gate(names)
+        fused = self._ensemble_fused() if not names else None
+        label = (
+            f"ensemble-vmap[{fused.engaged_label}]"
+            if fused is not None
+            else "ensemble-vmap[generic-xla]"
+        )
+        self._ensemble_record(B, label, "iters", names)
+        with self._dispatch_span("run_ensemble", mode="t_end",
+                                 iters=int(num_iters), members=B):
+            if fused is not None:
+                def block(us, ts):
+                    return jax.vmap(
+                        lambda u, t: fused.run(u, t, num_iters)
+                    )(us, ts)
+
+                f = self._compiled(
+                    ("ens_fused_run", num_iters, B),
+                    lambda: jax.jit(block), steps=int(num_iters),
+                )
+                u, t = f(estate.u, estate.t)
+                return EnsembleState(u=u, t=t, it=estate.it + num_iters)
+
+            def member(u, t, p):
+                ov = {n: p[i] for i, n in enumerate(names)} or None
+
+                def body(i, c):
+                    return self._local_step(c[0], c[1], overrides=ov)
+
+                return lax.fori_loop(0, num_iters, body, (u, t))
+
+            def block(us, ts, ps):
+                return jax.vmap(member)(us, ts, ps)
+
+            f = self._compiled(
+                ("ens_run", num_iters, B, names),
+                lambda: jax.jit(block), steps=int(num_iters),
+            )
+            u, t = f(estate.u, estate.t, ops)
+            return EnsembleState(u=u, t=t, it=estate.it + num_iters)
+
+    def advance_to_ensemble(self, estate: EnsembleState, t_end: float,
+                            operands=None) -> EnsembleState:
+        """March every member to ``t_end`` in one dispatch (vmapped
+        while-loop; finished members freeze while stragglers — e.g.
+        smaller member dt — keep stepping). Generic rung only: the
+        fused ``run_to`` loops host their own scalar plumbing that the
+        member axis does not fold."""
+        B = estate.members
+        names, ops = self._ensemble_pack(operands, B)
+        self._ensemble_gate(names)
+        self._ensemble_record(B, "ensemble-vmap[generic-xla]", "t_end",
+                              names)
+        with self._dispatch_span("advance_to_ensemble", mode="t_end",
+                                 t_end=float(t_end), members=B):
+            def member(u, t, p, te):
+                ov = {n: p[i] for i, n in enumerate(names)} or None
+                eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+
+                def cond(c):
+                    return c[1] < te - eps
+
+                def body(c):
+                    u, t, it = c
+                    u, t = self._local_step(u, t, t_end=te, overrides=ov)
+                    return (u, t, it + 1)
+
+                return lax.while_loop(
+                    cond, body, (u, t, jnp.zeros((), jnp.int32))
+                )
+
+            def block(us, ts, ps, te):
+                return jax.vmap(member, in_axes=(0, 0, 0, None))(
+                    us, ts, ps, te
+                )
+
+            f = self._compiled(
+                ("ens_adv", B, names), lambda: jax.jit(block)
+            )
+            u, t, steps = f(
+                estate.u, estate.t, ops,
+                jnp.asarray(t_end, estate.t.dtype),
+            )
+            return EnsembleState(u=u, t=t, it=estate.it + steps)

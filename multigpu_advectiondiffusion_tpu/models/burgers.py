@@ -131,10 +131,25 @@ class BurgersSolver(SolverBase):
             spec["rules"].append(physics.tv_monotone_rule())
         return spec
 
-    def build_local(self, ctx: StepContext) -> LocalPhysics:
+    def ensemble_operands(self) -> dict:
+        """Member-varying scalars the batched ensemble engine may pass
+        as traced operands: the CFL number (fixed-dt members get
+        ``cfl * min(dx)`` re-derived in-trace; adaptive members scale
+        their wave-speed dt). Riemann-state sweeps vary through
+        per-member initial conditions, not operands."""
+        return {"cfl": float(self.cfg.cfl)}
+
+    def build_local(self, ctx: StepContext, overrides=None) -> LocalPhysics:
         cfg = self.cfg
         spacing = cfg.grid.spacing
         fx = self.flux
+        # ensemble mode: a traced per-member CFL enters as an operand
+        cfl = cfg.cfl
+        fixed_dt = self.dt
+        if overrides and "cfl" in overrides:
+            cfl = overrides["cfl"]
+            if not cfg.adaptive_dt:
+                fixed_dt = cfl * min(spacing)
 
         ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
         # Burgers has no whole-step variant; any pallas flavor (e.g. the
@@ -171,11 +186,11 @@ class BurgersSolver(SolverBase):
 
         if cfg.adaptive_dt:
             dt_fn = lambda u: advective_dt(  # noqa: E731
-                u, fx.df, spacing, cfg.cfl, reduce_max=ctx.reduce_max
+                u, fx.df, spacing, cfl, reduce_max=ctx.reduce_max
             )
             return LocalPhysics(rhs=rhs, dt_fn=dt_fn)
         # CUDA-parity fixed dt: CFL * dx / 1.0 (Burgers3d_Baseline/main.c:193)
-        return LocalPhysics(rhs=rhs, static_dt=self.dt)
+        return LocalPhysics(rhs=rhs, static_dt=fixed_dt)
 
     # ------------------------------------------------------------------ #
     # Fully-fused Pallas fast path (single chip, fixed dt, edge BCs)

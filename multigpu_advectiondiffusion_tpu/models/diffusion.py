@@ -146,10 +146,24 @@ class DiffusionSolver(SolverBase):
             spec["meta"]["decay_rate_analytic"] = -self.grid.ndim / 2.0
         return spec
 
-    def build_local(self, ctx: StepContext) -> LocalPhysics:
+    def ensemble_operands(self) -> dict:
+        """Member-varying scalars the batched ensemble engine may pass
+        as traced operands: the diffusivity K (which also moves the
+        stability dt, recomputed in-trace per member)."""
+        return {"diffusivity": float(self.cfg.diffusivity)}
+
+    def build_local(self, ctx: StepContext, overrides=None) -> LocalPhysics:
         cfg = self.cfg
         grid = cfg.grid
         bcs = self.bcs
+        # ensemble mode: a traced per-member K enters as an operand
+        # (closure constants cannot vary along the vmapped member axis);
+        # the stability dt is re-derived from it in-trace
+        K = cfg.diffusivity
+        dt = self.dt
+        if overrides and "diffusivity" in overrides:
+            K = overrides["diffusivity"]
+            dt = diffusive_dt(K, grid.spacing, cfg.safety)
 
         if cfg.geometry == "axisymmetric":
             r = grid.coords(1, self.dtype)
@@ -170,7 +184,7 @@ class DiffusionSolver(SolverBase):
                     u,
                     grid.spacing,
                     inv_r_local,
-                    diffusivity=cfg.diffusivity,
+                    diffusivity=K,
                     padder=ctx.padder,
                     on_axis=on_axis_local,
                 )
@@ -180,12 +194,24 @@ class DiffusionSolver(SolverBase):
             ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
 
             impl = self._op_impl()
+            if impl == "pallas" and overrides and "diffusivity" in overrides:
+                # ensemble operand mode: the per-axis Pallas kernels bake
+                # their coefficients as compile-time constants and reject
+                # a traced per-member K (captured-constant error) — the
+                # batched generic path runs the XLA stencils instead,
+                # recorded like every other per-op fallback
+                self._op_fallback = (
+                    "member-varying diffusivity is a traced operand; "
+                    "per-axis Pallas kernels bake constants — XLA runs"
+                )
+                impl = "xla"
 
             def operator(u):
+                # a list keeps traced per-member K indexable per axis
                 return laplacian(
                     u,
                     grid.spacing,
-                    diffusivity=cfg.diffusivity,
+                    diffusivity=[K] * grid.ndim,
                     order=cfg.order,
                     padder=ctx.padder,
                     impl=impl,
@@ -234,7 +260,7 @@ class DiffusionSolver(SolverBase):
                     u = jnp.take(u, lidx, axis=a)
                 return u
 
-        return LocalPhysics(rhs=rhs, static_dt=self.dt, post=post)
+        return LocalPhysics(rhs=rhs, static_dt=dt, post=post)
 
     # ------------------------------------------------------------------ #
     # Fully-fused Pallas fast path (single-chip or shard-local under a

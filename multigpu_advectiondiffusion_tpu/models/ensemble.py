@@ -1,0 +1,296 @@
+"""Batched ensemble execution engine (ROADMAP item 1, ISSUE 9).
+
+The reference runs one binary per configuration and archives each
+timing by hand (``Run.m`` comments); a parameter sweep is N serialized
+processes, each paying compile, dispatch and HBM streaming alone. Here
+the sweep is ONE batched launch: :class:`EnsembleSolver` builds a
+``(B, *grid)`` initial state from per-member overrides (initial
+conditions and/or the solver's member-varying scalars — diffusivity K,
+CFL, Burgers Riemann states via ICs) and advances all B members per
+dispatch through ``SolverBase.run_ensemble`` / ``advance_to_ensemble``:
+
+* uniform-physics ensembles (IC sweeps) ``vmap`` the fused per-stage
+  stepper — bit-exact against the looped single runs
+  (tests/test_ensemble.py);
+* scalar sweeps ride the generic stepper with the member scalars as
+  batched operands (never closure constants);
+* the slab whole-run rung declines batching loudly (its
+  (timestep x z-slab) grid does not fold a member axis), as does a
+  device mesh — members, not shards, are the parallel axis here.
+
+Divergence stays member-attributed: the sentinel reduces per member
+(``resilience/sentinel.make_ensemble_probe``), so one blown-up member
+raises :class:`~..resilience.errors.EnsembleMemberDivergedError`
+naming its index while the others' results remain valid.
+
+Pairs with the persistent AOT executable cache
+(``tuning/aot_cache.py``): a repeat of the same batched request loads
+the compiled executable from disk instead of recompiling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from multigpu_advectiondiffusion_tpu.models.state import EnsembleState
+from multigpu_advectiondiffusion_tpu.resilience.errors import (
+    EnsembleMemberDivergedError,
+)
+
+# member-override keys that rebuild the member's INITIAL STATE (via a
+# per-member config) but do not enter the batched step as operands
+_IC_KEYS = ("ic", "ic_params", "t0")
+
+
+def parse_sweep_spec(spec: str, members: int) -> tuple:
+    """``'NAME=a:b'`` (linear sweep) or ``'NAME=v1,v2,...'`` (explicit,
+    one value per member) -> ``(name, [B floats])`` — the CLI
+    ``--sweep`` grammar."""
+    name, sep, body = spec.partition("=")
+    name = name.strip()
+    if not sep or not name or not body:
+        raise ValueError(
+            f"--sweep wants NAME=a:b or NAME=v1,v2,...; got {spec!r}"
+        )
+    if ":" in body:
+        lo, _, hi = body.partition(":")
+        values = np.linspace(float(lo), float(hi), members)
+        return name, [float(v) for v in values]
+    values = [float(v) for v in body.split(",")]
+    if len(values) != members:
+        raise ValueError(
+            f"--sweep {name}: {len(values)} values for {members} members"
+        )
+    return name, values
+
+
+class EnsembleSolver:
+    """Front end over one template solver: build the batched state,
+    dispatch the batched programs, thread per-member summaries out.
+
+    ``members`` is either an int B (B identical members — the pure
+    amortization case) or a sequence of per-member override dicts whose
+    keys are the solver's :meth:`~..models.base.SolverBase.
+    ensemble_operands` names (member-varying scalars) and/or the IC
+    keys ``ic``/``ic_params``/``t0`` (member-varying initial states,
+    e.g. Burgers Riemann ``left``/``right`` sweeps via
+    ``ic_params``)."""
+
+    def __init__(self, solver_cls, cfg, members, mesh=None, decomp=None):
+        if mesh is not None or decomp is not None:
+            raise ValueError(
+                "ensemble batching composes members on one device; a "
+                "mesh shards a single member's grid — drop --mesh for "
+                "--ensemble runs"
+            )
+        if isinstance(members, int):
+            if members < 1:
+                raise ValueError("an ensemble needs at least one member")
+            members = [{} for _ in range(members)]
+        self._overrides = [dict(m) for m in members]
+        self.members = len(self._overrides)
+        if cfg.impl == "auto":
+            # measured dispatch, keyed BY the ensemble dimension: a
+            # B=64 decision is never served to a B=1 run (and vice
+            # versa) — tuning/autotuner.make_key carries ens=B
+            from multigpu_advectiondiffusion_tpu import tuning
+
+            decision = tuning.resolve(
+                solver_cls, cfg, None, None, ensemble=self.members
+            )
+            self._tuned = decision
+            cfg = dataclasses.replace(cfg, impl=decision["impl"])
+        else:
+            self._tuned = None
+        self.solver_cls = solver_cls
+        self.cfg = cfg
+        self.solver = solver_cls(cfg)  # the template every member shares
+        supported = set(self.solver.ensemble_operands())
+        for i, ov in enumerate(self._overrides):
+            unknown = sorted(set(ov) - supported - set(_IC_KEYS))
+            if unknown:
+                raise ValueError(
+                    f"member {i}: override(s) {unknown} are neither "
+                    f"member-varying operands ({sorted(supported)}) nor "
+                    f"IC keys {list(_IC_KEYS)} — structure-changing "
+                    "knobs (impl, weno_order, grid, ...) cannot vary "
+                    "inside one batched executable"
+                )
+        # construction-time loud gate (mesh/slab-pin/k>1/operand names)
+        self.solver._ensemble_gate(
+            tuple(k for ov in self._overrides for k in ov
+                  if k in supported)
+        )
+        self._probe = None
+        self._baseline = None
+
+    # ------------------------------------------------------------------ #
+    # State + operands
+    # ------------------------------------------------------------------ #
+    def member_cfg(self, i: int):
+        """Member ``i``'s effective config (template + its overrides) —
+        used for per-member initial states and summaries; execution
+        itself stays on the ONE batched program."""
+        ov = {
+            k: v for k, v in self._overrides[i].items()
+            if k in {f.name for f in dataclasses.fields(self.cfg)}
+        }
+        if "ic_params" in ov and not isinstance(ov["ic_params"], tuple):
+            ov["ic_params"] = tuple(
+                (k, v) for k, v in dict(ov["ic_params"]).items()
+            )
+        return dataclasses.replace(self.cfg, **ov) if ov else self.cfg
+
+    def member_solver(self, i: int):
+        """A throwaway single-member solver for member ``i`` (initial
+        states, analytic solutions, looped-baseline benches) — never
+        the execution path."""
+        return self.solver_cls(self.member_cfg(i))
+
+    def initial_state(self) -> EnsembleState:
+        states = [
+            self.member_solver(i).initial_state()
+            for i in range(self.members)
+        ]
+        est = EnsembleState.stack(states)
+        self.arm(est)
+        return est
+
+    def operands(self) -> Optional[dict]:
+        """``{name: [B values]}`` for every member-varying scalar where
+        any member differs from the template default; ``None`` when the
+        physics is uniform (the fused-eligible case)."""
+        defaults = self.solver.ensemble_operands()
+        out = {}
+        for name, default in defaults.items():
+            col = [
+                float(ov.get(name, default)) for ov in self._overrides
+            ]
+            if any(v != float(default) for v in col):
+                out[name] = col
+        return out or None
+
+    # ------------------------------------------------------------------ #
+    # Execution
+    # ------------------------------------------------------------------ #
+    def run(self, estate: EnsembleState, num_iters: int) -> EnsembleState:
+        return self.solver.run_ensemble(
+            estate, num_iters, operands=self.operands()
+        )
+
+    def advance_to(self, estate: EnsembleState,
+                   t_end: float) -> EnsembleState:
+        return self.solver.advance_to_ensemble(
+            estate, t_end, operands=self.operands()
+        )
+
+    def engaged_path(self) -> dict:
+        """Batched-dispatch provenance: the inner stepper the vmap
+        wraps, the member count, and (``impl='auto'``) the tuner
+        decision — the bench rows' engagement-guard surface."""
+        last = getattr(self.solver, "_ensemble_last", None) or {}
+        out = {
+            "impl": getattr(self.solver, "_requested_impl", self.cfg.impl),
+            "stepper": last.get("stepper", "ensemble-vmap[unrun]"),
+            "ensemble": self.members,
+            "operands": last.get("operands", []),
+            "fallback": getattr(self.solver, "_fused_fallback", None),
+        }
+        if self._tuned is not None:
+            out["tuned"] = {
+                k: self._tuned.get(k)
+                for k in ("source", "impl", "mlups", "key")
+                if k in self._tuned
+            }
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Per-member health + summaries
+    # ------------------------------------------------------------------ #
+    def _get_probe(self):
+        if self._probe is None:
+            from multigpu_advectiondiffusion_tpu.resilience.sentinel import (
+                make_ensemble_probe,
+            )
+
+            self._probe = make_ensemble_probe(self.solver)
+        return self._probe
+
+    def arm(self, estate: EnsembleState) -> None:
+        """Record the per-member healthy baseline (mass integrals and
+        norms) the drift reports and the growth bound read against."""
+        stats = self._get_probe()(estate)
+        bad = [
+            i for i, m in enumerate(stats["max_abs"])
+            if not np.isfinite(m)
+        ]
+        if bad:
+            raise EnsembleMemberDivergedError(
+                int(np.max(np.asarray(estate.it))),
+                float(np.max(np.asarray(estate.t))),
+                bad, [stats["max_abs"][i] for i in bad],
+                reason="non-finite initial state",
+            )
+        self._baseline = stats
+
+    def check_health(self, estate: EnsembleState,
+                     growth: float = 1e3) -> dict:
+        """Per-member divergence check: non-finite members (or members
+        whose norm grew past ``growth * max(1, |u0|)``) raise
+        :class:`EnsembleMemberDivergedError` naming their indices —
+        the rest of the batch stays valid. Returns the per-member
+        stats dict on health."""
+        stats = self._get_probe()(estate)
+        norms = stats["max_abs"]
+        bad, why = [], None
+        for i, m in enumerate(norms):
+            if not np.isfinite(m):
+                bad.append(i)
+                why = "non-finite field"
+        if not bad and self._baseline is not None:
+            for i, m in enumerate(norms):
+                bound = growth * max(1.0, self._baseline["max_abs"][i])
+                if m > bound:
+                    bad.append(i)
+                    why = f"norm grew past the growth bound ({growth:g})"
+        if bad:
+            raise EnsembleMemberDivergedError(
+                int(np.max(np.asarray(estate.it))),
+                float(np.max(np.asarray(estate.t))),
+                bad, [norms[i] for i in bad], reason=why,
+            )
+        return stats
+
+    def member_summaries(self, estate: EnsembleState) -> list:
+        """One dict per member (max|u|, min/max, l2, mass, mass drift
+        vs the armed baseline, final t/it, its overrides) — the batched
+        run's answer to the reference's per-run PrintSummary."""
+        stats = self._get_probe()(estate)
+        t = np.asarray(estate.t)
+        it = np.asarray(estate.it)
+        out = []
+        for i in range(self.members):
+            row = {
+                "member": i,
+                "t": float(t[i]),
+                "it": int(it[i]),
+                "max_abs": stats["max_abs"][i],
+                "min": stats["min"][i],
+                "max": stats["max"][i],
+                "l2": stats["l2"][i],
+                "mass": stats["mass"][i],
+            }
+            if self._baseline is not None:
+                m0 = self._baseline["mass"][i]
+                row["mass_drift"] = (row["mass"] - m0) / max(
+                    abs(m0), 1e-30
+                )
+            if self._overrides[i]:
+                row["overrides"] = {
+                    k: v for k, v in self._overrides[i].items()
+                }
+            out.append(row)
+        return out

@@ -24,3 +24,41 @@ class SolverState(NamedTuple):
         return SolverState(
             u=u, t=jnp.asarray(t, dtype=rdt), it=jnp.asarray(0, dtype=jnp.int32)
         )
+
+
+class EnsembleState(NamedTuple):
+    """A batch of B independent solver states advanced by ONE dispatch.
+
+    The member axis leads every field: ``u`` is ``(B, *grid.shape)``,
+    ``t`` and ``it`` are ``(B,)`` — members may sit at different
+    simulated times (member-varying dt) and, in ``advance_to`` mode,
+    different step counts. A pytree like :class:`SolverState`, so the
+    batched programs flow through ``jit``/``vmap``/``lax`` loops
+    unchanged.
+    """
+
+    u: jnp.ndarray   # (B, *grid.shape)
+    t: jnp.ndarray   # (B,)
+    it: jnp.ndarray  # (B,) int32
+
+    @property
+    def members(self) -> int:
+        return int(self.u.shape[0])
+
+    @staticmethod
+    def stack(states) -> "EnsembleState":
+        """Batch B single-member states into one ensemble state."""
+        states = list(states)
+        if not states:
+            raise ValueError("an ensemble needs at least one member")
+        return EnsembleState(
+            u=jnp.stack([s.u for s in states]),
+            t=jnp.stack([jnp.asarray(s.t) for s in states]),
+            it=jnp.stack(
+                [jnp.asarray(s.it, dtype=jnp.int32) for s in states]
+            ),
+        )
+
+    def member(self, i: int) -> SolverState:
+        """Member ``i`` as a plain :class:`SolverState` view."""
+        return SolverState(u=self.u[i], t=self.t[i], it=self.it[i])

@@ -25,6 +25,32 @@ class SolverDivergedError(RuntimeError):
         )
 
 
+class EnsembleMemberDivergedError(SolverDivergedError):
+    """One (or more) members of a batched ensemble run diverged.
+
+    The ensemble sentinel reduces PER MEMBER — one member's NaN or
+    norm blow-up must name its index instead of poisoning the whole
+    batch's verdict. Carries ``members`` (offending indices) and
+    ``norms`` (their max-norms); ``norm`` is the worst one, so the
+    error still quacks like a :class:`SolverDivergedError` for
+    existing handlers."""
+
+    def __init__(self, step: int, t: float, members, norms,
+                 reason: str = "non-finite field"):
+        self.members = [int(m) for m in members]
+        self.member_norms = [float(n) for n in norms]
+        worst = max(
+            (n for n in self.member_norms), default=float("nan")
+        )
+        super().__init__(
+            step, t, worst,
+            reason=(
+                f"{reason} in ensemble member(s) "
+                f"{self.members} of the batch"
+            ),
+        )
+
+
 class SDCDetectedError(SolverDivergedError):
     """The silent-data-corruption guard re-executed one step from a
     probed state and the two executions disagreed bit-for-bit on a

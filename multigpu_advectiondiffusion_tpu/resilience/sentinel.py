@@ -121,6 +121,46 @@ def make_health_probe(solver, diagnostics: bool = False):
     return probe
 
 
+def make_ensemble_probe(solver):
+    """Per-member health/physics probe for batched ensemble states:
+    ``EnsembleState -> {key: (B,) list}`` of ``max_abs`` (non-finite
+    mapped to +inf, like the single-run probe), ``min``, ``max``,
+    ``l2`` and ``mass`` — ONE jitted vmapped reduction pass, reduced
+    along each member's own axes only, so one diverging member reports
+    its index instead of poisoning the batch (the member analog of the
+    mesh-aware probe above). Ensemble runs are single-device per
+    member, so no mesh reduction applies."""
+    import jax
+
+    vol = math.prod(solver.grid.spacing)
+
+    def one(u):
+        a = jnp.abs(u).astype(jnp.float32)
+        a = jnp.where(jnp.isnan(a), jnp.inf, a)
+        uf = u.astype(jnp.float32)
+        return (
+            jnp.max(a), jnp.min(uf), jnp.max(uf),
+            jnp.sum(uf * uf), jnp.sum(uf),
+        )
+
+    f = jax.jit(jax.vmap(one))
+
+    def probe(estate) -> dict:
+        m, umin, umax, s2, s = (list(map(float, v)) for v in f(estate.u))
+        return {
+            "max_abs": m,
+            "min": umin,
+            "max": umax,
+            "l2": [
+                math.sqrt(max(vol * x, 0.0)) if math.isfinite(x) else x
+                for x in s2
+            ],
+            "mass": [vol * x for x in s],
+        }
+
+    return probe
+
+
 def duplicate_step_check(solver, state):
     """Silent-data-corruption probe: execute ONE step twice from the
     same ``state`` and compare the results bit-for-bit.

@@ -81,6 +81,18 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "fallback": set(),
         None: set(),
     },
+    # batched ensemble engine (models/base.run_ensemble /
+    # advance_to_ensemble): one event per batched dispatch, carrying
+    # the member count and the vmapped inner stepper
+    "ensemble": {"dispatch": {"members", "stepper"}},
+    # persistent AOT executable cache (tuning/aot_cache.py): every
+    # lookup is a hit or a (reasoned) miss, every write a store —
+    # out/ensemble_gate.sh gates the warm-run hit on these
+    "aot_cache": {
+        "hit": {"key", "compile_seconds_saved"},
+        "miss": {"key", "reason"},
+        "store": {"key", "persisted"},
+    },
     "progress": {"chunk": {"step", "steps_done", "step_seconds"}},
     "perf": {
         "outlier": {"step", "step_seconds", "median", "threshold"},

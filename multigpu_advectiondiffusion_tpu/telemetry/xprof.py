@@ -115,6 +115,13 @@ class ExecRecord:
     # (None where the model has no opinion)
     model_bytes_per_step: Optional[float]
     model_flops_per_step: Optional[float]
+    # persistent AOT executable cache (tuning/aot_cache.py): "hit"
+    # when this executable was deserialized instead of compiled (then
+    # compile_seconds is the load time and compile_seconds_saved the
+    # original build's compile cost), "store"/"miss" otherwise; None
+    # when the cache is disabled
+    aot: Optional[str] = None
+    compile_seconds_saved: Optional[float] = None
 
     def to_fields(self) -> dict:
         return dataclasses.asdict(self)
@@ -192,23 +199,51 @@ class _IntrospectedDispatch:
     ladder expects it and an aval/sharding change simply retraces.
     """
 
-    def __init__(self, fn, solver, key: str, steps: Optional[int] = None):
+    def __init__(self, fn, solver, key: str, steps: Optional[int] = None,
+                 aot_key: Optional[str] = None):
         self._fn = fn
         self._solver = solver
         self._key = key
         self._steps = steps
+        self._aot_key = aot_key
         self._compiled = None
         self._fallback = False
         self.record: Optional[ExecRecord] = None
+
+    def _aot_resolve(self, args):
+        """Persistent AOT cache (tuning/aot_cache.py): returns
+        ``(compiled, compile_seconds, aot_status, saved)`` — loading
+        the stored executable on a hit, compiling (and storing) on a
+        miss. ``aot_status`` is None when the cache is off."""
+        from multigpu_advectiondiffusion_tpu.tuning import aot_cache
+
+        full_key = None
+        if self._aot_key and aot_cache.enabled():
+            full_key = (
+                f"{self._aot_key}|avals={aot_cache.aval_fingerprint(args)}"
+            )
+            loaded = aot_cache.load(full_key, args)
+            if loaded is not None:
+                compiled, meta = loaded
+                return (
+                    compiled, meta["load_seconds"], "hit",
+                    meta["compile_seconds_saved"],
+                )
+        t0 = time.perf_counter()
+        compiled = self._fn.lower(*args).compile()
+        compile_s = time.perf_counter() - t0
+        if full_key is not None:
+            persisted = aot_cache.store(full_key, args, compiled,
+                                        compile_s)
+            return compiled, compile_s, "store" if persisted else "miss", None
+        return compiled, compile_s, None, None
 
     def __call__(self, *args):
         if self._fallback:
             return self._fn(*args)
         if self._compiled is None:
             try:
-                t0 = time.perf_counter()
-                compiled = self._fn.lower(*args).compile()
-                compile_s = time.perf_counter() - t0
+                compiled, compile_s, aot, saved = self._aot_resolve(args)
             except Exception:
                 # compile failures must propagate from the PLAIN path:
                 # the kernel ladder classifies them there
@@ -216,7 +251,8 @@ class _IntrospectedDispatch:
                 return self._fn(*args)
             self._compiled = compiled
             self.record = _capture(
-                compiled, self._solver, self._key, self._steps, compile_s
+                compiled, self._solver, self._key, self._steps, compile_s,
+                aot=aot, compile_seconds_saved=saved,
             )
         try:
             return self._compiled(*args)
@@ -227,7 +263,9 @@ class _IntrospectedDispatch:
 
 
 def _capture(compiled, solver, key: str, steps: Optional[int],
-             compile_s: float) -> Optional[ExecRecord]:
+             compile_s: float, aot: Optional[str] = None,
+             compile_seconds_saved: Optional[float] = None,
+             ) -> Optional[ExecRecord]:
     """Build (and register + emit) the ExecRecord for one compiled
     executable; every probe is individually fault-tolerant."""
     try:
@@ -273,6 +311,11 @@ def _capture(compiled, solver, key: str, steps: Optional[int],
         compile_seconds=round(compile_s, 6),
         model_bytes_per_step=model_bytes,
         model_flops_per_step=model_flops,
+        aot=aot,
+        compile_seconds_saved=(
+            None if compile_seconds_saved is None
+            else round(compile_seconds_saved, 6)
+        ),
         **cost,
         **mem,
     )
@@ -289,13 +332,17 @@ def _capture(compiled, solver, key: str, steps: Optional[int],
     return record
 
 
-def wrap_dispatch(fn, solver, key: str, steps: Optional[int] = None):
+def wrap_dispatch(fn, solver, key: str, steps: Optional[int] = None,
+                  aot_key: Optional[str] = None):
     """Dispatch-layer hook: wrap a freshly built jitted program for
     measured introspection (no-op passthrough when ``TPUCFD_XPROF=0``
-    or the builder returned something un-lowerable)."""
+    or the builder returned something un-lowerable). ``aot_key``
+    additionally routes the first-call compile through the persistent
+    AOT executable cache (tuning/aot_cache.py)."""
     if not enabled() or not hasattr(fn, "lower"):
         return fn
-    return _IntrospectedDispatch(fn, solver, key, steps=steps)
+    return _IntrospectedDispatch(fn, solver, key, steps=steps,
+                                 aot_key=aot_key)
 
 
 # --------------------------------------------------------------------- #

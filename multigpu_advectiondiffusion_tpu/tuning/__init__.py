@@ -25,6 +25,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from multigpu_advectiondiffusion_tpu.tuning import aot_cache  # noqa: F401
 from multigpu_advectiondiffusion_tpu.tuning import autotuner  # noqa: F401
 from multigpu_advectiondiffusion_tpu.tuning.autotuner import (  # noqa: F401
     autotune,
@@ -40,6 +41,7 @@ from multigpu_advectiondiffusion_tpu.tuning.cache import (  # noqa: F401
 
 __all__ = [
     "TuningCache",
+    "aot_cache",
     "autotune",
     "candidates",
     "configure",
@@ -101,13 +103,16 @@ def _measure_params():
     return max(1, iters), max(1, reps), prune
 
 
-def resolve(solver_cls, cfg, mesh, decomp) -> dict:
+def resolve(solver_cls, cfg, mesh, decomp, ensemble: int = 1) -> dict:
     """Resolve ``impl="auto"`` for one solver construction; see the
-    module docstring for the hit/miss/disabled contract."""
+    module docstring for the hit/miss/disabled contract. ``ensemble``
+    is the batched-engine member count — part of the key, so a B=64
+    decision is never served to a B=1 run (and vice versa)."""
     import jax
 
     backend = jax.default_backend()
-    key = make_key(solver_cls, cfg, mesh, decomp, backend)
+    key = make_key(solver_cls, cfg, mesh, decomp, backend,
+                   ensemble=ensemble)
     cache = TuningCache(cache_path())
     hit = cache.get(key)
     autotuner._emit("lookup", key=key, hit=hit is not None,
@@ -130,4 +135,4 @@ def resolve(solver_cls, cfg, mesh, decomp) -> dict:
         return decision
     iters, reps, prune = _measure_params()
     return autotune(solver_cls, cfg, mesh, decomp, cache, key,
-                    iters, reps, prune)
+                    iters, reps, prune, ensemble=ensemble)

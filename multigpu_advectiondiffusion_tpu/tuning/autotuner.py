@@ -71,11 +71,15 @@ def _mesh_tokens(mesh, decomp):
     return f"mesh={sizes};decomp={axes}"
 
 
-def make_key(solver_cls, cfg, mesh, decomp, backend: str) -> str:
+def make_key(solver_cls, cfg, mesh, decomp, backend: str,
+             ensemble: int = 1) -> str:
     """The tuning key: everything that changes which ``(rung, k)`` wins.
     Kernel-strategy knobs that the tuner itself decides (impl,
     steps_per_exchange) are excluded; physics scalars that do not change
-    kernel structure (diffusivity value, flux params) are too."""
+    kernel structure (diffusivity value, flux params) are too.
+    ``ensemble`` is the batched-engine member count B — a B=64 decision
+    (amortized dispatch, different winning rung economics) must never
+    be served to a B=1 run, so it is a first-class key dimension."""
     kind = costmodel.solver_kind(cfg) or type(cfg).__name__
     shape = "x".join(map(str, cfg.grid.shape))
     parts = [
@@ -87,6 +91,7 @@ def make_key(solver_cls, cfg, mesh, decomp, backend: str) -> str:
         f"overlap={getattr(cfg, 'overlap', None)}",
         _mesh_tokens(mesh, decomp),
         f"backend={backend}",
+        f"ens={max(1, int(ensemble))}",
     ]
     if kind == "burgers":
         parts += [
@@ -239,8 +244,12 @@ def measure_candidate(solver_cls, cfg, mesh, decomp, cand,
 
 
 def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
-             iters: int, reps: int, prune_ratio: float) -> dict:
-    """Measure the pruned candidate space and persist the winner."""
+             iters: int, reps: int, prune_ratio: float,
+             ensemble: int = 1) -> dict:
+    """Measure the pruned candidate space and persist the winner.
+    ``ensemble > 1`` restricts the space to the rungs the batched
+    engine serves (the slab rung and the k-schedule decline member
+    batching) — measurement stays single-run, the per-member proxy."""
     import jax
 
     backend = jax.default_backend()
@@ -251,6 +260,12 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
         else decomp.local_shape(mesh, cfg.grid.shape)
     )
     cands = candidates(solver_cls, cfg, mesh, decomp)
+    if ensemble > 1:
+        cands = [
+            c for c in cands
+            if c["impl"] != "pallas_slab"
+            and c["steps_per_exchange"] == 1
+        ] or [{"impl": "pallas_stage", "steps_per_exchange": 1}]
     best_model = None
     for c in cands:
         t = modeled_step_seconds(cfg, lshape, c, devices, backend)
@@ -315,6 +330,7 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
         "source": choice["source"],
         "backend": backend,
         "devices": devices,
+        "ensemble": max(1, int(ensemble)),
         "key": key,
         "tuner": {"iters": iters, "reps": reps,
                   "prune_ratio": prune_ratio},

@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Ensemble-engine gate (ISSUE 9):
+#
+# 1. Cold-vs-warm AOT executable cache selftest: the same batched
+#    ensemble CLI request is run twice against a fresh TPUCFD_AOT_CACHE.
+#    The cold run must compile and STORE every dispatch program; the
+#    warm run must HIT for every program — zero misses, zero stores,
+#    i.e. zero recompiles of the cached executables — and its xla:cost
+#    events must record the compile seconds saved.
+# 2. bench/compare.py coverage selftest for the ensemble_* rows: new
+#    rounds carrying the `ensemble`/`vs_looped` columns must compare
+#    cleanly against pre-ensemble rounds (BENCH_r01-r05 rows have
+#    neither field), and a dropped ensemble column must surface as a
+#    non-gating coverage note (the MEASURED_FIELDS discipline).
+#
+#   ./out/ensemble_gate.sh          # run both selftests
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+export TPUCFD_AOT_CACHE="$TMP/aot"
+
+CMD=(python -m multigpu_advectiondiffusion_tpu.cli diffusion3d
+     --n 20 16 12 --iters 4 --ensemble 4 --sweep K=0.5:2.0 --impl xla)
+
+echo "ensemble_gate: cold run (compile + store)"
+"${CMD[@]}" --metrics "$TMP/cold.jsonl" > "$TMP/cold.out"
+echo "ensemble_gate: warm run (must hit the AOT cache, zero recompiles)"
+"${CMD[@]}" --metrics "$TMP/warm.jsonl" > "$TMP/warm.out"
+
+python - "$TMP/cold.jsonl" "$TMP/warm.jsonl" <<'PY'
+import json, sys
+
+def events(path):
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+cold = [e for e in events(sys.argv[1]) if e["kind"] == "aot_cache"]
+warm = [e for e in events(sys.argv[2]) if e["kind"] == "aot_cache"]
+stores = [e for e in cold if e["name"] == "store" and e.get("persisted")]
+assert stores, f"cold run persisted nothing: {cold}"
+assert not [e for e in cold if e["name"] == "hit"], \
+    "cold run hit a fresh cache?"
+hits = [e for e in warm if e["name"] == "hit"]
+assert hits, f"warm run must emit aot_cache:hit; got {warm}"
+recompiles = [e for e in warm if e["name"] in ("miss", "store")]
+assert not recompiles, f"warm run recompiled: {recompiles}"
+xla = [e for e in events(sys.argv[2])
+       if e["kind"] == "xla" and e["name"] == "cost"]
+not_loaded = [e["key"] for e in xla if e.get("aot") != "hit"]
+assert not not_loaded, \
+    f"warm xla:cost events not served from the AOT cache: {not_loaded}"
+saved = sum(e.get("compile_seconds_saved") or 0 for e in hits)
+print(f"ensemble_gate: AOT selftest OK — {len(stores)} store(s) cold, "
+      f"{len(hits)} hit(s) warm, {saved:.3f}s of compile skipped")
+PY
+
+echo "ensemble_gate: bench/compare.py ensemble-row coverage selftest"
+python - "$TMP" <<'PY'
+import json, os, sys
+
+from multigpu_advectiondiffusion_tpu.bench import compare as cmp
+
+tmp = sys.argv[1]
+old_rows = [  # a pre-ensemble round: no ensemble/vs_looped fields
+    {"metric": "diffusion3d_mlups", "value": 100.0, "spread": 0.01},
+]
+new_rows = [
+    {"metric": "diffusion3d_mlups", "value": 101.0, "spread": 0.01,
+     "ensemble": 1},
+    {"metric": "ensemble_diffusion3d_b64_mlups_members", "value": 900.0,
+     "spread": 0.02, "ensemble": 64, "vs_looped": 3.4},
+]
+def write(path, rows):
+    with open(path, "w") as f:
+        f.write("\n".join(json.dumps(r) for r in rows) + "\n")
+write(os.path.join(tmp, "old.jsonl"), old_rows)
+write(os.path.join(tmp, "new.jsonl"), new_rows)
+res = cmp.compare(cmp.load_rows(os.path.join(tmp, "new.jsonl")),
+                  cmp.load_rows(os.path.join(tmp, "old.jsonl")))
+assert res.ok, res.format_text()
+assert not res.notes, f"pre-ensemble rounds must not note: {res.notes}"
+assert [r for r in res.rows if r.status == "added"], \
+    "new ensemble rows must read as added, not regressions"
+# a later round that silently DROPS the ensemble columns gets a note
+# (non-gating), the MEASURED_FIELDS discipline
+stripped = [dict(new_rows[0]), dict(new_rows[1])]
+del stripped[1]["ensemble"]; del stripped[1]["vs_looped"]
+write(os.path.join(tmp, "stripped.jsonl"), stripped)
+res2 = cmp.compare(cmp.load_rows(os.path.join(tmp, "stripped.jsonl")),
+                   cmp.load_rows(os.path.join(tmp, "new.jsonl")))
+assert res2.ok, "dropped provenance columns must not gate"
+assert any("vs_looped" in n for n in res2.notes), res2.notes
+print("ensemble_gate: compare coverage selftest OK")
+PY
+
+echo "ensemble_gate: OK"

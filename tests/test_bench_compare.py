@@ -182,3 +182,53 @@ def test_cli_requires_exactly_one_mode(tmp_path):
         cmp.main([str(new)])  # neither prior nor --floors
     with pytest.raises(SystemExit):
         cmp.main([str(new), str(new), "--floors"])  # both
+
+
+# --------------------------------------------------------------------- #
+# Ensemble columns (ISSUE 9): tolerance for pre-ensemble rounds
+# --------------------------------------------------------------------- #
+def test_old_rounds_without_ensemble_field_read_as_one():
+    assert cmp.row_members({"metric": "a", "value": 1.0}) == 1
+    assert cmp.row_members({"metric": "a", "ensemble": None}) == 1
+    assert cmp.row_members({"metric": "a", "ensemble": "garbage"}) == 1
+    assert cmp.row_members({"metric": "a", "ensemble": 64}) == 64
+
+
+def test_pre_ensemble_baseline_is_not_a_coverage_regression():
+    """BENCH_r01-r05 rows carry no `ensemble`/`vs_looped` fields; a new
+    round that adds them (plus brand-new ensemble_* metrics) must
+    compare clean — no regressions, no notes."""
+    old = {"diffusion3d_mlups": {"metric": "diffusion3d_mlups",
+                                 "value": 100.0, "spread": 0.01}}
+    new = {
+        "diffusion3d_mlups": {"metric": "diffusion3d_mlups",
+                              "value": 101.0, "spread": 0.01,
+                              "ensemble": 1},
+        "ensemble_diffusion3d_b64_mlups_members": {
+            "metric": "ensemble_diffusion3d_b64_mlups_members",
+            "value": 900.0, "spread": 0.02, "ensemble": 64,
+            "vs_looped": 3.4,
+        },
+    }
+    res = cmp.compare(new, old)
+    assert res.ok, res.format_text()
+    assert not res.notes, res.notes
+    assert {r.status for r in res.rows} == {"ok", "added"}
+
+
+def test_dropped_ensemble_columns_note_but_never_gate():
+    """The MEASURED_FIELDS discipline for the ensemble columns: a round
+    that silently loses them prints a coverage note, exit stays 0."""
+    row = {"metric": "ensemble_x_b8_mlups_members", "value": 10.0,
+           "ensemble": 8, "vs_looped": 3.0}
+    stripped = {"metric": "ensemble_x_b8_mlups_members", "value": 10.0}
+    res = cmp.compare({row["metric"]: stripped}, {row["metric"]: row})
+    assert res.ok
+    assert any("vs_looped" in n for n in res.notes), res.notes
+    # member-count DRIFT (a b8 row re-measured at another B) is also a
+    # note — the workload changed, the threshold math did not
+    res2 = cmp.compare(
+        {row["metric"]: {**row, "ensemble": 16}}, {row["metric"]: row}
+    )
+    assert res2.ok
+    assert any("member count changed" in n for n in res2.notes)

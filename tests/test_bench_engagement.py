@@ -115,3 +115,24 @@ def test_scaling_configs_use_measured_dispatch():
 
     for cfg, _, _ in _configs(on_tpu=False).values():
         assert cfg.impl == "auto", cfg
+
+
+def test_bench_ensemble_rows_engage_vmapped_steppers():
+    """The ensemble_* rows' batched dispatch must ride the promised
+    vmapped inner rung (ISSUE 9) — at B=2/1 iter so no timing is paid,
+    just the dispatch record."""
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+
+    bench = _bench_module()
+    fams = bench._ensemble_cases(on_tpu=False)
+    assert len(fams) >= 3
+    for family, make_case, expect in fams:
+        solver_cls, cfg, _iters, member_fn = make_case()
+        es = EnsembleSolver(solver_cls, cfg,
+                            [member_fn(i) for i in range(2)])
+        es.run(es.initial_state(), 1)
+        engaged = es.engaged_path()
+        assert engaged["stepper"] in expect, (family, engaged)
+        assert engaged["ensemble"] == 2, family

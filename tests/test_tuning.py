@@ -206,6 +206,37 @@ def test_key_separates_configs(devices):
     ) != k1
 
 
+def test_key_separates_ensemble_dimension(devices):
+    """ISSUE 9 satellite: the batched-engine member count is a key
+    dimension — a B=64 decision can never be served to a B=1 run."""
+    cfg = _sharded_burgers_cfg()
+    mesh = _mesh2(devices)
+    dec = Decomposition.slab("dz")
+    k1 = tuning.make_key(BurgersSolver, cfg, mesh, dec, "cpu")
+    assert k1 == tuning.make_key(BurgersSolver, cfg, mesh, dec, "cpu",
+                                 ensemble=1)
+    k64 = tuning.make_key(BurgersSolver, cfg, mesh, dec, "cpu",
+                          ensemble=64)
+    assert k64 != k1 and "ens=64" in k64 and "ens=1" in k1
+    # a decision persisted under the B=64 key is invisible to a B=1
+    # resolve: the lookup misses and (tuning disabled) falls back
+    import jax
+
+    backend = jax.default_backend()
+    cache = TuningCache(tuning.cache_path())
+    cache.put(
+        tuning.make_key(BurgersSolver, cfg, None, None, backend,
+                        ensemble=64),
+        {"impl": "pallas_stage", "steps_per_exchange": 1,
+         "source": "measured", "ensemble": 64},
+    )
+    tuning.configure(enabled=False)
+    d1 = tuning.resolve(BurgersSolver, cfg, None, None, ensemble=1)
+    assert d1["source"] == "untuned-heuristic"
+    d64 = tuning.resolve(BurgersSolver, cfg, None, None, ensemble=64)
+    assert d64["source"] == "cache" and d64["impl"] == "pallas_stage"
+
+
 def test_candidate_space_scales_with_shard_depth(devices):
     """candidates() (no measurement — cheap) enumerates every k the
     shard can serve and nothing more: lz=36 admits {1,2,4}, lz=20 only
