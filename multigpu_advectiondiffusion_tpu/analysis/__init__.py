@@ -1,0 +1,30 @@
+"""Project static analysis (`tpucfd-check`): the machine-checked half
+of nine PRs of hand-enforced invariants.
+
+Three layers (ISSUE 10):
+
+* :mod:`framework` + :mod:`rules` — an AST rule engine (the
+  generalization of ``telemetry/schema.scan_emitted``) with domain lint
+  rules: closure-captured physics constants in ``build_local``
+  closures, host-sync calls inside traced code, non-atomic persistent
+  artifact writes, unregistered telemetry emission sites;
+* :mod:`halo_verify` — the stencil/halo consistency verifier, this
+  domain's race detector: proves ghost depth G, exchange depth k*G and
+  the slab trapezoid margins ``(k-1-j)*G`` mutually sufficient for
+  every (rung, order, k) combination the dispatch admits;
+* :mod:`sanitizer` — opt-in ``jax.experimental.checkify``
+  instrumentation of the steppers (``--checkify``), surfacing NaN /
+  div-by-zero / OOB through the supervisor's rollback path.
+
+CLI: ``python -m multigpu_advectiondiffusion_tpu.analysis`` (or the
+``check`` subcommand of the main CLI); CI gate: ``out/lint_gate.sh``.
+"""
+
+from multigpu_advectiondiffusion_tpu.analysis.framework import (  # noqa: F401
+    ParsedModule,
+    Rule,
+    Violation,
+    all_rules,
+    iter_modules,
+    run_rules,
+)

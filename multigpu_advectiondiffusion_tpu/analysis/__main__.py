@@ -1,0 +1,9 @@
+"""``python -m multigpu_advectiondiffusion_tpu.analysis`` — the
+standalone ``tpucfd-check`` entry (also: the main CLI's ``check``
+subcommand)."""
+
+import sys
+
+from multigpu_advectiondiffusion_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,202 @@
+"""``tpucfd-check``: the static-analysis CLI.
+
+    python -m multigpu_advectiondiffusion_tpu.analysis          # full check
+    python -m multigpu_advectiondiffusion_tpu.cli check          # same
+    ... check --selftest         # every rule must trip on its seeded
+                                 # fixture; the halo verifier must fail
+                                 # an injected off-by-one ghost depth
+    ... check --json             # machine-readable report
+    ... check --list-rules       # the rule table
+
+Exit codes: 0 clean, 1 violations (or a failed selftest), 2 usage.
+Wired into CI by ``out/lint_gate.sh`` (clean-tree pass + selftest) and
+run over the installed package by the tier-1 ``tests/test_analysis.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def configure_parser(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--root", default=None, metavar="DIR",
+                   help="package tree to lint (default: the installed "
+                        "multigpu_advectiondiffusion_tpu package)")
+    p.add_argument("--rules", default=None, metavar="NAME[,NAME...]",
+                   help="run only these lint rules (default: all)")
+    p.add_argument("--skip-lint", action="store_true",
+                   help="skip the AST lint rules (halo verifier only)")
+    p.add_argument("--skip-halo", action="store_true",
+                   help="skip the stencil/halo verifier (lint only)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--selftest", action="store_true",
+                   help="prove every rule trips on its seeded violation "
+                        "fixture (and passes the clean twin), and the "
+                        "halo verifier fails an injected off-by-one "
+                        "ghost depth")
+    p.set_defaults(fn=run)
+
+
+def _selected_rules(arg: Optional[str]):
+    from multigpu_advectiondiffusion_tpu.analysis import all_rules
+
+    registry = all_rules()
+    if not arg:
+        return [cls() for cls in registry.values()]
+    out = []
+    for name in arg.split(","):
+        name = name.strip()
+        if name not in registry:
+            raise SystemExit(
+                f"unknown rule {name!r}; known: {sorted(registry)}"
+            )
+        out.append(registry[name]())
+    return out
+
+
+def selftest(out=print) -> bool:
+    """Every rule trips on its seeded fixture and passes the clean
+    twin; the halo verifier proves the shipped combos and fails an
+    injected off-by-one ghost depth naming kernel/axis/depth."""
+    import tempfile
+
+    from multigpu_advectiondiffusion_tpu.analysis import all_rules, run_rules
+    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+    from multigpu_advectiondiffusion_tpu.analysis.fixtures import (
+        RULE_FIXTURES,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.io import atomic_write_text
+
+    ok = True
+    registry = all_rules()
+    missing = sorted(set(registry) - set(RULE_FIXTURES))
+    if missing:
+        out(f"FAIL: rule(s) without a seeded fixture: {missing}")
+        ok = False
+    for name, pair in sorted(RULE_FIXTURES.items()):
+        if name not in registry:
+            out(f"FAIL: fixture for unknown rule {name!r}")
+            ok = False
+            continue
+        rule = registry[name]()
+        for flavor, src in (("bad", pair["bad"]), ("good", pair["good"])):
+            with tempfile.TemporaryDirectory() as d:
+                atomic_write_text(f"{d}/fixture_{flavor}.py", src)
+                hits = [
+                    v for v in run_rules(d, rules=[rule])
+                    if v.rule == name
+                ]
+            if flavor == "bad" and not hits:
+                out(f"FAIL: rule {name} did not trip on its seeded "
+                    "violation fixture")
+                ok = False
+            elif flavor == "good" and hits:
+                out(f"FAIL: rule {name} false-positives on its clean "
+                    f"twin: {[str(v) for v in hits]}")
+                ok = False
+            else:
+                out(f"  ok: {name} [{flavor}]")
+    # halo verifier: shipped combos prove clean...
+    report = halo_verify.verify_all()
+    if not report.ok:
+        out("FAIL: halo verifier flags the shipped tree:")
+        for v in report.violations:
+            out(f"  {v}")
+        ok = False
+    else:
+        out(f"  ok: halo verifier ({report.checked} combos clean)")
+    # ...and an injected off-by-one ghost depth fails, named
+    combo = next(
+        c for c in halo_verify.default_combos()
+        if c.name == "slab-diffusion[k=2]"
+    )
+    stepper = combo.build()
+    stepper.exchange_depth += 1
+    injected = halo_verify.verify_stepper(stepper, kernel=combo.name)
+    if not injected:
+        out("FAIL: halo verifier passed an injected off-by-one ghost "
+            "depth")
+        ok = False
+    elif not any(v.axis == 0 for v in injected):
+        out("FAIL: halo violation does not name the offending axis")
+        ok = False
+    else:
+        out(f"  ok: injected off-by-one trips ({len(injected)} "
+            f"violations, e.g. {injected[0]})")
+    out("selftest: " + ("PASS" if ok else "FAIL"))
+    return ok
+
+
+def run(args) -> Optional[bool]:
+    """Entry point for both the ``check`` subcommand and the module
+    CLI. Returns ``False`` (CLI failure) on violations."""
+    from multigpu_advectiondiffusion_tpu.analysis import all_rules, run_rules
+    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+
+    if args.list_rules:
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name}: {' '.join(cls.description.split())}")
+        print("halo-verify: stencil/halo consistency verifier — proves "
+              "ghost depth G, exchange depth k*G and the slab trapezoid "
+              "margins (k-1-j)*G sufficient for every admitted "
+              "(rung, order, k) combination")
+        return None
+    if args.selftest:
+        return True if selftest() else False
+
+    problems: List[str] = []
+    lint = []
+    if not args.skip_lint:
+        lint = run_rules(args.root, rules=_selected_rules(args.rules))
+        problems.extend(str(v) for v in lint)
+    halo = None
+    if not args.skip_halo:
+        halo = halo_verify.verify_all()
+        problems.extend(str(v) for v in halo.violations)
+
+    if args.json:
+        print(json.dumps({
+            "lint": [vars(v) for v in lint],
+            "halo": {
+                "checked": halo.checked if halo else 0,
+                "declined": [
+                    {"name": c.name, "reason": c.reason}
+                    for c in (halo.combos if halo else [])
+                    if not c.admitted
+                ],
+                "violations": [vars(v) for v in halo.violations]
+                if halo else [],
+            },
+            "ok": not problems,
+        }, indent=2))
+    else:
+        for line in problems:
+            print(line)
+        checked = halo.checked if halo else 0
+        print(
+            f"tpucfd-check: {len(problems)} violation(s); "
+            f"halo combos proven: {checked}"
+            + ("" if problems else " — clean")
+        )
+    return False if problems else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpucfd-check",
+        description="project static analysis: AST lint rules + "
+                    "stencil/halo consistency verifier",
+    )
+    configure_parser(ap)
+    args = ap.parse_args(argv)
+    return 1 if run(args) is False else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

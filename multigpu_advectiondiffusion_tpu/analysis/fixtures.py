@@ -1,0 +1,91 @@
+"""Seeded violation fixtures: one (bad, good) source pair per lint
+rule, embedded as strings.
+
+Consumed by ``tpucfd-check --selftest`` and ``tests/test_analysis.py``:
+every rule must TRIP on its seeded ``bad`` fixture and stay silent on
+the ``good`` twin — the proof that a green lint gate means "checked and
+clean", not "checker broke". (These are string constants: the AST
+engine never sees them as code when linting this package.)
+"""
+
+from __future__ import annotations
+
+RULE_FIXTURES = {
+    "raw-artifact-write": {
+        "bad": (
+            "import json\n"
+            "\n"
+            "def save_report(path, obj):\n"
+            "    with open(path, 'w') as f:\n"
+            "        json.dump(obj, f)\n"
+        ),
+        "good": (
+            "import json\n"
+            "import os\n"
+            "import tempfile\n"
+            "\n"
+            "def save_report(path, obj):\n"
+            "    fd, tmp = tempfile.mkstemp(dir='.')\n"
+            "    with os.fdopen(fd, 'w') as f:\n"
+            "        json.dump(obj, f)\n"
+            "    os.replace(tmp, path)\n"
+        ),
+    },
+    "unregistered-emission": {
+        "bad": (
+            "def emit(sink):\n"
+            "    sink.event('totally_unknown_kind', 'x', foo=1)\n"
+            "    sink.counter('no.such.counter', 1)\n"
+        ),
+        "good": (
+            "def emit(sink):\n"
+            "    sink.event('dispatch', 'build', key='k', impl='xla')\n"
+            "    sink.counter('halo.exchanges_traced', 1)\n"
+        ),
+    },
+    "host-sync-in-traced": {
+        "bad": (
+            "from jax import lax\n"
+            "\n"
+            "def advance(u, n):\n"
+            "    def body(i, c):\n"
+            "        return c + c.item()\n"
+            "    return lax.fori_loop(0, n, body, u)\n"
+        ),
+        "good": (
+            "from jax import lax\n"
+            "\n"
+            "def advance(u, n):\n"
+            "    def body(i, c):\n"
+            "        return c + 1.0\n"
+            "    out = lax.fori_loop(0, n, body, u)\n"
+            "    return float(out.item())  # host side: after the loop\n"
+        ),
+    },
+    "closure-constant": {
+        "bad": (
+            "class Solver:\n"
+            "    def build_local(self, ctx, overrides=None):\n"
+            "        cfg = self.cfg\n"
+            "        K = cfg.diffusivity\n"
+            "        if overrides and 'diffusivity' in overrides:\n"
+            "            K = overrides['diffusivity']\n"
+            "\n"
+            "        def rhs(u):\n"
+            "            return u * cfg.diffusivity\n"
+            "        return rhs\n"
+        ),
+        "good": (
+            "class Solver:\n"
+            "    def build_local(self, ctx, overrides=None):\n"
+            "        cfg = self.cfg\n"
+            "        K = cfg.diffusivity\n"
+            "        if overrides and 'diffusivity' in overrides:\n"
+            "            K = overrides['diffusivity']\n"
+            "\n"
+            "        def rhs(u):\n"
+            "            return u * K\n"
+            "        return rhs\n"
+        ),
+    },
+}

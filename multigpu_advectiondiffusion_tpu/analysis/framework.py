@@ -1,0 +1,154 @@
+"""AST rule engine: parse each package module once, run pluggable
+rules over it, collect structured violations.
+
+This generalizes the regex scan ``telemetry/schema.scan_emitted``
+shipped with (one hard-coded pattern, one consumer) into the framework
+every project invariant registers against: a :class:`Rule` sees a
+:class:`ParsedModule` (source + AST + parent links) and yields
+:class:`Violation` rows; the engine handles file walking, parsing,
+suppression pragmas and aggregation, so a new invariant is ONE rule
+class — not a new scanner.
+
+Suppression: a violation whose source line (or the line above it)
+carries ``tpucfd-check: allow[<rule-name>]`` is dropped — the pragma
+is the audited opt-out (e.g. the torn-checkpoint fault injector
+*deliberately* writes non-atomically).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule breach at one source location."""
+
+    rule: str
+    path: str  # package-relative where possible
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class ParsedModule:
+    """One source file, parsed once and shared by every rule."""
+
+    def __init__(self, path: str, root: str):
+        self.path = path
+        self.relpath = os.path.relpath(path, root)
+        with open(path) as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        # parent links: rules climb from a call site to its enclosing
+        # function without re-walking the tree
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest FunctionDef/AsyncFunctionDef ancestor (None at
+        module level)."""
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        tag = f"tpucfd-check: allow[{rule}]"
+        return tag in self.line_text(lineno) or tag in self.line_text(
+            lineno - 1
+        )
+
+
+class Rule:
+    """One statically checkable project invariant."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        raise NotImplementedError
+
+    def violation(self, mod: ParsedModule, node: ast.AST,
+                  message: str) -> Violation:
+        return Violation(
+            rule=self.name,
+            path=mod.relpath,
+            line=getattr(node, "lineno", 0),
+            message=message,
+        )
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator: add a rule to the engine's default set."""
+    if not cls.name:
+        raise ValueError(f"rule {cls.__name__} declares no name")
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    """Registered rules (importing :mod:`rules` populates this)."""
+    # the domain rules live in a sibling module; importing it here
+    # makes the registry complete for every consumer
+    from multigpu_advectiondiffusion_tpu.analysis import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+def iter_modules(root: str) -> Iterable[ParsedModule]:
+    """Parse every ``.py`` under ``root`` (skipping ``__pycache__``),
+    sorted for deterministic reports. Unparseable files are the
+    caller's bug — a SyntaxError propagates loudly."""
+    paths = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    for path in sorted(paths):
+        yield ParsedModule(path, root)
+
+
+def run_rules(
+    root: Optional[str] = None,
+    rules: Optional[Sequence[Rule]] = None,
+) -> List[Violation]:
+    """Run ``rules`` (default: every registered rule) over the package
+    tree at ``root`` (default: the installed package). Returns the
+    surviving (non-suppressed) violations, sorted by location."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if rules is None:
+        rules = [cls() for cls in all_rules().values()]
+    out: List[Violation] = []
+    for mod in iter_modules(root):
+        for rule in rules:
+            for v in rule.check(mod):
+                if not mod.suppressed(v.line, v.rule):
+                    out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))

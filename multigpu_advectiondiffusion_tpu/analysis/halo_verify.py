@@ -1,0 +1,483 @@
+"""Stencil/halo consistency verifier — this domain's race detector.
+
+A stale-halo read is to a halo-exchange stencil code what a data race
+is to threaded CUDA: silently wrong cells near shard boundaries,
+invisible until a norm drifts. ``cuda-memcheck``/racecheck found the
+reference's races dynamically; here the contract is simple enough to
+prove *statically*: every kernel declares its stencil radius
+(``stencil_spec()``, the old ``R = 3``-style constants promoted to
+queryable metadata), and this module proves — for every (rung, order,
+k) combination the dispatch's eligibility gates admit — that
+
+* the per-refresh ghost depth serves the fused trapezoid
+  (``ghost_depth >= fused_stages * stage_radius``),
+* the exchange moves exactly ``k * ghost_depth`` rows
+  (``steps_per_exchange`` contract, ``parallel/halo.py``),
+* the padded layout stores what the exchange writes
+  (``core_offsets`` / ``padded_shape`` arithmetic, per axis),
+* a shard's core is thick enough to SERVE the exchange
+  (``interior[0] >= k*G`` — the ``exchange_ghosts`` runtime guard,
+  proven before any program runs),
+* the slab rung's built call windows match the re-derived trapezoid:
+  k=1 full-core / three-call split; deep blocks shrinking by
+  ``(k-1-j)*G`` margins per in-block step, step 0 consuming exactly
+  the exchanged buffer (``fused_slab_run._build_deep_calls``).
+
+Failures name the exact kernel/axis/depth. Consumed by ``tpucfd-check``
+(CLI), ``out/lint_gate.sh`` and ``tests/test_analysis.py``; the tests
+additionally prove an injected off-by-one ghost depth fails loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class HaloViolation:
+    """One broken stencil/halo invariant, named precisely."""
+
+    kernel: str
+    axis: Optional[int]
+    what: str
+    expected: object
+    actual: object
+
+    def __str__(self) -> str:
+        ax = "-" if self.axis is None else str(self.axis)
+        return (
+            f"[halo] kernel={self.kernel} axis={ax}: {self.what}: "
+            f"expected {self.expected}, got {self.actual}"
+        )
+
+
+@dataclasses.dataclass
+class ComboResult:
+    """One (rung, order, k) combination's verdict."""
+
+    name: str
+    admitted: bool
+    reason: Optional[str] = None  # decline reason when not admitted
+    violations: List[HaloViolation] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@dataclasses.dataclass
+class HaloReport:
+    combos: List[ComboResult] = dataclasses.field(default_factory=list)
+    constant_violations: List[HaloViolation] = dataclasses.field(
+        default_factory=list
+    )
+
+    @property
+    def violations(self) -> List[HaloViolation]:
+        out = list(self.constant_violations)
+        for c in self.combos:
+            out.extend(c.violations)
+        return out
+
+    @property
+    def checked(self) -> int:
+        return sum(1 for c in self.combos if c.admitted)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# --------------------------------------------------------------------- #
+# Instance battery
+# --------------------------------------------------------------------- #
+def verify_stepper(stepper, kernel: Optional[str] = None
+                   ) -> List[HaloViolation]:
+    """Prove one stepper instance's declared stencil metadata
+    consistent with its ghost/exchange/layout arithmetic. Returns the
+    violations (empty = proven)."""
+    spec = stepper.stencil_spec()
+    kern = kernel or spec.get("kernel") or type(stepper).__name__
+    out: List[HaloViolation] = []
+
+    def bad(axis, what, expected, actual):
+        out.append(HaloViolation(kern, axis, what, expected, actual))
+
+    h = spec["stage_radius"]
+    stages = spec["fused_stages"]
+    G = spec["ghost_depth"]
+    depth = spec["exchange_depth"]
+    k = spec["steps_per_exchange"]
+    if h < 1:
+        bad(None, "stage radius must be >= 1", ">= 1", h)
+        return out
+    if G < stages * h:
+        bad(0, "ghost depth cannot serve the fused-stage trapezoid",
+            f">= {stages} * {h} = {stages * h}", G)
+    if depth is not None and depth != k * G:
+        bad(0, "exchange depth violates the k-step contract (k * G)",
+            k * G, depth)
+
+    interior = tuple(getattr(stepper, "interior_shape", ()))
+    padded = tuple(getattr(stepper, "padded_shape", ()))
+    offs = getattr(stepper, "core_offsets", None)
+    if interior and padded:
+        lead_pad = depth if depth is not None else G
+        if padded[0] < interior[0] + 2 * lead_pad:
+            bad(0, "padded layout too thin for the declared ghost rows",
+                f">= {interior[0]} + 2 * {lead_pad}", padded[0])
+        if offs is not None:
+            if depth is not None and offs[0] != depth:
+                bad(0, "core offset must equal the exchange depth "
+                       "(the exchange writes the rows above/below "
+                       "the core)", depth, offs[0])
+            for ax in range(len(interior)):
+                if offs[ax] + interior[ax] > padded[ax]:
+                    bad(ax, "core window exceeds the padded layout",
+                        f"offset {offs[ax]} + interior {interior[ax]} "
+                        f"<= {padded[ax]}",
+                        offs[ax] + interior[ax])
+    sharded = bool(getattr(stepper, "sharded", False))
+    if sharded and depth is not None and interior:
+        # exchange_ghosts raises at trace time when a shard cannot
+        # serve the requested depth from its core — prove it up front
+        if interior[0] < depth:
+            bad(0, "shard core too thin to serve the exchange "
+                   "(parallel/halo.exchange_ghosts would raise)",
+                f"interior z >= {depth}", interior[0])
+    out.extend(_verify_slab_windows(stepper, kern, spec))
+    return out
+
+
+def _expected_slab_windows(stepper, spec):
+    """Re-derive the slab rung's call windows from the contract alone
+    (interior/padded + stencil_spec + the shared block picker): the
+    list of ``(z_out0, rows_out, ghost_src)`` the schedule must build,
+    in construction order — k=1 full-core or three-call split; deep
+    blocks with the ``(k-1-j)*G`` trapezoid margins."""
+    G = spec["ghost_depth"]
+    k = spec["steps_per_exchange"]
+    depth = spec["exchange_depth"]
+    lz = stepper.interior_shape[0]
+    pz = stepper.padded_shape[0]
+    bz, n_slabs = stepper.bz, stepper.n_slabs
+    exp = []
+    if k == 1:
+        if stepper.overlap_split:
+            exp.append((G + bz, (n_slabs - 2) * bz, None))      # interior
+            exp.append((G, bz, "lo"))                            # bottom
+            exp.append((G + (n_slabs - 1) * bz, bz, "hi"))       # top
+        else:
+            exp.append((G, n_slabs * bz, None))
+        return exp
+    # deep schedule: one call per in-block step j, windows shrinking by
+    # G per side; step 0's box must cover exactly the exchanged buffer
+    for j in range(k):
+        ext = lz + 2 * (k - 1 - j) * G
+        exp.append(((j + 1) * G, ext, None))
+    if stepper.overlap_split:
+        ext_i = lz - 2 * G
+        exp.append((G + depth, ext_i, None))                     # interior
+        bz_e = stepper._pick_call_bz(depth)
+        for i in range(depth // bz_e):
+            exp.append((G + i * bz_e, bz_e, "lo"))
+        for i in range(depth // bz_e):
+            exp.append((pz - G - depth + i * bz_e, bz_e, "hi"))
+    return exp
+
+
+def _verify_slab_windows(stepper, kern: str, spec) -> List[HaloViolation]:
+    """The BlockSpec window arithmetic of the slab rung's sharded
+    calls: recorded-at-construction windows vs the re-derived
+    trapezoid. Non-slab steppers (no window ledger) verify vacuously."""
+    windows = list(getattr(stepper, "_call_windows", ()) or ())
+    out: List[HaloViolation] = []
+    if not windows:
+        return out
+
+    def bad(what, expected, actual):
+        out.append(HaloViolation(kern, 0, what, expected, actual))
+
+    G = spec["ghost_depth"]
+    depth = spec["exchange_depth"]
+    pz = stepper.padded_shape[0]
+    lz = stepper.interior_shape[0]
+    for w in windows:
+        rows = w["bz"] * w["n_grid"]
+        box_lo = w["z_out0"] - G
+        box_hi = w["z_out0"] + rows + G
+        if box_lo < 0 or box_hi > pz:
+            bad("call box reads outside the padded buffer",
+                f"[0, {pz})", f"[{box_lo}, {box_hi})")
+        if w["ghost_src"] is not None:
+            # edge calls splice op_rows rows of the exchanged operand
+            # into the box — the splice must stay inside the operand's
+            # depth rows and inside the box
+            if not (0 < w["op_rows"] <= w["bz"] + 2 * G):
+                bad("ghost call consumes a nonsensical operand slice",
+                    f"1..{w['bz'] + 2 * G} rows", w["op_rows"])
+            if not (0 <= w["g_start"]
+                    and w["g_start"] + w["op_rows"] <= depth):
+                bad("ghost operand slice exceeds the exchanged depth",
+                    f"within [0, {depth})",
+                    f"[{w['g_start']}, {w['g_start'] + w['op_rows']})")
+    expected = _expected_slab_windows(stepper, spec)
+    actual = [
+        (w["z_out0"], w["bz"] * w["n_grid"], w["ghost_src"])
+        for w in windows
+    ]
+    if expected != actual:
+        bad("built call windows disagree with the re-derived "
+            "trapezoid schedule (z_out0, rows, ghost_src)",
+            expected, actual)
+    # the union of the final in-block step's output must be exactly the
+    # core: rows [depth, depth + lz)
+    k = spec["steps_per_exchange"]
+    if k > 1:
+        last = expected[k - 1]
+        if (last[0], last[1]) != (depth, lz):
+            bad("final in-block step does not write exactly the core",
+                (depth, lz), (last[0], last[1]))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Constants cross-check (first principles vs the shipped constants)
+# --------------------------------------------------------------------- #
+def verify_constants() -> List[HaloViolation]:
+    """Prove the radius constants against the discretizations they
+    describe: WENO order o reconstructs from an ``(o+1)//2``-wide
+    one-sided stencil; the O4 second derivative is 5 taps per axis
+    (radius ``len(coeffs)//2``); the slab/step fused ghosts are the
+    3-stage trapezoid of those radii."""
+    out: List[HaloViolation] = []
+    from multigpu_advectiondiffusion_tpu.ops.laplacian import D2_STENCILS
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+        MARGIN,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
+        _G_DIFF,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+        O4_COEFFS,
+        R,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+    for order, r in HALO.items():
+        want = (order + 1) // 2
+        if r != want:
+            out.append(HaloViolation(
+                f"weno{order}", None,
+                "WENO halo disagrees with the reconstruction width",
+                want, r,
+            ))
+    if R != len(O4_COEFFS) // 2:
+        out.append(HaloViolation(
+            "pallas-laplacian", None,
+            "O4 radius disagrees with its coefficient count",
+            len(O4_COEFFS) // 2, R,
+        ))
+    for order, (coefs, radius, _denom) in D2_STENCILS.items():
+        if len(coefs) != order + 1:
+            out.append(HaloViolation(
+                f"laplacian-o{order}", None,
+                "generic D2 stencil width disagrees with its order",
+                order + 1, len(coefs),
+            ))
+        if radius != len(coefs) // 2:
+            # the generic path pads by this declared radius — a drift
+            # here is exactly the stale-ghost read the verifier exists
+            # to rule out
+            out.append(HaloViolation(
+                f"laplacian-o{order}", None,
+                "declared pad radius disagrees with the tap count",
+                len(coefs) // 2, radius,
+            ))
+    if _G_DIFF != 3 * R:
+        out.append(HaloViolation(
+            "fused-whole-run-slab", 0,
+            "slab diffusion ghost depth is not the 3-stage trapezoid",
+            3 * R, _G_DIFF,
+        ))
+    if MARGIN < max(HALO.values()):
+        out.append(HaloViolation(
+            "fused-stage", 1,
+            "Burgers y margin cannot host the widest WENO halo",
+            f">= {max(HALO.values())}", MARGIN,
+        ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# The admitted (rung, order, k) matrix
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class Combo:
+    name: str
+    build: Callable[[], object]
+
+
+def _spacing(n):
+    return (0.1,) * n
+
+
+def default_combos() -> List[Combo]:
+    """Every (rung, order, k) combination the dispatch's eligibility
+    gates admit, as cheap constructor calls (layout math only — no
+    tracing, no devices). Combos a gate declines are recorded as
+    declined, mirroring the dispatch's own loud rejections."""
+    import jax.numpy as jnp
+
+    from multigpu_advectiondiffusion_tpu.ops.flux import burgers as _burg
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused2d_sharded import (
+        ShardedFusedBurgers2DStepper,
+        ShardedFusedDiffusion2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+        FusedBurgersStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (
+        FusedBurgers2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+        FusedDiffusionStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion2d import (
+        FusedDiffusion2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion_step import (  # noqa: E501
+        StepFusedDiffusionStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
+        SlabRunBurgersStepper,
+        SlabRunDiffusionStepper,
+    )
+
+    f32 = jnp.float32
+    combos: List[Combo] = []
+
+    def diff3d(shape=(24, 10, 12), **kw):
+        return FusedDiffusionStepper(
+            shape, f32, _spacing(3), [1.0] * 3, 1e-4, 2, 0.0, **kw
+        )
+
+    combos.append(Combo("diffusion3d-stage", diff3d))
+    combos.append(Combo(
+        "diffusion3d-stage[sharded]",
+        lambda: diff3d(global_shape=(48, 10, 12)),
+    ))
+    combos.append(Combo(
+        "diffusion3d-step",
+        lambda: StepFusedDiffusionStepper(
+            (24, 10, 12), f32, _spacing(3), [1.0] * 3, 1e-4, 2, 0.0
+        ),
+    ))
+    combos.append(Combo(
+        "diffusion2d-whole-run",
+        lambda: FusedDiffusion2DStepper(
+            (32, 32), f32, _spacing(2), [1.0] * 2, 1e-4, 2, 0.0
+        ),
+    ))
+    combos.append(Combo(
+        "diffusion2d-stage[sharded]",
+        lambda: ShardedFusedDiffusion2DStepper(
+            (16, 32), f32, _spacing(2), [1.0] * 2, 1e-4, 2, 0.0,
+            global_shape=(32, 32),
+        ),
+    ))
+
+    def slab_diff(k=1, split=False, shape=(24, 10, 12), sharded=True):
+        return SlabRunDiffusionStepper(
+            shape, f32, _spacing(3), [1.0] * 3, 1e-4, 2, 0.0,
+            global_shape=(shape[0] * 2,) + shape[1:] if sharded else None,
+            overlap_split=split, steps_per_exchange=k,
+        )
+
+    combos.append(Combo(
+        "slab-diffusion[unsharded]",
+        lambda: slab_diff(sharded=False),
+    ))
+    for k in (1, 2, 3):
+        combos.append(Combo(
+            f"slab-diffusion[k={k}]", lambda k=k: slab_diff(k=k)
+        ))
+        combos.append(Combo(
+            f"slab-diffusion[k={k},split]",
+            lambda k=k: slab_diff(k=k, split=True),
+        ))
+
+    for order in (5, 7):
+        def burg3d(order=order, **kw):
+            return FusedBurgersStepper(
+                (12, 16, 64), f32, _spacing(3), _burg(), "js", 0.0,
+                dt=1e-3, order=order, **kw,
+            )
+
+        combos.append(Combo(f"burgers3d-stage[o{order}]", burg3d))
+        combos.append(Combo(
+            f"burgers3d-stage[o{order},sharded]",
+            lambda order=order: burg3d(
+                order=order, global_shape=(24, 16, 64)
+            ),
+        ))
+        combos.append(Combo(
+            f"burgers2d-stage[o{order},sharded]",
+            lambda order=order: ShardedFusedBurgers2DStepper(
+                (16, 64), f32, _spacing(2), _burg(), "js", 0.0,
+                dt=1e-3, global_shape=(32, 64), order=order,
+            ),
+        ))
+        combos.append(Combo(
+            f"burgers2d-whole-run[o{order}]",
+            lambda order=order: FusedBurgers2DStepper(
+                (32, 64), f32, _spacing(2), _burg(), "js", 0.0,
+                dt=1e-3, order=order,
+            ),
+        ))
+
+        def slab_burg(k=1, split=False, order=order):
+            shape = (36, 16, 64)
+            return SlabRunBurgersStepper(
+                shape, f32, _spacing(3), _burg(), "js", 0.0, 1e-3,
+                global_shape=(72,) + shape[1:], order=order,
+                overlap_split=split, steps_per_exchange=k,
+            )
+
+        combos.append(Combo(
+            f"slab-burgers[o{order},unsharded]",
+            lambda order=order: SlabRunBurgersStepper(
+                (36, 16, 64), f32, _spacing(3), _burg(), "js", 0.0,
+                1e-3, order=order,
+            ),
+        ))
+        for k in (1, 2):
+            combos.append(Combo(
+                f"slab-burgers[o{order},k={k}]",
+                lambda k=k, order=order: slab_burg(k=k, order=order),
+            ))
+            combos.append(Combo(
+                f"slab-burgers[o{order},k={k},split]",
+                lambda k=k, order=order: slab_burg(
+                    k=k, split=True, order=order
+                ),
+            ))
+    return combos
+
+
+def verify_all(combos: Optional[List[Combo]] = None) -> HaloReport:
+    """Run the battery over every admitted combination; declined
+    combinations (a constructor gate raised, as the dispatch would)
+    are recorded with their reason, not silently dropped."""
+    report = HaloReport(constant_violations=verify_constants())
+    for combo in combos if combos is not None else default_combos():
+        res = ComboResult(name=combo.name, admitted=True)
+        try:
+            stepper = combo.build()
+        except ValueError as exc:
+            res.admitted = False
+            res.reason = str(exc)
+            report.combos.append(res)
+            continue
+        res.violations = verify_stepper(stepper, kernel=combo.name)
+        report.combos.append(res)
+    return report

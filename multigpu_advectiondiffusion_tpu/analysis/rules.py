@@ -1,0 +1,373 @@
+"""Domain lint rules for the AST engine (:mod:`framework`).
+
+Four invariants, each previously enforced in exactly one hand-written
+place (or not at all):
+
+* ``closure-constant`` — the PR 9 ``build_local`` contract: a scalar a
+  solver declares member-varying (readable from ``overrides``) must
+  enter its traced closures as an operand, never re-read from the
+  config inside the closure (a closure constant cannot vary along the
+  vmapped member axis — the batched run silently computes every member
+  with member 0's physics);
+* ``host-sync-in-traced`` — ``.item()`` / ``.block_until_ready()`` /
+  ``np.asarray`` and friends inside functions that are traced
+  (arguments to ``jit``/``vmap``/``fori_loop``/``while_loop``/
+  ``pallas_call``/``shard_map``...): a host sync inside traced code is
+  either a tracer error at runtime or a silent per-step device->host
+  round trip;
+* ``raw-artifact-write`` — ``open(..., 'w')`` of a persistent artifact
+  outside the tempfile + ``os.replace`` atomic-publish discipline the
+  checkpoint/cache/summary writers follow (append-mode streams are
+  exempt: a JSONL tail is not a torn-write hazard);
+* ``unregistered-emission`` — telemetry ``.event(kind, name)`` /
+  ``.counter(name)`` call sites the schema registry
+  (``telemetry/schema.EVENT_REGISTRY``) does not know — the guard
+  against silent schema drift, now one rule of the shared engine
+  instead of a private regex scanner.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set, Tuple
+
+from multigpu_advectiondiffusion_tpu.analysis.framework import (
+    ParsedModule,
+    Rule,
+    Violation,
+    iter_modules,
+    register,
+)
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    """``jax.lax.fori_loop`` -> ``fori_loop``; ``open`` -> ``open``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# --------------------------------------------------------------------- #
+# raw-artifact-write
+# --------------------------------------------------------------------- #
+@register
+class RawArtifactWriteRule(Rule):
+    name = "raw-artifact-write"
+    description = (
+        "open(..., 'w') of a persistent artifact without the tempfile + "
+        "os.replace atomic-publish discipline (a crash/preemption leaves "
+        "a torn file where readers expect a complete one)"
+    )
+
+    _OPENERS = ("open", "fdopen")
+
+    def _mode_of(self, call: ast.Call) -> Optional[str]:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                return _literal_str(kw.value)
+        if len(call.args) >= 2:
+            return _literal_str(call.args[1])
+        return None
+
+    def _has_atomic_publish(self, scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "replace"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("os", "_os")
+            ):
+                return True
+        return False
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _terminal_name(node.func)
+            if fname not in self._OPENERS:
+                continue
+            if fname == "open" and not isinstance(node.func, ast.Name):
+                continue  # method .open() on some object: out of scope
+            mode = self._mode_of(node)
+            if mode is None or not any(c in mode for c in "wx"):
+                continue  # reads and append-only streams are fine
+            scope = mod.enclosing_function(node) or mod.tree
+            if self._has_atomic_publish(scope):
+                continue
+            yield self.violation(
+                mod, node,
+                f"open(..., {mode!r}) writes a persistent artifact "
+                "without tempfile + os.replace (use "
+                "utils.io.atomic_write_text or publish via os.replace "
+                "in the same function)",
+            )
+
+
+# --------------------------------------------------------------------- #
+# unregistered-emission (+ the reusable scanner telemetry/schema wraps)
+# --------------------------------------------------------------------- #
+def _emission_calls(mod: ParsedModule):
+    """Yield ``(node, kind, name_or_None)`` for ``.event(...)`` sites
+    with a literal kind, and ``(node, None, counter_name)`` for
+    ``.counter(...)`` sites with a literal name. Dynamic kinds (a
+    variable) are skipped — the kind itself is then the call site's
+    contract, unresolvable statically (same semantics as the regex
+    scanner this replaces)."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if node.func.attr == "event" and node.args:
+            kind = _literal_str(node.args[0])
+            if kind is None:
+                continue
+            name = (
+                _literal_str(node.args[1]) if len(node.args) >= 2 else None
+            )
+            yield node, kind, name
+        elif node.func.attr == "counter" and node.args:
+            cname = _literal_str(node.args[0])
+            if cname is not None:
+                yield node, None, cname
+
+
+def scan_emission_sites(
+    root: str,
+) -> Tuple[Set[Tuple[str, Optional[str]]], Set[str]]:
+    """AST scan of every emission site under ``root``: returns
+    ``(event_pairs, counter_names)`` — the engine-backed implementation
+    of ``telemetry/schema.scan_emitted`` (same contract: pair name is
+    ``None`` when the call site passes a variable)."""
+    pairs: Set[Tuple[str, Optional[str]]] = set()
+    counters: Set[str] = set()
+    for mod in iter_modules(root):
+        for _node, kind, name in _emission_calls(mod):
+            if kind is None:
+                counters.add(name)
+            else:
+                pairs.add((kind, name))
+    return pairs, counters
+
+
+@register
+class UnregisteredEmissionRule(Rule):
+    name = "unregistered-emission"
+    description = (
+        "telemetry .event(kind, name)/.counter(name) call site not "
+        "covered by telemetry/schema.EVENT_REGISTRY / COUNTER_NAMES "
+        "(silent schema drift: consumers learn about the new event six "
+        "months later)"
+    )
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        from multigpu_advectiondiffusion_tpu.telemetry import schema
+
+        for node, kind, name in _emission_calls(mod):
+            if kind is None:
+                if name not in schema.COUNTER_NAMES:
+                    yield self.violation(
+                        mod, node,
+                        f"counter {name!r} missing from "
+                        "telemetry/schema.COUNTER_NAMES",
+                    )
+            elif not schema.registered(kind, name):
+                yield self.violation(
+                    mod, node,
+                    f"event {kind}:{name} not registered in "
+                    "telemetry/schema.EVENT_REGISTRY (register it and "
+                    "document it in README's event table)",
+                )
+
+
+# --------------------------------------------------------------------- #
+# host-sync-in-traced
+# --------------------------------------------------------------------- #
+#: call names whose function-valued arguments are traced by jax
+_TRACE_ENTRIES = {
+    "jit", "vmap", "pmap", "checkify", "grad", "value_and_grad",
+    "fori_loop", "while_loop", "scan", "cond", "switch",
+    "pallas_call", "shard_map", "remat", "custom_vjp", "custom_jvp",
+    "named_call",
+}
+#: decorator names that trace the function they decorate
+_TRACE_DECORATORS = {"jit", "vmap", "pmap", "when", "custom_vjp",
+                     "custom_jvp", "remat"}
+#: methods whose nested function defs are traced by construction
+#: (build_local's rhs/dt_fn/post closures run inside the jitted step)
+_TRACED_METHODS = {"build_local"}
+
+_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+_HOST_ARRAY_MODULES = {"np", "numpy", "onp"}
+
+
+@register
+class HostSyncInTracedRule(Rule):
+    name = "host-sync-in-traced"
+    description = (
+        "host-synchronizing call (.item()/.block_until_ready()/"
+        ".tolist()/np.asarray/jax.device_get) inside traced code — a "
+        "tracer error or a silent per-step device->host round trip"
+    )
+
+    def _traced_nodes(self, mod: ParsedModule):
+        traced = set()
+        traced_names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                if _terminal_name(node.func) in _TRACE_ENTRIES:
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Lambda):
+                            traced.add(arg)
+                        elif isinstance(arg, ast.Name):
+                            traced_names.add(arg.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = (
+                        dec.func if isinstance(dec, ast.Call) else dec
+                    )
+                    if _terminal_name(target) in _TRACE_DECORATORS:
+                        traced.add(node)
+                if node.name in _TRACED_METHODS:
+                    # closures built here ARE the traced physics; the
+                    # method body itself runs at trace time
+                    for sub in ast.walk(node):
+                        if sub is not node and isinstance(
+                            sub, (ast.FunctionDef, ast.Lambda)
+                        ):
+                            traced.add(sub)
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in traced_names
+            ):
+                traced.add(node)
+        # everything defined inside a traced function is traced too
+        closure = set()
+        for fn in traced:
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.Lambda)):
+                    closure.add(sub)
+        return closure
+
+    def _sync_calls(self, fn: ast.AST):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SYNC_METHODS
+            ):
+                yield node, f".{func.attr}()"
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("asarray", "array")
+                and isinstance(func.value, ast.Name)
+                and func.value.id in _HOST_ARRAY_MODULES
+            ):
+                yield node, f"{func.value.id}.{func.attr}(...)"
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "device_get"
+            ):
+                yield node, "jax.device_get(...)"
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        seen = set()
+        for fn in self._traced_nodes(mod):
+            for node, what in self._sync_calls(fn):
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.violation(
+                    mod, node,
+                    f"{what} inside traced code (hot path): hoist the "
+                    "sync out of the traced function or thread the "
+                    "value in as an operand",
+                )
+
+
+# --------------------------------------------------------------------- #
+# closure-constant
+# --------------------------------------------------------------------- #
+@register
+class ClosureConstantRule(Rule):
+    name = "closure-constant"
+    description = (
+        "a build_local closure reads a member-varying scalar straight "
+        "from the config instead of the overrides-threaded local (PR 9 "
+        "contract: the batched ensemble dispatch vmaps ONE compiled "
+        "program over members — a closure constant silently runs every "
+        "member with member 0's physics)"
+    )
+
+    def _override_names(self, fn: ast.FunctionDef) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Compare) and any(
+                isinstance(op, ast.In) for op in node.ops
+            ):
+                lit = _literal_str(node.left)
+                if lit is not None and any(
+                    isinstance(c, ast.Name) and c.id == "overrides"
+                    for c in node.comparators
+                ):
+                    names.add(lit)
+            elif (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "overrides"
+            ):
+                lit = _literal_str(node.slice)
+                if lit is not None:
+                    names.add(lit)
+        return names
+
+    def _cfg_reads(self, fn: ast.AST, names: Set[str]):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Attribute) or node.attr not in names:
+                continue
+            base = node.value
+            is_cfg = (
+                isinstance(base, ast.Name) and base.id == "cfg"
+            ) or (isinstance(base, ast.Attribute) and base.attr == "cfg")
+            if is_cfg:
+                yield node
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        for node in ast.walk(mod.tree):
+            if (
+                not isinstance(node, ast.FunctionDef)
+                or node.name != "build_local"
+            ):
+                continue
+            names = self._override_names(node)
+            if not names:
+                continue
+            for fn in ast.walk(node):
+                if fn is node or not isinstance(
+                    fn, (ast.FunctionDef, ast.Lambda)
+                ):
+                    continue
+                for read in self._cfg_reads(fn, names):
+                    yield self.violation(
+                        mod, read,
+                        f"closure captures cfg.{read.attr} — "
+                        f"{read.attr!r} is a member-varying override; "
+                        "read the overrides-threaded local instead",
+                    )

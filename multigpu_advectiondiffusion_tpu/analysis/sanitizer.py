@@ -1,0 +1,113 @@
+"""Runtime sanitizer: opt-in ``jax.experimental.checkify``
+instrumentation of the dispatch programs (the ``--checkify`` CLI mode).
+
+What ``cuda-memcheck`` was to the reference's kernels, this is to the
+steppers: every jitted block program is rebuilt as
+``jit(checkify(fn))`` with NaN / division-by-zero / out-of-bounds
+checks discharged into the compiled program; the wrapper inspects the
+functionalized error after every dispatch and raises
+:class:`~.resilience.errors.SanitizerError` — a
+:class:`SolverDivergedError` subclass, so the supervisor's existing
+rollback/retry path recovers it with no new plumbing. The divergence
+sentinel sees a NaN only when the chunk-boundary norm probe runs; the
+sanitizer names the offending primitive at the step that produced it —
+the fault-injection suite's second oracle.
+
+Scope: single-device programs (``shard_map`` carries no checkify
+rules — a meshed solver under ``--checkify`` fails loudly at
+construction, pin semantics). Proven on the generic-XLA rung; Pallas
+kernels are opaque to checkify (their interiors add no checks), so the
+e2e guarantees ride ``impl='xla'``.
+
+Off by default; ``configure(enabled=True)`` (or ``--checkify``) arms it
+process-wide. The error-set selection maps the familiar sanitizer
+names onto checkify's sets: ``nan`` -> ``nan_checks``, ``div`` ->
+``div_checks``, ``oob`` -> ``index_checks``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+_DEFAULT_ERRORS = ("nan", "div", "oob")
+
+_state = {
+    "enabled": False,
+    "errors": tuple(_DEFAULT_ERRORS),
+}
+
+
+def configure(enabled: Optional[bool] = None,
+              errors: Optional[Iterable[str]] = None) -> None:
+    """Arm/disarm the sanitizer process-wide; ``errors`` selects the
+    check classes (subset of ``nan``/``div``/``oob``)."""
+    if enabled is not None:
+        _state["enabled"] = bool(enabled)
+    if errors is not None:
+        errors = tuple(errors)
+        unknown = sorted(set(errors) - set(_DEFAULT_ERRORS))
+        if unknown:
+            raise ValueError(
+                f"unknown checkify error class(es) {unknown}; "
+                f"choose from {_DEFAULT_ERRORS}"
+            )
+        if not errors:
+            raise ValueError("empty error set would check nothing")
+        _state["errors"] = errors
+
+
+def enabled() -> bool:
+    return bool(_state["enabled"])
+
+
+def error_names() -> tuple:
+    return tuple(_state["errors"])
+
+
+def _error_set():
+    from jax.experimental import checkify as _ck
+
+    sets = {
+        "nan": _ck.nan_checks,
+        "div": _ck.div_checks,
+        "oob": _ck.index_checks,
+    }
+    out = None
+    for name in _state["errors"]:
+        out = sets[name] if out is None else out | sets[name]
+    return out
+
+
+def checked_jit(fn):
+    """``jit(checkify(fn))`` returning the original signature: the
+    wrapper unwraps the functionalized error on every call and raises
+    :class:`SanitizerError` (through the supervisor's rollback path)
+    when a check tripped. The host read of the error payload happens at
+    the dispatch boundary the caller was about to sync at anyway (the
+    supervisor's chunk cadence)."""
+    import jax
+    from jax.experimental import checkify as _ck
+
+    jitted = jax.jit(_ck.checkify(fn, errors=_error_set()))
+
+    def call(*args, **kwargs):
+        err, out = jitted(*args, **kwargs)
+        raise_if_tripped(err)
+        return out
+
+    return call
+
+
+def raise_if_tripped(err) -> None:
+    """Inspect a checkify error pytree; no-op when clean."""
+    msg = err.get()
+    if msg is None:
+        return
+    from multigpu_advectiondiffusion_tpu import telemetry
+    from multigpu_advectiondiffusion_tpu.resilience.errors import (
+        SanitizerError,
+    )
+
+    telemetry.event("sanitizer", "trip", message=str(msg),
+                    errors=list(_state["errors"]))
+    raise SanitizerError(str(msg))

@@ -448,8 +448,13 @@ def main(argv=None):
         print(line, flush=True)
         lines.append(line)
     if args.out:
-        with open(args.out, "w") as f:
-            f.write("\n".join(lines) + "\n")
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            atomic_write_text,
+        )
+
+        # atomic publish: bench/compare.py gates against this file —
+        # it must never read a half-written round
+        atomic_write_text(args.out, "\n".join(lines) + "\n")
     if args.compare:
         # measured regression gate: this run's rows against the prior
         # round, per-row noise thresholds, loud nonzero exit

@@ -122,6 +122,15 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "rank_failure report instead of hanging in a "
                         "collective forever (0 = off, the MPI "
                         "abort-the-world model)")
+    p.add_argument("--checkify", action="store_true",
+                   help="runtime sanitizer: compile every dispatch "
+                        "program with jax.experimental.checkify NaN/"
+                        "div-by-zero/OOB checks discharged in; a trip "
+                        "names the offending primitive and recovers "
+                        "through the supervisor's rollback path (the "
+                        "cuda-memcheck analog; single-device runs "
+                        "only — see README 'Static analysis & "
+                        "sanitizers')")
     p.add_argument("--sdc-every", type=int, default=0, metavar="M",
                    help="silent-data-corruption guard: every M-th "
                         "sentinel probe re-executes one step from the "
@@ -457,12 +466,18 @@ def _run_convergence(args):
         import os
 
         os.makedirs(args.save, exist_ok=True)
-        with open(os.path.join(args.save, "convergence.log"), "w") as f:
-            f.write("\n".join(lines) + "\n")
-        with open(os.path.join(args.save, "convergence.json"), "w") as f:
-            _json.dump({"ndim": ndim, "dtype": args.dtype,
-                        "order": args.order, "t_end": args.t_end,
-                        "rows": rows}, f, indent=1)
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            atomic_write_text,
+        )
+
+        atomic_write_text(os.path.join(args.save, "convergence.log"),
+                          "\n".join(lines) + "\n")
+        atomic_write_text(
+            os.path.join(args.save, "convergence.json"),
+            _json.dumps({"ndim": ndim, "dtype": args.dtype,
+                         "order": args.order, "t_end": args.t_end,
+                         "rows": rows}, indent=1),
+        )
         from multigpu_advectiondiffusion_tpu.utils.plot import (
             plot_convergence,
         )
@@ -545,6 +560,20 @@ def build_parser() -> argparse.ArgumentParser:
                             "Chrome/Perfetto trace_event export")
     trace_cli.configure_parser(p)
 
+    # tpucfd-check: project static analysis (also standalone:
+    # python -m multigpu_advectiondiffusion_tpu.analysis)
+    from multigpu_advectiondiffusion_tpu.analysis import cli as check_cli
+
+    p = sub.add_parser("check",
+                       help="static analysis (tpucfd-check): AST lint "
+                            "rules (closure constants, host syncs in "
+                            "traced code, non-atomic writes, "
+                            "unregistered telemetry) + the stencil/"
+                            "halo consistency verifier; --selftest "
+                            "proves every rule trips on a seeded "
+                            "violation")
+    check_cli.configure_parser(p)
+
     return ap
 
 
@@ -573,6 +602,12 @@ def main(argv=None):
         from multigpu_advectiondiffusion_tpu.tuning import aot_cache
 
         aot_cache.configure(cache_dir=args.aot_cache, enabled=True)
+    if getattr(args, "checkify", False):
+        # runtime sanitizer: arm process-wide BEFORE any solver builds
+        # its dispatch programs (analysis/sanitizer.py)
+        from multigpu_advectiondiffusion_tpu.analysis import sanitizer
+
+        sanitizer.configure(enabled=True)
     if getattr(args, "tune", False) or getattr(args, "tuning_cache", None):
         # tuner surface: --tune allows measurement on a cache miss,
         # --tuning-cache points both lookup and persistence at PATH

@@ -93,8 +93,11 @@ def run(args) -> None:
 
     report = analyze(args.streams)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(report.to_dict(), f, indent=2)
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            atomic_write_text,
+        )
+
+        atomic_write_text(args.out, json.dumps(report.to_dict(), indent=2))
     if args.json:
         print(json.dumps(report.to_dict(), indent=2))
     else:

@@ -294,8 +294,11 @@ def main(argv=None) -> None:
         artifact = extract(args.extract)
         text = json.dumps(artifact, indent=1)
         if args.out:
-            with open(args.out, "w") as f:
-                f.write(text + "\n")
+            from multigpu_advectiondiffusion_tpu.utils.io import (
+                atomic_write_text,
+            )
+
+            atomic_write_text(args.out, text + "\n")
             print(
                 f"science round: {len(artifact['runs'])} run(s) -> "
                 f"{args.out}"
@@ -318,8 +321,11 @@ def main(argv=None) -> None:
         bands=bands, default_band=args.default_band,
     )
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(result.to_dict(), f, indent=2)
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            atomic_write_text,
+        )
+
+        atomic_write_text(args.out, json.dumps(result.to_dict(), indent=2))
     if args.json:
         print(json.dumps(result.to_dict(), indent=2))
     else:

@@ -325,6 +325,22 @@ class SolverBase:
         cannot be checked on any impl."""
         from multigpu_advectiondiffusion_tpu.ops import is_pallas_impl
 
+        # opt-in checkify sanitizer (--checkify, analysis/sanitizer.py):
+        # the block program compiles with NaN/div0/OOB checks discharged
+        # in, and a trip surfaces as SanitizerError through the
+        # supervisor's existing rollback path. Single-device only —
+        # shard_map carries no checkify rule, so a meshed config fails
+        # loudly here (pin semantics) instead of silently unchecked.
+        from multigpu_advectiondiffusion_tpu.analysis import sanitizer
+
+        if sanitizer.enabled():
+            if self.mesh is not None:
+                raise ValueError(
+                    "--checkify instruments single-device programs; "
+                    "shard_map carries no checkify rule — run unsharded "
+                    "or drop --checkify"
+                )
+            return sanitizer.checked_jit(fn)
         if self.mesh is None:
             return jax.jit(fn)
         if check is None:

@@ -390,6 +390,7 @@ class ShardedFusedBurgers2DStepper(_Sharded2DStepperBase):
         r = HALO[order]
         self.order = order
         self.halo = r
+        self.stencil_radius = r  # per-stage refresh at the WENO reach
         self.core_offsets = (r, r)
         ly, lx = interior_shape
         self.interior_shape = tuple(interior_shape)
@@ -464,6 +465,7 @@ class ShardedFusedDiffusion2DStepper(_Sharded2DStepperBase):
     global walls via the offsets operand."""
 
     halo = R_LAP
+    stencil_radius = R_LAP  # per-stage refresh at the O4 reach
     core_offsets = (R_LAP, R_LAP)
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,

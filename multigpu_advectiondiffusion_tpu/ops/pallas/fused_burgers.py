@@ -856,6 +856,7 @@ class FusedBurgersStepper(FusedStepperBase):
         r = HALO[order]
         self.order = order
         self.halo = r
+        self.stencil_radius = r  # WENO reach; ghosts refresh per stage
         # x-sharded meshes switch to the stored-x-ghost layout: interior
         # at lane offset r with real ghost lanes for the ppermute
         # refresh to rewrite (_x_widths docstring; priced in PARITY.md)

@@ -103,6 +103,19 @@ class FusedBurgers2DStepper:
 
     engaged_label = "fused-whole-run"
 
+    def stencil_spec(self) -> dict:
+        """Stencil metadata (analysis/halo_verify.py): whole-run VMEM
+        residency with an ``r``-deep edge-resynthesized pad — no
+        exchange, single-chip only."""
+        return {
+            "kernel": self.engaged_label,
+            "stage_radius": int(self.halo),
+            "fused_stages": 1,
+            "ghost_depth": int(self.halo),
+            "exchange_depth": None,
+            "steps_per_exchange": 1,
+        }
+
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float | None = None,
                  dt_fn=None, order: int = 5):

@@ -409,6 +409,7 @@ class FusedDiffusionStepper(FusedStepperBase):
     """
 
     halo = R
+    stencil_radius = R  # O4 Laplacian reach; ghosts refresh per stage
     needs_offsets = True  # global wall masks take an offsets operand
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,

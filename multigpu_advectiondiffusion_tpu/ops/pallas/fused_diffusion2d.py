@@ -79,6 +79,20 @@ class FusedDiffusion2DStepper:
     """Jit-cached whole-run VMEM stepper for one (grid, dtype, dt)."""
 
     engaged_label = "fused-whole-run"
+    stencil_radius = R  # O4 Laplacian reach; in-core frozen ghosts
+
+    def stencil_spec(self) -> dict:
+        """Stencil metadata (analysis/halo_verify.py): whole-run VMEM
+        residency with an ``R``-deep frozen Dirichlet pad — no
+        exchange, single-chip only."""
+        return {
+            "kernel": self.engaged_label,
+            "stage_radius": R,
+            "fused_stages": 1,
+            "ghost_depth": R,
+            "exchange_depth": None,
+            "steps_per_exchange": 1,
+        }
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value):

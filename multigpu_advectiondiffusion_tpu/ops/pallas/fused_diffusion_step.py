@@ -154,6 +154,22 @@ class StepFusedDiffusionStepper:
     ``FusedDiffusionStepper`` (``embed``/``extract``/``run``)."""
 
     engaged_label = "fused-step"
+    stencil_radius = R  # O4 Laplacian reach per stage
+    fused_stages = 3  # whole-step temporal blocking: 3 stages per pass
+
+    def stencil_spec(self) -> dict:
+        """Stencil metadata (analysis/halo_verify.py): the z pad is
+        ``ZGHOST = 4R`` (the 3-stage trapezoid's ``3R`` plus one extra
+        ``R`` for the edge blocks' stage-3-deep windows); single-chip
+        only, so there is no exchange depth to verify."""
+        return {
+            "kernel": self.engaged_label,
+            "stage_radius": R,
+            "fused_stages": 3,
+            "ghost_depth": ZGHOST,
+            "exchange_depth": None,
+            "steps_per_exchange": 1,
+        }
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value, block_z=None):

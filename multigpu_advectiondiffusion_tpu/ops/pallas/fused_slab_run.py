@@ -389,11 +389,36 @@ class _SlabRunStepper:
     # in __init__ (models/base._fused_sharded_ctx exchanges
     # ``exchange_depth`` rows instead of the per-step stencil halo)
     k = steps_per_exchange = 1
+    #: queryable stencil metadata (analysis/halo_verify.py): all three
+    #: RK stages recompute per ghost refresh, so G = halo = 3 * h
+    fused_stages = 3
+    stencil_radius = None  # subclasses declare h (R / HALO[order])
+
+    def stencil_spec(self) -> dict:
+        """Stencil/halo contract of the slab rung (see
+        ``stepper_base.FusedStepperBase.stencil_spec``): ``halo`` is
+        the fused-step ghost depth ``G = 3h``, the exchange moves
+        ``k * G`` rows, and the deep schedule's in-block windows shrink
+        by ``G`` per step — all statically provable from these fields
+        plus ``interior_shape``/``padded_shape``/``core_offsets``."""
+        return {
+            "kernel": self.engaged_label,
+            "stage_radius": int(self.stencil_radius),
+            "fused_stages": int(self.fused_stages),
+            "ghost_depth": int(self.halo),
+            "exchange_depth": int(self.exchange_depth),
+            "steps_per_exchange": int(self.steps_per_exchange),
+        }
 
     # populated by subclass __init__:
     #   interior_shape, global_shape, sharded, overlap_split, halo (=G),
     #   exchange_depth (=k*G), core_offsets, padded_shape, dtype
     #   (kernel), _storage, dt, bz, n_slabs, _step_fn
+    #: window ledger of every sharded call built (_make_call), in
+    #: construction order — the static halo verifier
+    #: (analysis/halo_verify.py) proves these against the trapezoid
+    #: arithmetic it re-derives from stencil_spec()
+    _call_windows = ()
 
     def _scratch(self):
         trailing = self.padded_shape[1:]
@@ -456,6 +481,11 @@ class _SlabRunStepper:
         # offset (exchange depth) plus this shard's global offset (oz,
         # traced — applied in-kernel)
         gz_base = z_out0 - G - self.core_offsets[0]
+        self._call_windows.append({
+            "z_out0": int(z_out0), "bz": int(bz), "n_grid": int(n_grid),
+            "ghost_src": ghost_src, "op_rows": int(op_rows),
+            "g_start": int(g_start),
+        })
 
         kern = functools.partial(
             _step_call_kernel,
@@ -497,6 +527,7 @@ class _SlabRunStepper:
         raise NotImplementedError
 
     def _build_sharded_calls(self):
+        self._call_windows = []
         G, bz, n_slabs = self.halo, self.bz, self.n_slabs
         if self.k > 1:
             self._build_deep_calls()
@@ -730,6 +761,7 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
     """
 
     halo = _G_DIFF
+    stencil_radius = R  # O4 Laplacian reach; G = 3 * R
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value, block_z=None, global_shape=None,
@@ -924,6 +956,7 @@ class SlabRunBurgersStepper(_SlabRunStepper):
         G = 3 * r
         self.order = order
         self.halo = G
+        self.stencil_radius = r  # WENO reach; G = 3 * r
         nz, ny, nx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)

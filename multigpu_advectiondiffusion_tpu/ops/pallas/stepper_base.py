@@ -57,6 +57,14 @@ def _with_repeats(fn, repeats: int):
 class FusedStepperBase:
     needs_offsets = False
     engaged_label = "fused-stage"  # what engaged_path()/PrintSummary report
+    #: per-stage stencil radius h, queryable metadata for the static
+    #: halo verifier (analysis/halo_verify.py). None -> equals the
+    #: per-refresh ghost depth ``halo`` (true for the per-stage family:
+    #: ghosts refresh every RK stage at exactly the stencil radius)
+    stencil_radius = None
+    #: RK stages recomputed per ghost refresh (the trapezoid factor):
+    #: 1 for the per-stage family; the whole-step/slab rungs override
+    fused_stages = 1
     # communication-avoiding chunk length: the per-stage kernels bake
     # one stencil-halo refresh per RK stage into their dataflow, so the
     # per-stage family serves k=1 only — the k-step deep-halo schedule
@@ -65,6 +73,30 @@ class FusedStepperBase:
     # against the engaged rung (models/base.py) and fails loudly rather
     # than silently running the per-step cadence.
     steps_per_exchange = 1
+
+    def stencil_spec(self) -> dict:
+        """Queryable stencil/halo metadata — the ``R = 3``-style radius
+        constants promoted to a contract the static verifier
+        (``analysis/halo_verify.py``) can prove consistent with the
+        ghost/exchange/BlockSpec arithmetic. Keys: ``stage_radius`` (h,
+        one stage's stencil reach), ``fused_stages`` (stages recomputed
+        per ghost refresh), ``ghost_depth`` (rows refreshed per
+        exchange site, ``>= fused_stages * h``), ``exchange_depth``
+        (rows ppermuted per exchange, ``k * ghost_depth``; None for
+        single-chip-only steppers), ``steps_per_exchange`` (k)."""
+        h = int(self.stencil_radius or self.halo)
+        return {
+            "kernel": self.engaged_label,
+            "stage_radius": h,
+            "fused_stages": int(self.fused_stages),
+            "ghost_depth": int(self.halo),
+            "exchange_depth": int(
+                getattr(self, "exchange_depth", self.halo)
+            ),
+            "steps_per_exchange": int(
+                getattr(self, "steps_per_exchange", 1) or 1
+            ),
+        }
 
     def _dt_value(self, S):
         raise NotImplementedError

@@ -96,6 +96,28 @@ class PhysicsViolationError(SolverDivergedError):
         super().__init__(step, t, norm, reason=f"physics violation: {what}")
 
 
+class SanitizerError(SolverDivergedError):
+    """The checkify sanitizer (``analysis/sanitizer.py``, the
+    ``--checkify`` mode) caught a NaN / division-by-zero / OOB index
+    *inside* an instrumented stepper — at the offending primitive, one
+    chunk earlier than the divergence sentinel's norm probe would
+    notice the fallout, and named (checkify's message carries the
+    primitive and source line).
+
+    Subclasses :class:`SolverDivergedError` so the supervisor's
+    existing rollback + dt-backoff path recovers it unchanged — the
+    second oracle the fault-injection suite reads. ``step``/``t`` are
+    unknown at the dispatch wrapper (-1/nan) unless the catcher fills
+    them in."""
+
+    def __init__(self, message: str, step: int = -1,
+                 t: float = float("nan")):
+        self.checkify_message = str(message)
+        super().__init__(
+            step, t, float("nan"), reason=f"checkify: {message}"
+        )
+
+
 #: Documented CLI exit code when a peer rank died or stalled past the
 #: watchdog timeout: the survivor aborts instead of hanging in a
 #: collective forever. Restart the job (on the surviving topology if a

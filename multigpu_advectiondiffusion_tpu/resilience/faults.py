@@ -291,6 +291,10 @@ def torn_ckptd_write(directory: str, mode: str = "uncommitted") -> None:
         if entry["shape"][0] + delta <= 0:
             raise ValueError("shard too small to tear along axis 0")
         entry["shape"] = [entry["shape"][0] + delta] + entry["shape"][1:]
+        # this IS the fault: the torn-checkpoint injector deliberately
+        # rewrites a manifest in place to simulate the corruption the
+        # atomic-write discipline prevents
+        # tpucfd-check: allow[raw-artifact-write] — deliberate torn write
         with open(mpaths[0], "w") as f:
             json.dump(m, f)
         return

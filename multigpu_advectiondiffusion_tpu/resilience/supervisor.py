@@ -417,6 +417,20 @@ def supervise_run(
             last_snap_it = int(nxt.it)
         return nxt
 
+    def _locate(err: SolverDivergedError, at) -> SolverDivergedError:
+        """A SanitizerError (checkify trip) carries no step/t — they
+        are unknown at the dispatch wrapper. Pin it to the chunk's
+        starting state so the rollback event is attributable."""
+        if getattr(err, "step", 0) < 0:
+            err.step = int(at.it)
+            err.t = float(at.t)
+            err.args = (
+                f"solver diverged at step {err.step} "
+                f"(t={err.t:.6g}): {err.reason} "
+                f"(max|u| = {err.norm:.6g})",
+            )
+        return err
+
     def _recover(err: SolverDivergedError):
         nonlocal last_good
         report.retries += 1
@@ -489,7 +503,7 @@ def supervise_run(
                     nxt, int(nxt.it) - prev_it, time.monotonic() - c0
                 )
             except SolverDivergedError as err:
-                state = _recover(err)
+                state = _recover(_locate(err, state))
                 _chunk_io[0] = 0.0
         return _finish(state)
 
@@ -523,7 +537,7 @@ def supervise_run(
                 dt_est = max(float(nxt.t) - float(state.t), 0.0) or None
                 state = _after_chunk(nxt, probe_due=bool(sentinel_every))
             except SolverDivergedError as err:
-                state = _recover(err)
+                state = _recover(_locate(err, state))
                 dt_est = None
             continue
         if sentinel_every:
@@ -544,7 +558,7 @@ def supervise_run(
                 # remainder is below the time dtype's resolution): done
                 break
         except SolverDivergedError as err:
-            state = _recover(err)
+            state = _recover(_locate(err, state))
             dt_est = getattr(solver, "dt", None)
             _chunk_io[0] = 0.0
     return _finish(state)

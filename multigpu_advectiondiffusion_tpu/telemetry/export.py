@@ -165,6 +165,8 @@ def write_chrome_trace(path: str, streams: Sequence[Stream]) -> dict:
             "refusing to write invalid trace_event JSON: "
             + "; ".join(problems[:5])
         )
-    with open(path, "w") as f:
-        json.dump(obj, f)
+    from multigpu_advectiondiffusion_tpu.utils.io import atomic_write_text
+
+    # atomic publish: Perfetto must never load a half-written trace
+    atomic_write_text(path, json.dumps(obj))
     return obj

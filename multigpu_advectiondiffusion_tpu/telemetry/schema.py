@@ -22,7 +22,6 @@ after the exception type, ``tune`` names arrive via a variable).
 from __future__ import annotations
 
 import os
-import re
 from typing import Dict, List, Optional, Set, Tuple
 
 # kind -> name -> required fields (beyond the sink's own t/proc/kind/
@@ -111,6 +110,10 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     "mem": {"watermark": {"bytes_in_use", "peak_bytes", "source"}},
     # measured-peak calibration writes (telemetry/calibration.py)
     "calib": {"update": {"backend", "path", "persisted"}},
+    # checkify sanitizer trips (analysis/sanitizer.py, --checkify): one
+    # event per caught NaN/div0/OOB, before SanitizerError enters the
+    # supervisor's rollback path
+    "sanitizer": {"trip": {"message", "errors"}},
     "crash": {None: {"message"}},
 }
 
@@ -146,18 +149,6 @@ COUNTER_NAMES: Set[str] = {
     "halo.bytes_per_execution",
 }
 
-# `.event("kind"[, "name"]` on any sink-ish receiver. DOTALL-free: \s*
-# already spans newlines between the arguments.
-_EVENT_RE = re.compile(
-    r"""\.event\(\s*
-        ["']([a-z_]+)["']\s*,\s*        # literal kind
-        (?:["']([\w:.-]+)["'])?         # literal name (absent if dynamic)
-    """,
-    re.VERBOSE,
-)
-_COUNTER_RE = re.compile(r"""\.counter\(\s*["']([\w.]+)["']""", re.VERBOSE)
-
-
 def scan_emitted(
     root: Optional[str] = None,
 ) -> Tuple[Set[Tuple[str, Optional[str]]], Set[str]]:
@@ -165,24 +156,21 @@ def scan_emitted(
     ``(event_pairs, counter_names)`` where each pair is
     ``(kind, name-or-None)`` — name ``None`` when the call site passes
     a variable. Test files are out of scope (they emit arbitrary
-    events on purpose)."""
+    events on purpose).
+
+    Implemented on the shared AST rule engine
+    (``analysis/rules.scan_emission_sites`` — the generalization of the
+    regex scanner that used to live here): same contract, and the same
+    extraction the ``unregistered-emission`` lint rule runs per module,
+    so the tier-1 schema test and ``tpucfd-check`` cannot disagree
+    about what counts as an emission site."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pairs: Set[Tuple[str, Optional[str]]] = set()
-    counters: Set[str] = set()
-    for dirpath, _dirnames, filenames in os.walk(root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, fn)) as f:
-                text = f.read()
-            for m in _EVENT_RE.finditer(text):
-                pairs.add((m.group(1), m.group(2)))
-            for m in _COUNTER_RE.finditer(text):
-                counters.add(m.group(1))
-    return pairs, counters
+    from multigpu_advectiondiffusion_tpu.analysis.rules import (
+        scan_emission_sites,
+    )
+
+    return scan_emission_sites(root)
 
 
 def registered(kind: str, name: Optional[str]) -> bool:

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Lint gate: the static-analysis counterpart of out/bench_gate.sh
+# (measured perf) and out/science_gate.sh (numerics). Two halves:
+#
+#   1. clean-tree pass — tpucfd-check must exit 0 on the shipped
+#      package: every AST lint rule silent (closure constants, host
+#      syncs in traced code, non-atomic artifact writes, unregistered
+#      telemetry emissions) and the stencil/halo verifier proving every
+#      admitted (rung, order, k) combination;
+#   2. --selftest — every rule must TRIP on its seeded violation
+#      fixture (and pass the clean twin), and the halo verifier must
+#      fail an injected off-by-one ghost depth naming kernel/axis/depth
+#      — so a green gate means "checked and clean", never "checker
+#      silently broke".
+#
+#   ./out/lint_gate.sh              # both halves
+#   ./out/lint_gate.sh --selftest   # selftest only
+#
+# Runs on the virtual CPU backend (no TPU needed), same as tier-1.
+# Hooked into out/soak_resilience.sh behind LINT_GATE=1.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+if [[ "${1:-}" == "--selftest" ]]; then
+  python -m multigpu_advectiondiffusion_tpu.analysis --selftest
+  exit 0
+fi
+
+echo "=== lint_gate: clean-tree pass ==="
+python -m multigpu_advectiondiffusion_tpu.analysis
+
+echo "=== lint_gate: rule selftests ==="
+python -m multigpu_advectiondiffusion_tpu.analysis --selftest
+
+echo "lint_gate: PASS"

@@ -10,6 +10,9 @@
 #                                   # regression-gate self-test after
 #   SCIENCE_GATE=1 ./out/soak_resilience.sh  # also run the science
 #                                   # regression-gate self-test after
+#   LINT_GATE=1 ./out/soak_resilience.sh     # also run the static-
+#                                   # analysis gate (clean tree +
+#                                   # rule selftests) after
 #
 # Runs on the virtual CPU backend (no TPU needed), same as tier-1.
 set -euo pipefail
@@ -41,4 +44,11 @@ if [[ "${SCIENCE_GATE:-0}" == "1" ]]; then
   # injected 2% diffusivity perturbation, passes an unmodified round)
   # — see out/science_gate.sh
   JAX_PLATFORMS=cpu ./out/science_gate.sh --selftest
+fi
+
+if [[ "${LINT_GATE:-0}" == "1" ]]; then
+  # and on the invariants: tpucfd-check clean-tree pass + every rule's
+  # seeded-violation selftest + the halo verifier's injected
+  # off-by-one — see out/lint_gate.sh
+  JAX_PLATFORMS=cpu ./out/lint_gate.sh
 fi

@@ -1,0 +1,333 @@
+"""Static-analysis subsystem (ISSUE 10): the AST lint rules, the
+stencil/halo consistency verifier, and the checkify sanitizer.
+
+Tier-1 teeth:
+
+* the whole installed package must lint clean — a future non-atomic
+  write, closure-captured override, host sync in traced code or
+  unregistered emission fails HERE, not in production six months on;
+* every rule trips on its seeded violation fixture and stays silent on
+  the clean twin (a green gate means "checked and clean", never
+  "checker broke");
+* the halo verifier proves every dispatch-admitted (rung, order, k)
+  combination and fails an injected off-by-one ghost depth loudly,
+  naming kernel/axis/depth;
+* ``--checkify`` catches an injected NaN (named, at the offending
+  primitive) through the supervisor's rollback path BEFORE the
+  divergence sentinel's norm probe would notice.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax.numpy as jnp
+import pytest
+
+from multigpu_advectiondiffusion_tpu.analysis import (
+    all_rules,
+    halo_verify,
+    run_rules,
+    sanitizer,
+)
+from multigpu_advectiondiffusion_tpu.analysis.fixtures import RULE_FIXTURES
+from multigpu_advectiondiffusion_tpu.utils.io import atomic_write_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "multigpu_advectiondiffusion_tpu")
+
+
+# --------------------------------------------------------------------- #
+# Lint rules
+# --------------------------------------------------------------------- #
+def test_package_tree_lints_clean():
+    violations = run_rules(PKG)
+    assert not violations, (
+        "tpucfd-check flags the shipped tree:\n"
+        + "\n".join(str(v) for v in violations)
+    )
+
+
+def test_every_rule_has_a_fixture():
+    assert set(all_rules()) == set(RULE_FIXTURES)
+
+
+def _lint_fixture(rule_name: str, src: str):
+    rule = all_rules()[rule_name]()
+    with tempfile.TemporaryDirectory() as d:
+        atomic_write_text(os.path.join(d, "fixture.py"), src)
+        return [v for v in run_rules(d, rules=[rule])
+                if v.rule == rule_name]
+
+
+@pytest.mark.parametrize("rule_name", sorted(RULE_FIXTURES))
+def test_rule_trips_on_seeded_violation(rule_name):
+    hits = _lint_fixture(rule_name, RULE_FIXTURES[rule_name]["bad"])
+    assert hits, f"rule {rule_name} missed its seeded violation"
+    assert all(v.rule == rule_name and v.line > 0 for v in hits)
+
+
+@pytest.mark.parametrize("rule_name", sorted(RULE_FIXTURES))
+def test_rule_passes_clean_twin(rule_name):
+    hits = _lint_fixture(rule_name, RULE_FIXTURES[rule_name]["good"])
+    assert not hits, [str(v) for v in hits]
+
+
+def test_suppression_pragma_is_honored():
+    src = RULE_FIXTURES["raw-artifact-write"]["bad"].replace(
+        "    with open(path, 'w') as f:",
+        "    # tpucfd-check: allow[raw-artifact-write] — test pragma\n"
+        "    with open(path, 'w') as f:",
+    )
+    assert not _lint_fixture("raw-artifact-write", src)
+
+
+def test_scan_emitted_rides_the_engine():
+    """The migrated schema scanner (satellite 2): same contract as the
+    regex it replaced — real sites found, dynamic names as None."""
+    from multigpu_advectiondiffusion_tpu.telemetry import schema
+
+    pairs, counters = schema.scan_emitted(PKG)
+    assert ("dispatch", "build") in pairs
+    assert ("resilience", "rollback") in pairs
+    assert ("sanitizer", "trip") in pairs
+    # RunSummary emits under a run-named (dynamic) event name
+    assert ("summary", None) in pairs
+    assert "halo.exchanges_traced" in counters
+
+
+# --------------------------------------------------------------------- #
+# Stencil/halo verifier
+# --------------------------------------------------------------------- #
+def test_halo_verifier_proves_all_admitted_combos():
+    report = halo_verify.verify_all()
+    assert report.ok, "\n".join(str(v) for v in report.violations)
+    names = {c.name for c in report.combos if c.admitted}
+    # the matrix genuinely spans (rung, order, k): per-stage/step/
+    # whole-run/slab rungs, WENO orders 5 and 7, k in {1, 2, 3}
+    for expect in (
+        "diffusion3d-stage", "diffusion3d-stage[sharded]",
+        "diffusion3d-step", "diffusion2d-whole-run",
+        "slab-diffusion[k=1]", "slab-diffusion[k=2]",
+        "slab-diffusion[k=3]", "slab-diffusion[k=2,split]",
+        "burgers3d-stage[o5]", "burgers3d-stage[o7,sharded]",
+        "slab-burgers[o5,k=2]", "slab-burgers[o7,k=2,split]",
+        "burgers2d-stage[o7,sharded]",
+    ):
+        assert expect in names, f"combo {expect} missing from the matrix"
+    assert report.checked >= 25
+
+
+def test_constants_cross_check_from_first_principles():
+    assert not halo_verify.verify_constants()
+
+
+@pytest.mark.parametrize("combo_name", [
+    "slab-diffusion[k=2]", "slab-burgers[o5,k=2]",
+])
+def test_injected_off_by_one_ghost_depth_fails_loudly(combo_name):
+    combo = next(
+        c for c in halo_verify.default_combos() if c.name == combo_name
+    )
+    stepper = combo.build()
+    stepper.exchange_depth += 1  # the off-by-one a refactor could slip
+    violations = halo_verify.verify_stepper(stepper, kernel=combo_name)
+    assert violations, "verifier passed a broken exchange depth"
+    text = "\n".join(str(v) for v in violations)
+    assert combo_name in text  # names the kernel
+    assert any(v.axis == 0 for v in violations)  # names the axis
+    k, G = stepper.steps_per_exchange, stepper.halo
+    assert str(k * G) in text and str(k * G + 1) in text  # names depths
+
+
+def test_injected_thin_shard_fails():
+    """A shard too thin to serve the deep exchange is caught before
+    any program would trace (the halo.exchange_ghosts guard, proven
+    statically)."""
+    combo = next(
+        c for c in halo_verify.default_combos()
+        if c.name == "slab-diffusion[k=2]"
+    )
+    stepper = combo.build()
+    stepper.interior_shape = (stepper.exchange_depth - 1,) + tuple(
+        stepper.interior_shape[1:]
+    )
+    violations = halo_verify.verify_stepper(stepper)
+    assert any("serve the exchange" in v.what for v in violations)
+
+
+def test_stencil_spec_is_queryable_metadata():
+    """Satellite: the R=3-style constants are promoted to one
+    queryable contract shared by every rung."""
+    for combo in halo_verify.default_combos():
+        try:
+            stepper = combo.build()
+        except ValueError:
+            continue
+        spec = stepper.stencil_spec()
+        for key in ("kernel", "stage_radius", "fused_stages",
+                    "ghost_depth", "exchange_depth",
+                    "steps_per_exchange"):
+            assert key in spec, (combo.name, key)
+        assert spec["stage_radius"] >= 1
+        assert spec["ghost_depth"] >= (
+            spec["fused_stages"] * spec["stage_radius"]
+        )
+
+
+# --------------------------------------------------------------------- #
+# Checkify sanitizer
+# --------------------------------------------------------------------- #
+@pytest.fixture
+def checkified():
+    sanitizer.configure(enabled=True, errors=("nan", "div", "oob"))
+    try:
+        yield
+    finally:
+        sanitizer.configure(enabled=False)
+
+
+def _nan_solver():
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionConfig,
+        DiffusionSolver,
+    )
+
+    grid = Grid.make(12, 10, 8, lengths=2.0)
+
+    def nan_source(u):
+        # one poisoned cell: the sentinel sees it only at the next
+        # norm probe; checkify sees the producing primitive
+        return jnp.zeros_like(u).at[2, 2, 2].set(jnp.nan)
+
+    return DiffusionSolver(DiffusionConfig(grid=grid, source=nan_source))
+
+
+def test_checkify_catches_injected_nan_named_before_sentinel(checkified):
+    from multigpu_advectiondiffusion_tpu.resilience.errors import (
+        SanitizerError,
+    )
+    from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+        supervise_run,
+    )
+
+    solver = _nan_solver()
+    with pytest.raises(SanitizerError) as exc:
+        supervise_run(solver, solver.initial_state(), iters=8,
+                      sentinel_every=4, max_retries=1)
+    # named: checkify's message carries the offending primitive
+    assert "nan" in str(exc.value).lower()
+    assert "primitive" in exc.value.checkify_message
+    # located: the supervisor pinned the dispatch-time error to a step
+    assert exc.value.step >= 0
+
+
+def test_same_fault_without_checkify_is_a_plain_divergence():
+    from multigpu_advectiondiffusion_tpu.resilience.errors import (
+        SanitizerError,
+        SolverDivergedError,
+    )
+    from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+        supervise_run,
+    )
+
+    solver = _nan_solver()
+    with pytest.raises(SolverDivergedError) as exc:
+        supervise_run(solver, solver.initial_state(), iters=8,
+                      sentinel_every=4, max_retries=0)
+    assert not isinstance(exc.value, SanitizerError)
+
+
+def test_checkify_rollback_event_rides_supervisor_path(checkified):
+    """The sanitizer is the rollback trigger, not a new recovery
+    mechanism: the retry ledger shows the checkify reason."""
+    from multigpu_advectiondiffusion_tpu.resilience.errors import (
+        SanitizerError,
+    )
+    from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+        supervise_run,
+    )
+
+    solver = _nan_solver()
+    try:
+        supervise_run(solver, solver.initial_state(), iters=8,
+                      sentinel_every=4, max_retries=2)
+    except SanitizerError as err:
+        assert "checkify" in err.reason
+
+
+def test_checkify_clean_run_matches_unchecked(checkified):
+    """Instrumentation must not perturb the physics: a healthy run
+    under --checkify reproduces the unchecked trajectory bit-exact."""
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionConfig,
+        DiffusionSolver,
+    )
+
+    grid = Grid.make(10, 8, 6, lengths=2.0)
+    cfg = DiffusionConfig(grid=grid)
+    checked = DiffusionSolver(cfg)
+    out_checked = checked.run(checked.initial_state(), 5)
+    sanitizer.configure(enabled=False)
+    plain = DiffusionSolver(cfg)
+    out_plain = plain.run(plain.initial_state(), 5)
+    assert jnp.array_equal(out_checked.u, out_plain.u)
+
+
+def test_checkify_declines_meshes_loudly(checkified, devices):
+    import jax
+
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionConfig,
+        DiffusionSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dz": 2}, devices=jax.devices()[:2])
+    grid = Grid.make(8, 8, 8, lengths=2.0)
+    solver = DiffusionSolver(DiffusionConfig(grid=grid), mesh=mesh)
+    with pytest.raises(ValueError, match="checkify"):
+        solver.run(solver.initial_state(), 1)
+
+
+def test_sanitizer_configure_validates():
+    with pytest.raises(ValueError):
+        sanitizer.configure(errors=("nan", "nonsense"))
+    with pytest.raises(ValueError):
+        sanitizer.configure(errors=())
+    assert not sanitizer.enabled()
+
+
+# --------------------------------------------------------------------- #
+# CLI + gate surfaces
+# --------------------------------------------------------------------- #
+def test_check_cli_clean_and_selftest():
+    from multigpu_advectiondiffusion_tpu.analysis import cli as check_cli
+
+    assert check_cli.main([]) == 0
+    assert check_cli.main(["--selftest"]) == 0
+    assert check_cli.main(["--list-rules"]) == 0
+
+
+def test_check_cli_flags_a_seeded_tree():
+    from multigpu_advectiondiffusion_tpu.analysis import cli as check_cli
+
+    with tempfile.TemporaryDirectory() as d:
+        atomic_write_text(
+            os.path.join(d, "bad.py"),
+            RULE_FIXTURES["raw-artifact-write"]["bad"],
+        )
+        assert check_cli.main(["--root", d, "--skip-halo"]) == 1
+
+
+def test_atomic_write_text_publishes_complete_files(tmp_path):
+    path = str(tmp_path / "artifact.json")
+    atomic_write_text(path, "first")
+    atomic_write_text(path, "second")
+    with open(path) as f:
+        assert f.read() == "second"
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
