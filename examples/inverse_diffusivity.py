@@ -1,0 +1,126 @@
+"""Gradient-based inverse problem on the batched ensemble engine.
+
+Recover an unknown diffusivity K* from one observed field by
+differentiating STRAIGHT THROUGH the batched dispatch: ``jax.grad``
+flows through ``SolverBase.advance_to_ensemble`` (the ``max_steps``
+bounded-loop mode — reverse-mode needs a static trip count) with the
+member diffusivities as traced operands, so one compiled program
+yields the loss AND its gradient for B independent optimization
+trajectories at once. This is a scenario family the CUDA reference can
+never offer (ROADMAP item 1's creative extension): its kernels are
+hand-written forward passes; here the same vmapped stepper that serves
+the ensemble engine is differentiable for free.
+
+Run::
+
+    JAX_PLATFORMS=cpu python examples/inverse_diffusivity.py
+
+Consumed by ``tests/test_inverse.py`` (tier-1, loose-tolerance
+convergence assert).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# runnable as a plain script from anywhere: the package lives one
+# directory up from examples/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def make_problem(n: int = 48, k_true: float = 1.0, t_window: float = 0.05):
+    """(solver, batched initial state template, t_end, observed field)
+    for a 1-D heat-kernel workload with ground-truth diffusivity
+    ``k_true``."""
+    from multigpu_advectiondiffusion_tpu import (
+        DiffusionConfig,
+        DiffusionSolver,
+        Grid,
+    )
+
+    grid = Grid.make(n, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, diffusivity=k_true, dtype="float32",
+                          impl="xla")
+    solver = DiffusionSolver(cfg)
+    s0 = solver.initial_state()
+    t_end = float(s0.t) + t_window
+    obs = solver.advance_to(s0, t_end)
+    return solver, s0, t_end, obs.u
+
+
+def recover_diffusivity(
+    guesses,
+    n: int = 48,
+    k_true: float = 1.0,
+    t_window: float = 0.05,
+    iterations: int = 60,
+    lr: float = 0.05,
+    max_steps: int = 64,
+):
+    """Run B simultaneous gradient-descent trajectories (one per initial
+    guess) against the observed field; returns ``(recovered, history)``
+    where ``recovered`` is the (B,) final diffusivity estimates.
+
+    ``max_steps`` bounds every member's step count for the
+    differentiable ``fori_loop`` mode of ``advance_to_ensemble`` — it
+    must cover the steepest member (largest K => smallest stability
+    dt => most steps to ``t_end``)."""
+    from multigpu_advectiondiffusion_tpu.models.state import EnsembleState
+
+    solver, s0, t_end, u_obs = make_problem(n, k_true, t_window)
+    Ks = jnp.asarray(guesses, jnp.float32)
+    B = int(Ks.shape[0])
+    est0 = EnsembleState(
+        u=jnp.stack([s0.u] * B),
+        t=jnp.stack([s0.t] * B),
+        it=jnp.zeros((B,), jnp.int32),
+    )
+
+    def loss(ks):
+        out = solver.advance_to_ensemble(
+            est0, t_end, operands={"diffusivity": ks},
+            max_steps=max_steps,
+        )
+        # summed per-member misfits: members are independent, so the
+        # gradient separates — one backward pass serves all B
+        # optimization trajectories
+        return jnp.sum(jnp.mean((out.u - u_obs[None]) ** 2, axis=1))
+
+    grad_fn = jax.value_and_grad(loss)
+    history = []
+    # sign descent on log K with a geometrically decaying step: the
+    # per-member misfit scales differ by orders of magnitude across
+    # guesses (a raw gradient step would stall the flattest member);
+    # the decaying log-step first homes in at a fixed multiplicative
+    # rate, then anneals — total travel covers a ~10x-off guess
+    theta = jnp.log(Ks)
+    step = lr
+    for _ in range(iterations):
+        value, grads = grad_fn(jnp.exp(theta))
+        history.append(float(value))
+        theta = theta - step * jnp.sign(grads)
+        step *= 0.97
+    Ks = jnp.exp(theta)
+    return Ks, history
+
+
+def main():
+    k_true = 1.3
+    guesses = [0.4, 0.9, 2.2, 3.5]
+    recovered, history = recover_diffusivity(guesses, k_true=k_true)
+    print(f"true diffusivity: {k_true}")
+    for g, k in zip(guesses, [float(v) for v in recovered]):
+        err = abs(k - k_true) / k_true
+        print(f"  guess {g:4.2f} -> recovered {k:6.4f} "
+              f"(rel err {100 * err:.2f}%)")
+    print(f"loss: {history[0]:.3e} -> {history[-1]:.3e} "
+          f"({len(history)} gradient steps through the batched "
+          "dispatch)")
+
+
+if __name__ == "__main__":
+    main()

@@ -144,8 +144,80 @@ def verify_stepper(stepper, kernel: Optional[str] = None
             bad(0, "shard core too thin to serve the exchange "
                    "(parallel/halo.exchange_ghosts would raise)",
                 f"interior z >= {depth}", interior[0])
+    # B-folded member grid axis (ISSUE 11): a batched rung must declare
+    # the member axis HALO-FREE — members are independent problems, so
+    # any nonzero member-axis stencil reach is a cross-member read, the
+    # exact stale-halo class this verifier exists to rule out — and the
+    # fold must never compose with spatial sharding in one program
+    # (the per-step ghost refresh cannot cross the fold).
+    members = int(spec.get("members", 1) or 1)
+    mh = spec.get("member_halo")
+    if members > 1:
+        if mh != 0:
+            bad(None, "member axis of a B-folded grid must be "
+                      "halo-free (members are independent problems)",
+                0, mh)
+        if sharded:
+            bad(0, "B-folded member grid cannot compose with spatial "
+                   "sharding in one program", "unsharded", "sharded")
+    elif mh not in (None, 0):
+        bad(None, "declared member-axis halo must be 0", 0, mh)
     out.extend(_verify_slab_windows(stepper, kern, spec))
     return out
+
+
+# --------------------------------------------------------------------- #
+# Member-sharded ensemble meshes (ISSUE 11)
+# --------------------------------------------------------------------- #
+def verify_member_mesh(name: str, mesh_axes: dict,
+                       spatial: dict) -> ComboResult:
+    """Statically prove a members(-x-spatial) ensemble mesh layout:
+    the ``members`` axis exists, shards ONLY the batched state's
+    leading member axis (never a grid axis — member sharding is
+    halo-free by construction, so a member axis inside the spatial
+    decomposition would be an undeclared exchange), and every spatial
+    axis keeps its existing per-subgroup exchange contract (nothing
+    about the spatial halo arithmetic changes under the fold)."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import MEMBER_AXIS
+
+    res = ComboResult(name=name, admitted=True)
+
+    def bad(axis, what, expected, actual):
+        res.violations.append(
+            HaloViolation(name, axis, what, expected, actual)
+        )
+
+    if MEMBER_AXIS not in mesh_axes:
+        bad(None, "ensemble mesh must carry a members axis",
+            f"'{MEMBER_AXIS}' in mesh", sorted(mesh_axes))
+        return res
+    if mesh_axes[MEMBER_AXIS] < 1:
+        bad(None, "member axis extent must be >= 1", ">= 1",
+            mesh_axes[MEMBER_AXIS])
+    for ax, nm in sorted(spatial.items()):
+        names = nm if isinstance(nm, tuple) else (nm,)
+        if MEMBER_AXIS in names:
+            bad(ax, "the members axis may not shard a grid axis "
+                    "(member sharding is halo-free; a grid-axis "
+                    "mapping would be an undeclared exchange)",
+                "spatial mesh axes only", nm)
+        for n in names:
+            if n != MEMBER_AXIS and n not in mesh_axes:
+                bad(ax, "spatial decomposition names a missing mesh "
+                        "axis", f"one of {sorted(mesh_axes)}", n)
+    return res
+
+
+def default_member_meshes():
+    """The ensemble mesh layouts the dispatch admits, as static
+    (name, mesh_axes, spatial-mapping) cases — members-only sharding
+    and the members x z-slab composition (ROADMAP item 1's two
+    rungs)."""
+    return [
+        ("ensemble-mesh[members=8]", {"members": 8}, {}),
+        ("ensemble-mesh[members=4,dz=2]", {"members": 4, "dz": 2},
+         {0: "dz"}),
+    ]
 
 
 def _expected_slab_windows(stepper, spec):
@@ -386,16 +458,28 @@ def default_combos() -> List[Combo]:
         ),
     ))
 
-    def slab_diff(k=1, split=False, shape=(24, 10, 12), sharded=True):
+    def slab_diff(k=1, split=False, shape=(24, 10, 12), sharded=True,
+                  members=1):
         return SlabRunDiffusionStepper(
             shape, f32, _spacing(3), [1.0] * 3, 1e-4, 2, 0.0,
             global_shape=(shape[0] * 2,) + shape[1:] if sharded else None,
-            overlap_split=split, steps_per_exchange=k,
+            overlap_split=split, steps_per_exchange=k, members=members,
         )
 
     combos.append(Combo(
         "slab-diffusion[unsharded]",
         lambda: slab_diff(sharded=False),
+    ))
+    # B-folded member grid axis (ISSUE 11): batched instances must
+    # prove the member axis halo-free and decline spatial sharding
+    for B in (2, 4):
+        combos.append(Combo(
+            f"slab-diffusion[B={B}]",
+            lambda B=B: slab_diff(sharded=False, members=B),
+        ))
+    combos.append(Combo(
+        "slab-diffusion[B=4,sharded]",  # must DECLINE (constructor gate)
+        lambda: slab_diff(members=4),
     ))
     for k in (1, 2, 3):
         combos.append(Combo(
@@ -450,6 +534,13 @@ def default_combos() -> List[Combo]:
                 1e-3, order=order,
             ),
         ))
+        combos.append(Combo(
+            f"slab-burgers[o{order},B=4]",
+            lambda order=order: SlabRunBurgersStepper(
+                (36, 16, 64), f32, _spacing(3), _burg(), "js", 0.0,
+                1e-3, order=order, members=4,
+            ),
+        ))
         for k in (1, 2):
             combos.append(Combo(
                 f"slab-burgers[o{order},k={k}]",
@@ -467,7 +558,9 @@ def default_combos() -> List[Combo]:
 def verify_all(combos: Optional[List[Combo]] = None) -> HaloReport:
     """Run the battery over every admitted combination; declined
     combinations (a constructor gate raised, as the dispatch would)
-    are recorded with their reason, not silently dropped."""
+    are recorded with their reason, not silently dropped. The default
+    battery also proves the ensemble mesh layouts
+    (:func:`default_member_meshes`) member-axis-halo-free."""
     report = HaloReport(constant_violations=verify_constants())
     for combo in combos if combos is not None else default_combos():
         res = ComboResult(name=combo.name, admitted=True)
@@ -480,4 +573,9 @@ def verify_all(combos: Optional[List[Combo]] = None) -> HaloReport:
             continue
         res.violations = verify_stepper(stepper, kernel=combo.name)
         report.combos.append(res)
+    if combos is None:
+        for name, mesh_axes, spatial in default_member_meshes():
+            report.combos.append(
+                verify_member_mesh(name, mesh_axes, spatial)
+            )
     return report

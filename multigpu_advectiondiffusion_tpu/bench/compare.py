@@ -47,13 +47,15 @@ DEFAULT_SPREAD_FACTOR = 2.0
 # versions.
 MEASURED_FIELDS = ("xla_flops", "xla_bytes", "peak_bytes")
 
-# Batched-ensemble columns (ISSUE 9): same coverage-note discipline as
-# MEASURED_FIELDS — ``ensemble`` (member count B) and ``vs_looped``
-# (batched-over-looped amortization ratio) are provenance, not gated
-# throughput. Rows from rounds BEFORE the ensemble engine (BENCH_r01 -
-# r05) carry neither field; :func:`row_members` reads them as B=1 and
+# Batched-ensemble columns (ISSUE 9/11): same coverage-note discipline
+# as MEASURED_FIELDS — ``ensemble`` (member count B), ``vs_looped``
+# (batched-over-looped amortization ratio) and, since the mesh-scale
+# round, ``member_sharding``/``devices`` (member-axis placement) are
+# provenance, not gated throughput. Rows from rounds BEFORE the
+# ensemble engine (BENCH_r01-r05) carry none of these;
+# :func:`row_members`/:func:`row_member_sharding` read them as 1 and
 # their absence is never a coverage regression.
-ENSEMBLE_FIELDS = ("ensemble", "vs_looped")
+ENSEMBLE_FIELDS = ("ensemble", "vs_looped", "member_sharding", "devices")
 
 
 def parse_rows(text: str) -> List[dict]:
@@ -98,6 +100,17 @@ def row_members(row: dict) -> int:
     never a parse error, never a coverage regression."""
     try:
         return max(1, int(row.get("ensemble") or 1))
+    except (TypeError, ValueError):
+        return 1
+
+
+def row_member_sharding(row: dict) -> int:
+    """Member-axis shard count of a row (how many devices the member
+    axis was spread over); rounds predating the mesh-scale ensemble
+    round read as 1 — never a parse error, never a coverage
+    regression."""
+    try:
+        return max(1, int(row.get("member_sharding") or 1))
     except (TypeError, ValueError):
         return 1
 
@@ -223,6 +236,17 @@ def compare(
             notes.append(
                 f"{key}: ensemble member count changed "
                 f"{row_members(old)} -> {row_members(new)} "
+                "(coverage note, non-gating)"
+            )
+        if row_member_sharding(old) != row_member_sharding(new):
+            # member-placement drift: the same B spread over a
+            # different number of devices is a different machine
+            # configuration — the rate comparison stays (same
+            # workload), but the drift is surfaced
+            notes.append(
+                f"{key}: member placement changed "
+                f"{row_member_sharding(old)}-way -> "
+                f"{row_member_sharding(new)}-way member sharding "
                 "(coverage note, non-gating)"
             )
         ov, nv = row_value(old), row_value(new)

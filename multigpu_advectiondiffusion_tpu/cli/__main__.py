@@ -248,12 +248,17 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
     p.add_argument("--ensemble", type=int, default=0, metavar="B",
                    help="batched ensemble engine: advance B independent "
                         "members (varying ICs and/or swept scalars — see "
-                        "--sweep) in ONE compiled, vmapped dispatch "
+                        "--sweep) in ONE compiled batched dispatch "
                         "instead of B serialized runs; per-member "
                         "summaries (max|u|, mass drift) and member-"
-                        "attributed divergence ride the batch. Slab-rung "
-                        "pins and --mesh decline loudly (README "
-                        "'Ensemble engine'; 0 = off)")
+                        "attributed divergence ride the batch. Composes "
+                        "with --mesh through a 'members' axis (--mesh "
+                        "members=8, or members=4,dz=2 for the members x "
+                        "z-slab composition — one dispatch serves B x P "
+                        "users); uniform-physics ensembles fold B into "
+                        "the whole-run slab rung's Pallas grid where it "
+                        "engages. A purely spatial --mesh still declines "
+                        "loudly (README 'Ensemble engine'; 0 = off)")
     p.add_argument("--sweep", action="append", default=[],
                    metavar="NAME=a:b",
                    help="member-varying parameter for --ensemble B: "

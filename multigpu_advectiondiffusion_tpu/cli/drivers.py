@@ -127,14 +127,40 @@ def build_ensemble_members(sweeps, members: int, aliases=None):
     return out
 
 
+def parse_ensemble_mesh(mesh_spec, grid):
+    """``--mesh members=8`` / ``members=4,dz=2`` -> ``(mesh,
+    spatial_decomp)`` for the batched ensemble engine. The member axis
+    shards the batched state's leading axis (halo-free); remaining
+    axes map to grid axes like any spatial mesh. A spec WITHOUT a
+    members axis declines loudly — a purely spatial mesh shards one
+    member's grid."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import MEMBER_AXIS
+
+    if not mesh_spec:
+        return None, None
+    mesh, sizes = parse_mesh_spec(mesh_spec)
+    if MEMBER_AXIS not in sizes:
+        raise ValueError(
+            "--ensemble composes with --mesh through a 'members' axis "
+            "(e.g. --mesh members=8 or --mesh members=4,dz=2); a "
+            "purely spatial mesh shards one member's grid — drop "
+            "--mesh or add the members axis"
+        )
+    spatial = {k: v for k, v in sizes.items() if k != MEMBER_AXIS}
+    decomp = decomposition_for(grid, spatial) if spatial else None
+    return mesh, decomp
+
+
 def run_ensemble_solver(solver_cls, cfg, name: str, args, aliases=None):
     """The batched-ensemble CLI driver (``--ensemble B [--sweep ...]``):
-    ONE vmapped dispatch advances all B members; per-member summaries
+    ONE batched dispatch advances all B members; per-member summaries
     (max|u|, mass drift) and member-attributed divergence come out of
-    the batch (models/ensemble.py). Supervision machinery that rolls
-    state back (checkpoints, SDC guard, diagnostics cadence) stays
-    single-run; ``--sentinel-every`` is served as a chunked per-member
-    health probe."""
+    the batch (models/ensemble.py). ``--mesh members=P[,dz=Q]``
+    composes: the member axis shards over the device mesh (optionally
+    x a z-slab spatial subgroup), so one dispatch serves B x P users.
+    Supervision machinery that rolls state back (checkpoints, SDC
+    guard, diagnostics cadence) stays single-run; ``--sentinel-every``
+    is served as a chunked per-member health probe."""
     import time as _time
 
     import jax
@@ -146,7 +172,6 @@ def run_ensemble_solver(solver_cls, cfg, name: str, args, aliases=None):
 
     B = int(args.ensemble)
     unsupported = {
-        "--mesh": getattr(args, "mesh", None),
         "--coordinator": getattr(args, "coordinator", None),
         "--resume": getattr(args, "resume", None),
         "--checkpoint-every": getattr(args, "checkpoint_every", 0),
@@ -165,7 +190,11 @@ def run_ensemble_solver(solver_cls, cfg, name: str, args, aliases=None):
             "individually"
         )
     members = build_ensemble_members(args.sweep, B, aliases=aliases)
-    es = EnsembleSolver(solver_cls, cfg, members)
+    mesh, spatial_decomp = parse_ensemble_mesh(
+        getattr(args, "mesh", None), cfg.grid
+    )
+    es = EnsembleSolver(solver_cls, cfg, members, mesh=mesh,
+                        decomp=spatial_decomp)
     estate = es.initial_state()
     iters = args.iters
     if iters is None and args.t_end is None:
@@ -231,6 +260,9 @@ def run_ensemble_solver(solver_cls, cfg, name: str, args, aliases=None):
             "seconds": round(seconds, 6),
             "compile_seconds": round(compile_s, 4),
             "mlups_members": round(rate, 2),
+            "devices": engaged.get("devices", 1),
+            "member_sharding": engaged.get("member_sharding", 1),
+            "mesh": engaged.get("mesh"),
             "engaged": engaged,
             "members": summaries,
         }
@@ -242,9 +274,15 @@ def run_ensemble_solver(solver_cls, cfg, name: str, args, aliases=None):
             )
 
     if jax.process_index() == 0:
+        placement = ""
+        if engaged.get("devices", 1) > 1:
+            placement = (
+                f", {engaged['member_sharding']}-way member sharding "
+                f"over {engaged['devices']} devices"
+            )
         print(f"-- {name} ensemble: B={B} members, {work} iters, "
               f"{seconds:.4f}s, {rate:,.1f} MLUPS*members "
-              f"({engaged['stepper']})")
+              f"({engaged['stepper']}{placement})")
         for row in summaries:
             drift = row.get("mass_drift")
             print(

@@ -874,25 +874,43 @@ class SolverBase:
         """Loud eligibility gate for batched dispatch — mirror of the
         impl/steps_per_exchange construction gates: a config the
         ensemble engine cannot serve fails here instead of silently
-        running something else."""
-        if self.mesh is not None:
+        running something else. Since the mesh-scale round (ROADMAP
+        item 1) device meshes and the slab rung are ADMITTED: a mesh
+        composes through a ``members`` axis (members-sharded, optionally
+        x a z-slab spatial subgroup) and uniform-physics ensembles fold
+        B into the slab rung's grid; the declines left below are the
+        genuinely unservable configs, each with its reason."""
+        emesh = getattr(self, "_ensemble_mesh", None)
+        if self.mesh is not None and emesh is None:
             raise ValueError(
-                "ensemble batching composes members on ONE device; a "
-                "device mesh shards a single member's grid — run the "
-                "ensemble unsharded (members are the parallel axis)"
+                "a purely spatial device mesh shards ONE member's grid; "
+                "ensembles compose with a mesh through a 'members' axis "
+                "— build via EnsembleSolver(..., mesh=make_mesh("
+                "{'members': P}) or {'members': P, 'dz': Q})"
             )
         if int(getattr(self.cfg, "steps_per_exchange", 1) or 1) > 1:
             raise ValueError(
-                "steps_per_exchange > 1 rides the sharded slab rung, "
-                "which declines ensemble batching"
+                "steps_per_exchange > 1 rides the spatially sharded "
+                "slab rung, whose k-step deep-halo schedule does not "
+                "fold a member axis — run ensembles at the per-step "
+                "exchange cadence"
             )
         if getattr(self.cfg, "impl", "xla") == "pallas_slab":
-            raise ValueError(
-                "the slab-pipelined whole-run rung declines ensemble "
-                "batching (its (timestep x z-slab) grid does not fold a "
-                "member axis); pin impl='pallas_stage' or let "
-                "impl='pallas' take the per-stage rung"
-            )
+            if self.mesh is not None:
+                raise ValueError(
+                    "the B-folded slab grid serves unsharded-spatial "
+                    "instances only (members-only meshes run one fold "
+                    "per device); a spatial z-slab x slab-rung ensemble "
+                    "remains unservable — its per-step ghost refresh "
+                    "cannot cross the member fold"
+                )
+            if operand_names:
+                raise ValueError(
+                    "the B-folded slab grid bakes uniform physics "
+                    "(fixed dt, closure coefficients); member-varying "
+                    f"operand(s) {sorted(operand_names)} ride the "
+                    "generic rung — drop the impl='pallas_slab' pin"
+                )
         supported = set(self.ensemble_operands())
         unknown = sorted(set(operand_names) - supported)
         if unknown:
@@ -902,23 +920,104 @@ class SolverBase:
             )
 
     def _ensemble_fused(self):
-        """The fused stepper the batched dispatch may ``vmap``, or
-        ``None`` (generic vmapped loop). Only the per-stage rung is
-        served: the slab rung's temporal blocking does not fold a
-        member axis (declined via the ``_decline`` choke point — the
-        ``"t_end"`` selection already skips it), and the 2-D whole-run
-        steppers' in-core padding is unproven under batching."""
-        fused = self._fused_stepper(mode="t_end")
+        """The fused stepper the batched dispatch may ride, or ``None``
+        (generic vmapped loop). Two fused shapes are served: the
+        per-stage rung under ``jax.vmap``, and — new this round — the
+        whole-run slab rung with B FOLDED into its Pallas grid
+        (``fused_slab_run.run_batched``: a leading member grid axis,
+        one program for the whole batched run). Spatially sharded fused
+        steppers decline (their ghost refresh does not fold a member
+        axis); the 2-D whole-run steppers' in-core padding stays
+        unproven under batching."""
+        fused = self._fused_stepper(mode="iters")
         if fused is None:
             return None
-        if fused.engaged_label != "fused-stage" or getattr(
-            fused, "sharded", False
-        ):
+        if getattr(fused, "sharded", False):
             return self._decline(
-                f"ensemble vmap serves the fused-stage rung only; "
-                f"{fused.engaged_label} declines batching"
+                "spatially sharded fused steppers decline the member "
+                "axis (ghost refresh cannot cross the fold); the "
+                "generic rung serves members x spatial meshes"
             )
-        return fused
+        if fused.engaged_label in ("fused-stage", "fused-whole-run-slab"):
+            return fused
+        return self._decline(
+            f"ensemble batching serves the fused-stage (vmap) and "
+            f"whole-run-slab (B-fold) rungs; {fused.engaged_label} "
+            f"declines batching"
+        )
+
+    # -- ensemble mesh plumbing (set by models/ensemble.EnsembleSolver:
+    # the full device mesh whose 'members' axis shards the batched
+    # state's leading axis; None = single-device batching) ----------- #
+    _ensemble_mesh = None
+    _ensemble_spatial = None  # spatial Decomposition (grid axes only)
+
+    def arm_ensemble_mesh(self, mesh, spatial_decomp) -> None:
+        """Attach the members(-x-spatial) mesh the batched dispatch
+        wraps its programs over. ``spatial_decomp`` maps GRID axes to
+        the mesh's non-member axes (None = members-only sharding); the
+        member axis itself is halo-free by construction and never
+        appears in it (verified statically by
+        ``analysis/halo_verify.verify_member_mesh``)."""
+        from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+            MEMBER_AXIS,
+        )
+
+        if mesh is not None and MEMBER_AXIS not in dict(mesh.shape):
+            raise ValueError(
+                "an ensemble mesh needs a 'members' axis"
+            )
+        self._ensemble_mesh = mesh
+        self._ensemble_spatial = spatial_decomp
+
+    def _ensemble_specs(self):
+        """``(state_spec, member_spec)`` PartitionSpecs of the batched
+        ``(B, *grid)`` state and the per-member ``(B,)`` scalars under
+        the armed ensemble mesh (``None`` when unmeshed)."""
+        if self._ensemble_mesh is None:
+            return None
+        from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+            MEMBER_AXIS,
+        )
+
+        ndim = self.grid.ndim
+        spatial = [None] * ndim
+        if self._ensemble_spatial is not None:
+            mapping = self._ensemble_spatial.mapping
+            spatial = [mapping.get(ax) for ax in range(ndim)]
+        return P(MEMBER_AXIS, *spatial), P(MEMBER_AXIS)
+
+    def _ensemble_wrap(self, fn, n_in_scalars: int, n_out_scalars: int,
+                       n_in_global: int = 0):
+        """Jit a batched block ``(us, *member_scalars, *globals) ->
+        (us, *member_scalars)``. Under the armed ensemble mesh the
+        block runs inside ``shard_map``: the state follows
+        ``(members, *spatial)``, per-member operands follow the member
+        axis, trailing globals (t_end) replicate. ``check=False``
+        throughout — the bodies host vmapped while/fori loops and
+        Pallas calls, neither of which carries vma typing."""
+        specs = self._ensemble_specs()
+        if specs is None:
+            return jax.jit(fn)
+        uspec, mspec = specs
+        return jax.jit(
+            shard_map(
+                fn,
+                mesh=self._ensemble_mesh,
+                in_specs=(uspec,) + (mspec,) * n_in_scalars
+                + (P(),) * n_in_global,
+                out_specs=(uspec,) + (mspec,) * n_out_scalars,
+                check=False,
+            )
+        )
+
+    def _ensemble_mesh_token(self):
+        """Dispatch-cache/AOT key component naming the ensemble mesh
+        layout (device placement changes the compiled executable)."""
+        emesh = self._ensemble_mesh
+        if emesh is None:
+            return None
+        return ",".join(f"{n}:{s}" for n, s in emesh.shape.items())
 
     def _ensemble_pack(self, operands, members: int):
         """Normalize ``{name: (B,)-array}`` member-varying operands to
@@ -944,19 +1043,34 @@ class SolverBase:
         """Record + emit the dispatch facts (``ensemble:dispatch``
         events; ``engaged`` provenance for bench rows and the CLI
         summary — the reference's PrintSummary discipline applied to
-        the batched engine)."""
+        the batched engine). Carries the mesh placement since the
+        mesh-scale round: ``devices`` (total devices the dispatch
+        spans) and ``member_sharding`` (member-axis shard count), so a
+        batched row that silently fell back to one device is visible
+        in the stream (and failed by the bench engagement guard)."""
+        from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+            member_extent,
+        )
+
+        emesh = self._ensemble_mesh
+        devices = 1 if emesh is None else int(emesh.devices.size)
+        msh = member_extent(emesh)
         self._ensemble_last = {
             "members": int(members),
             "stepper": stepper,
             "mode": mode,
             "operands": list(names),
+            "devices": devices,
+            "member_sharding": msh,
+            "mesh": self._ensemble_mesh_token(),
         }
         from multigpu_advectiondiffusion_tpu import telemetry
 
         telemetry.event(
             "ensemble", "dispatch",
             members=int(members), stepper=stepper, mode=mode,
-            operands=list(names),
+            operands=list(names), devices=devices, member_sharding=msh,
+            mesh=self._ensemble_mesh_token(),
         )
 
     def run_ensemble(self, estate: EnsembleState, num_iters: int,
@@ -973,14 +1087,36 @@ class SolverBase:
         names, ops = self._ensemble_pack(operands, B)
         self._ensemble_gate(names)
         fused = self._ensemble_fused() if not names else None
-        label = (
-            f"ensemble-vmap[{fused.engaged_label}]"
-            if fused is not None
-            else "ensemble-vmap[generic-xla]"
+        mtok = self._ensemble_mesh_token()
+        slab_fold = (
+            fused is not None
+            and fused.engaged_label == "fused-whole-run-slab"
         )
+        if slab_fold:
+            label = "ensemble-fold[fused-whole-run-slab]"
+        elif fused is not None:
+            label = f"ensemble-vmap[{fused.engaged_label}]"
+        else:
+            label = "ensemble-vmap[generic-xla]"
         self._ensemble_record(B, label, "iters", names)
         with self._dispatch_span("run_ensemble", mode="t_end",
                                  iters=int(num_iters), members=B):
+            if slab_fold:
+                # B folded into the slab rung's Pallas grid: ONE
+                # whole-run program per device advances its members
+                # (under a members-only mesh each device runs the fold
+                # over its own member shard)
+                def block(us, ts):
+                    return fused.run_batched(us, ts, num_iters)
+
+                f = self._compiled(
+                    ("ens_slab_run", num_iters, B, mtok),
+                    lambda: self._ensemble_wrap(block, 1, 1),
+                    steps=int(num_iters),
+                )
+                u, t = f(estate.u, estate.t)
+                return EnsembleState(u=u, t=t, it=estate.it + num_iters)
+
             if fused is not None:
                 def block(us, ts):
                     return jax.vmap(
@@ -988,8 +1124,9 @@ class SolverBase:
                     )(us, ts)
 
                 f = self._compiled(
-                    ("ens_fused_run", num_iters, B),
-                    lambda: jax.jit(block), steps=int(num_iters),
+                    ("ens_fused_run", num_iters, B, mtok),
+                    lambda: self._ensemble_wrap(block, 1, 1),
+                    steps=int(num_iters),
                 )
                 u, t = f(estate.u, estate.t)
                 return EnsembleState(u=u, t=t, it=estate.it + num_iters)
@@ -1006,22 +1143,34 @@ class SolverBase:
                 return jax.vmap(member)(us, ts, ps)
 
             f = self._compiled(
-                ("ens_run", num_iters, B, names),
-                lambda: jax.jit(block), steps=int(num_iters),
+                ("ens_run", num_iters, B, names, mtok),
+                lambda: self._ensemble_wrap(block, 2, 1),
+                steps=int(num_iters),
             )
             u, t = f(estate.u, estate.t, ops)
             return EnsembleState(u=u, t=t, it=estate.it + num_iters)
 
     def advance_to_ensemble(self, estate: EnsembleState, t_end: float,
-                            operands=None) -> EnsembleState:
+                            operands=None,
+                            max_steps: int | None = None) -> EnsembleState:
         """March every member to ``t_end`` in one dispatch (vmapped
         while-loop; finished members freeze while stragglers — e.g.
         smaller member dt — keep stepping). Generic rung only: the
         fused ``run_to`` loops host their own scalar plumbing that the
-        member axis does not fold."""
+        member axis does not fold.
+
+        ``max_steps`` switches the data-dependent ``while_loop`` for a
+        bounded ``fori_loop`` whose finished members freeze via masked
+        updates — semantically identical when ``max_steps`` covers the
+        longest member trajectory, and REVERSE-MODE DIFFERENTIABLE
+        (``jax.grad`` through a dynamic-trip ``while_loop`` is
+        undefined): the gradient-based inverse-problem path
+        (``examples/inverse_diffusivity.py``) differentiates through
+        this dispatch with respect to the member operands."""
         B = estate.members
         names, ops = self._ensemble_pack(operands, B)
         self._ensemble_gate(names)
+        mtok = self._ensemble_mesh_token()
         self._ensemble_record(B, "ensemble-vmap[generic-xla]", "t_end",
                               names)
         with self._dispatch_span("advance_to_ensemble", mode="t_end",
@@ -1029,6 +1178,23 @@ class SolverBase:
             def member(u, t, p, te):
                 ov = {n: p[i] for i, n in enumerate(names)} or None
                 eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+
+                if max_steps is not None:
+                    def fbody(i, c):
+                        u, t, it = c
+                        u2, t2 = self._local_step(u, t, t_end=te,
+                                                  overrides=ov)
+                        live = t < te - eps
+                        return (
+                            jnp.where(live, u2, u),
+                            jnp.where(live, t2, t),
+                            it + live.astype(jnp.int32),
+                        )
+
+                    return lax.fori_loop(
+                        0, int(max_steps), fbody,
+                        (u, t, jnp.zeros((), jnp.int32)),
+                    )
 
                 def cond(c):
                     return c[1] < te - eps
@@ -1048,7 +1214,8 @@ class SolverBase:
                 )
 
             f = self._compiled(
-                ("ens_adv", B, names), lambda: jax.jit(block)
+                ("ens_adv", B, names, mtok, max_steps),
+                lambda: self._ensemble_wrap(block, 2, 2, n_in_global=1),
             )
             u, t, steps = f(
                 estate.u, estate.t, ops,

@@ -14,9 +14,17 @@ dispatch through ``SolverBase.run_ensemble`` / ``advance_to_ensemble``:
   (tests/test_ensemble.py);
 * scalar sweeps ride the generic stepper with the member scalars as
   batched operands (never closure constants);
-* the slab whole-run rung declines batching loudly (its
-  (timestep x z-slab) grid does not fold a member axis), as does a
-  device mesh — members, not shards, are the parallel axis here.
+* uniform-physics ensembles additionally fold B into the slab
+  whole-run rung's Pallas grid (``fused_slab_run.run_batched``: a
+  leading member grid axis — one program advances the whole batch);
+* a device mesh composes through a ``members`` axis (the TPU-pod
+  batched-simulation shape of arXiv 2108.11076): members-sharded-only
+  meshes (``make_mesh({'members': P})``) run one batched program per
+  device, members x z-slab meshes (``{'members': P, 'dz': Q}``) vmap
+  the shard-local stepper with the existing halo exchange running per
+  spatial subgroup — one dispatch serves B x P users. Remaining
+  declines (spatial-only meshes, k > 1 deep-halo cadence, slab pins
+  over spatial subgroups) raise loudly with their reason.
 
 Divergence stays member-attributed: the sentinel reduces per member
 (``resilience/sentinel.make_ensemble_probe``), so one blown-up member
@@ -80,26 +88,59 @@ class EnsembleSolver:
     ``ic_params``)."""
 
     def __init__(self, solver_cls, cfg, members, mesh=None, decomp=None):
-        if mesh is not None or decomp is not None:
-            raise ValueError(
-                "ensemble batching composes members on one device; a "
-                "mesh shards a single member's grid — drop --mesh for "
-                "--ensemble runs"
-            )
+        from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+            MEMBER_AXIS,
+            axis_extent,
+            member_extent,
+        )
+
+        spatial_decomp = None
+        if mesh is not None:
+            sizes = dict(mesh.shape)
+            if MEMBER_AXIS not in sizes:
+                raise ValueError(
+                    "an ensemble mesh composes through a 'members' "
+                    "axis (members, not shards, are the batched "
+                    "parallel dimension) — e.g. make_mesh({'members': "
+                    "8}) or make_mesh({'members': 4, 'dz': 2}); a "
+                    "purely spatial mesh shards one member's grid"
+                )
+            spatial_decomp = decomp
+            if spatial_decomp is not None and MEMBER_AXIS in (
+                spatial_decomp.mesh_axis_names()
+            ):
+                raise ValueError(
+                    "the 'members' mesh axis may not shard a grid "
+                    "axis — member sharding is halo-free by "
+                    "construction (it partitions the batched state's "
+                    "leading member axis only)"
+                )
+        elif decomp is not None:
+            raise ValueError("a decomposition needs a mesh")
         if isinstance(members, int):
             if members < 1:
                 raise ValueError("an ensemble needs at least one member")
             members = [{} for _ in range(members)]
         self._overrides = [dict(m) for m in members]
         self.members = len(self._overrides)
+        mext = member_extent(mesh)
+        if self.members % mext:
+            raise ValueError(
+                f"{self.members} members do not tile the {mext}-way "
+                "member axis — B must be a multiple of the member-"
+                "sharding extent"
+            )
         if cfg.impl == "auto":
-            # measured dispatch, keyed BY the ensemble dimension: a
-            # B=64 decision is never served to a B=1 run (and vice
-            # versa) — tuning/autotuner.make_key carries ens=B
+            # measured dispatch, keyed BY the ensemble dimension AND
+            # the mesh layout: the tuner MEASURES the batched candidate
+            # space at the actual B (generic vmap / fused-stage vmap /
+            # B-folded slab, under this mesh) instead of keying a
+            # single-run proxy by ens=B — tuning/autotuner.autotune
             from multigpu_advectiondiffusion_tpu import tuning
 
             decision = tuning.resolve(
-                solver_cls, cfg, None, None, ensemble=self.members
+                solver_cls, cfg, mesh, spatial_decomp,
+                ensemble=self.members,
             )
             self._tuned = decision
             cfg = dataclasses.replace(cfg, impl=decision["impl"])
@@ -107,7 +148,24 @@ class EnsembleSolver:
             self._tuned = None
         self.solver_cls = solver_cls
         self.cfg = cfg
-        self.solver = solver_cls(cfg)  # the template every member shares
+        self.mesh = mesh
+        self._spatial_decomp = spatial_decomp
+        # the template every member shares: spatially sharded only when
+        # a spatial subgroup actually decomposes the grid (extent > 1) —
+        # its shard-local program then runs per member under the vmap
+        spatial = spatial_decomp is not None and any(
+            axis_extent(dict(mesh.shape), nm) > 1
+            for _, nm in spatial_decomp.axes
+        )
+        self.solver = solver_cls(
+            cfg,
+            mesh=mesh if spatial else None,
+            decomp=spatial_decomp if spatial else None,
+        )
+        if mesh is not None:
+            self.solver.arm_ensemble_mesh(
+                mesh, spatial_decomp if spatial else None
+            )
         supported = set(self.solver.ensemble_operands())
         for i, ov in enumerate(self._overrides):
             unknown = sorted(set(ov) - supported - set(_IC_KEYS))
@@ -156,6 +214,20 @@ class EnsembleSolver:
             for i in range(self.members)
         ]
         est = EnsembleState.stack(states)
+        if self.mesh is not None:
+            # place the batched state on the ensemble sharding: member
+            # axis over 'members', grid axes over the spatial subgroup
+            import jax
+            from jax.sharding import NamedSharding
+
+            uspec, mspec = self.solver._ensemble_specs()
+            est = EnsembleState(
+                u=jax.device_put(est.u, NamedSharding(self.mesh, uspec)),
+                t=jax.device_put(est.t, NamedSharding(self.mesh, mspec)),
+                it=jax.device_put(
+                    est.it, NamedSharding(self.mesh, mspec)
+                ),
+            )
         self.arm(est)
         return est
 
@@ -181,16 +253,21 @@ class EnsembleSolver:
             estate, num_iters, operands=self.operands()
         )
 
-    def advance_to(self, estate: EnsembleState,
-                   t_end: float) -> EnsembleState:
+    def advance_to(self, estate: EnsembleState, t_end: float,
+                   max_steps: Optional[int] = None) -> EnsembleState:
         return self.solver.advance_to_ensemble(
-            estate, t_end, operands=self.operands()
+            estate, t_end, operands=self.operands(),
+            max_steps=max_steps,
         )
 
     def engaged_path(self) -> dict:
         """Batched-dispatch provenance: the inner stepper the vmap
         wraps, the member count, and (``impl='auto'``) the tuner
         decision — the bench rows' engagement-guard surface."""
+        from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+            member_extent,
+        )
+
         last = getattr(self.solver, "_ensemble_last", None) or {}
         out = {
             "impl": getattr(self.solver, "_requested_impl", self.cfg.impl),
@@ -198,6 +275,18 @@ class EnsembleSolver:
             "ensemble": self.members,
             "operands": last.get("operands", []),
             "fallback": getattr(self.solver, "_fused_fallback", None),
+            # mesh placement provenance: a batched row that silently
+            # fell back to one device is visible (and bench-guarded)
+            "devices": last.get(
+                "devices",
+                1 if self.mesh is None else int(self.mesh.devices.size),
+            ),
+            "member_sharding": last.get(
+                "member_sharding", member_extent(self.mesh)
+            ),
+            "mesh": last.get(
+                "mesh", self.solver._ensemble_mesh_token()
+            ),
         }
         if self._tuned is not None:
             out["tuned"] = {

@@ -148,16 +148,32 @@ def _cross_ok(bz: int, G: int, n_slabs: int) -> bool:
 
 
 def _whole_run_kernel(s_in, ss, vs, res, sem_v, sem_w, *, step_fn, bz: int,
-                      G: int, n_slabs: int, n_iters: int, cross: bool):
+                      G: int, n_slabs: int, n_iters: int, cross: bool,
+                      batched: bool = False):
     """(timestep, z-slab) grid body; ``ss`` is the stacked (2, pz, Y, X)
     state (output aliased onto the input — all access goes through the
     out ref). ``step_fn(v, j) -> (bz, Y, X)`` fuses the three RK stages
-    of slab ``j`` on the ``(bz + 2G)``-row VMEM box ``v``."""
+    of slab ``j`` on the ``(bz + 2G)``-row VMEM box ``v``.
+
+    ``batched``: the B-folded ensemble variant — the grid gains a
+    LEADING member axis (``(B, timestep, z-slab)``), ``ss`` a leading
+    member dimension (``(B, 2, pz, Y, X)``), and every DMA indexes the
+    current member's stack. The sequential TPU grid finishes member
+    ``m`` (including the end-of-member write drain at ``i == total-1``)
+    before ``m+1`` starts, and no copy ever addresses another member's
+    rows — the member axis is halo-free by construction (statically
+    proven by ``analysis/halo_verify``)."""
     del s_in  # aliased with ss
     # canonical i32 indices: interpret mode under x64 hands the two grid
     # dimensions different integer widths
-    k = jnp.asarray(pl.program_id(0), jnp.int32)
-    j = jnp.asarray(pl.program_id(1), jnp.int32)
+    if batched:
+        m = jnp.asarray(pl.program_id(0), jnp.int32)
+        k = jnp.asarray(pl.program_id(1), jnp.int32)
+        j = jnp.asarray(pl.program_id(2), jnp.int32)
+    else:
+        m = None
+        k = jnp.asarray(pl.program_id(0), jnp.int32)
+        j = jnp.asarray(pl.program_id(1), jnp.int32)
     n = jnp.asarray(n_slabs, jnp.int32)
     two = jnp.asarray(2, jnp.int32)
     i = k * n + j
@@ -165,11 +181,15 @@ def _whole_run_kernel(s_in, ss, vs, res, sem_v, sem_w, *, step_fn, bz: int,
     slot = lax.rem(i, two)
     nslot = lax.rem(i + 1, two)
 
+    def _stack(parity):
+        # the (2, pz, Y, X) ping-pong stack of the current member
+        return ss.at[m, parity] if batched else ss.at[parity]
+
     def copy_in(kk, jj, s):
         kk = jnp.asarray(kk, jnp.int32)  # literal 0s stay i32 under x64
         jj = jnp.asarray(jj, jnp.int32)
         return pltpu.make_async_copy(
-            ss.at[lax.rem(kk, two), pl.ds(jj * bz, bz + 2 * G)],
+            _stack(lax.rem(kk, two)).at[pl.ds(jj * bz, bz + 2 * G)],
             vs.at[s],
             sem_v.at[s],
         )
@@ -180,7 +200,7 @@ def _whole_run_kernel(s_in, ss, vs, res, sem_v, sem_w, *, step_fn, bz: int,
         jj = lax.rem(ii, n)
         return pltpu.make_async_copy(
             res.at[s],
-            ss.at[1 - lax.rem(kk, two), pl.ds(G + jj * bz, bz)],
+            _stack(1 - lax.rem(kk, two)).at[pl.ds(G + jj * bz, bz)],
             sem_w.at[s],
         )
 
@@ -393,6 +413,14 @@ class _SlabRunStepper:
     #: RK stages recompute per ghost refresh, so G = halo = 3 * h
     fused_stages = 3
     stencil_radius = None  # subclasses declare h (R / HALO[order])
+    #: B-folded member grid axis (run_batched): declared member count of
+    #: a batched instance (1 = unbatched). The member axis carries NO
+    #: stencil reach — each member owns its own (2, pz, Y, X) stack and
+    #: no DMA crosses members — so its halo is 0 by construction; the
+    #: static verifier proves the declaration and that a batched
+    #: instance never composes with spatial sharding in one program.
+    members = 1
+    member_halo = 0
 
     def stencil_spec(self) -> dict:
         """Stencil/halo contract of the slab rung (see
@@ -400,7 +428,9 @@ class _SlabRunStepper:
         the fused-step ghost depth ``G = 3h``, the exchange moves
         ``k * G`` rows, and the deep schedule's in-block windows shrink
         by ``G`` per step — all statically provable from these fields
-        plus ``interior_shape``/``padded_shape``/``core_offsets``."""
+        plus ``interior_shape``/``padded_shape``/``core_offsets``.
+        ``members``/``member_halo`` declare the B-folded leading member
+        grid axis (halo-free; ``run_batched``)."""
         return {
             "kernel": self.engaged_label,
             "stage_radius": int(self.stencil_radius),
@@ -408,7 +438,26 @@ class _SlabRunStepper:
             "ghost_depth": int(self.halo),
             "exchange_depth": int(self.exchange_depth),
             "steps_per_exchange": int(self.steps_per_exchange),
+            "members": int(self.members),
+            "member_halo": int(self.member_halo),
         }
+
+    def _check_members(self, members: int) -> int:
+        """Validate a declared member fold: the batched grid serves
+        unsharded (single-chip or member-sharded) instances only — a
+        spatially sharded instance runs per-step calls whose ghost
+        refresh the member fold cannot cross."""
+        members = int(members)
+        if members < 1:
+            raise ValueError(f"members must be >= 1, got {members}")
+        if members > 1 and self.sharded:
+            raise ValueError(
+                "the B-folded slab grid composes with member sharding "
+                "only; a spatially sharded slab instance cannot fold a "
+                "member axis (its per-step ghost refresh would have to "
+                "cross the fold)"
+            )
+        return members
 
     # populated by subclass __init__:
     #   interior_shape, global_shape, sharded, overlap_split, halo (=G),
@@ -450,6 +499,53 @@ class _SlabRunStepper:
             interpret=interpret_mode(),
         )(SS)
         return out[num_iters % 2]
+
+    def run_batched(self, us, ts, num_iters: int):
+        """Advance B independent members ``num_iters`` fused steps in
+        ONE Pallas program: the ``(timestep, z-slab)`` grid gains a
+        LEADING member axis — grid ``(B, num_iters, n_slabs)``, stacked
+        state ``(B, 2, pz, Y, X)``. The sequential TPU grid streams one
+        member's whole run, drains its writes, then starts the next;
+        scratch (the double-buffered slab/result slots) is shared
+        because members never overlap in time. The member axis carries
+        no stencil reach — uniform-physics ensembles ride the fastest
+        rung instead of being declined (ROADMAP item 1). Unsharded
+        instances only (``_check_members``); under a member-sharded
+        mesh each device runs this program over its own members."""
+        if self.sharded:
+            raise ValueError(
+                "run_batched serves unsharded slab instances only "
+                "(member-sharded meshes run one fold per device; "
+                "spatial sharding declines the member fold)"
+            )
+        B = int(us.shape[0])
+        if num_iters == 0:
+            return us, ts
+        G, bz, n_slabs = self.halo, self.bz, self.n_slabs
+        kern = functools.partial(
+            _whole_run_kernel,
+            step_fn=lambda v, j: self._step_fn(v, j * bz - G),
+            bz=bz, G=G, n_slabs=n_slabs, n_iters=num_iters,
+            cross=_cross_ok(bz, G, n_slabs), batched=True,
+        )
+        with jax.named_scope(f"tpucfd.{self.engaged_label}[members]"):
+            S = jax.vmap(self.embed)(us)      # (B, pz, Y, X)
+            SS = jnp.stack([S, S], axis=1)    # (B, 2, pz, Y, X)
+            out = pl.pallas_call(
+                kern,
+                grid=(B, num_iters, n_slabs),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                out_shape=jax.ShapeDtypeStruct(SS.shape, SS.dtype),
+                scratch_shapes=self._scratch(),
+                input_output_aliases={0: 0},
+                compiler_params=(
+                    None if interpret_mode() else compiler_params()
+                ),
+                interpret=interpret_mode(),
+            )(SS)
+            final = jax.vmap(self.extract)(out[:, num_iters % 2])
+        return final, accumulate_t(ts, self.dt, num_iters)
 
     def _make_call(self, z_out0: int, bz: int, n_grid: int, ghost_src=None):
         """One sharded step call writing ``n_grid`` slabs of ``bz`` rows
@@ -766,7 +862,7 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value, block_z=None, global_shape=None,
                  overlap_split: bool = False, storage_dtype=None,
-                 steps_per_exchange: int = 1):
+                 steps_per_exchange: int = 1, members: int = 1):
         nz, ny, nx = interior_shape
         G = _G_DIFF
         self.interior_shape = tuple(interior_shape)
@@ -775,6 +871,7 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
         self.dtype = jnp.dtype(dtype)
         self._storage = jnp.dtype(storage_dtype or dtype)
         self.bc_value = float(bc_value)
+        self.members = self._check_members(members)
         k = _check_steps_per_exchange(steps_per_exchange, self.sharded,
                                       nz, G)
         self.k = self.steps_per_exchange = k
@@ -947,7 +1044,8 @@ class SlabRunBurgersStepper(_SlabRunStepper):
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,
                  variant: str, nu: float, dt: float, block_z=None,
                  global_shape=None, overlap_split: bool = False,
-                 order: int = 5, steps_per_exchange: int = 1):
+                 order: int = 5, steps_per_exchange: int = 1,
+                 members: int = 1):
         if order not in HALO:
             raise ValueError(f"unsupported WENO order {order}")
         if order == 7 and variant != "js":
@@ -963,6 +1061,7 @@ class SlabRunBurgersStepper(_SlabRunStepper):
         self.sharded = self.global_shape != self.interior_shape
         self.dtype = jnp.dtype(dtype)
         self._storage = self.dtype
+        self.members = self._check_members(members)
         k = _check_steps_per_exchange(steps_per_exchange, self.sharded,
                                       nz, G)
         self.k = self.steps_per_exchange = k

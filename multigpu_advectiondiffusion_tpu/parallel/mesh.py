@@ -29,6 +29,22 @@ _CHECK_KWARG = (
     else "check_rep"
 )
 
+# Reserved mesh-axis name for the batched ensemble engine's member
+# dimension (ROADMAP item 1: members x devices). The member axis shards
+# the LEADING axis of a (B, *grid) batched state — members are
+# embarrassingly parallel, so the axis is halo-free by construction and
+# never appears in a spatial Decomposition (statically proven by
+# analysis/halo_verify.verify_member_mesh).
+MEMBER_AXIS = "members"
+
+
+def member_extent(mesh) -> int:
+    """Shard count of the ensemble member axis (1 when the mesh is
+    ``None`` or carries no ``members`` axis)."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(MEMBER_AXIS, 1))
+
 
 def shard_map(*args, check: bool = True, **kwargs):
     """Project ``shard_map``. ``check=False`` disables the

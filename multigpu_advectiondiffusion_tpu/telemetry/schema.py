@@ -82,8 +82,13 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     },
     # batched ensemble engine (models/base.run_ensemble /
     # advance_to_ensemble): one event per batched dispatch, carrying
-    # the member count and the vmapped inner stepper
-    "ensemble": {"dispatch": {"members", "stepper"}},
+    # the member count, the inner stepper (vmapped or B-folded), and —
+    # since the mesh-scale round — the device placement (devices,
+    # member_sharding, mesh), so a batched dispatch that silently fell
+    # back to one device is visible in the stream
+    "ensemble": {
+        "dispatch": {"members", "stepper", "devices", "member_sharding"},
+    },
     # persistent AOT executable cache (tuning/aot_cache.py): every
     # lookup is a hit or a (reasoned) miss, every write a store —
     # out/ensemble_gate.sh gates the warm-run hit on these

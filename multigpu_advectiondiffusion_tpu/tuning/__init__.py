@@ -30,8 +30,10 @@ from multigpu_advectiondiffusion_tpu.tuning import autotuner  # noqa: F401
 from multigpu_advectiondiffusion_tpu.tuning.autotuner import (  # noqa: F401
     autotune,
     candidates,
+    ensemble_candidates,
     make_key,
     measure_candidate,
+    measure_ensemble_candidate,
     modeled_step_seconds,
 )
 from multigpu_advectiondiffusion_tpu.tuning.cache import (  # noqa: F401
@@ -46,8 +48,10 @@ __all__ = [
     "candidates",
     "configure",
     "default_path",
+    "ensemble_candidates",
     "make_key",
     "measure_candidate",
+    "measure_ensemble_candidate",
     "modeled_step_seconds",
     "resolve",
     "tuning_enabled",

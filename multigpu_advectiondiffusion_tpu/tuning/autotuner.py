@@ -64,6 +64,9 @@ def _mesh_tokens(mesh, decomp):
     if mesh is None:
         return "mesh=1"
     sizes = ",".join(f"{n}:{s}" for n, s in mesh.shape.items())
+    if decomp is None:
+        # members-only ensemble meshes carry no spatial decomposition
+        return f"mesh={sizes};decomp=-"
     axes = ",".join(
         f"{ax}:{'|'.join(nm) if isinstance(nm, tuple) else nm}"
         for ax, nm in decomp.axes
@@ -243,29 +246,104 @@ def measure_candidate(solver_cls, cfg, mesh, decomp, cand,
     }
 
 
+def ensemble_candidates(solver_cls, cfg, mesh, decomp,
+                        members: int) -> list:
+    """The rung space the batched engine serves at ``B = members``,
+    asked from the dispatch's own eligibility gates (the tuner never
+    re-implements them): the generic vmapped rung always serves; the
+    fused-stage vmap and the B-folded slab rung serve where an
+    unsharded-spatial probe engages them. A members x spatial mesh
+    (``decomp`` with real extents) serves the generic rung only —
+    spatially sharded fused steppers decline the member axis."""
+    out = [{"impl": "xla", "steps_per_exchange": 1}]
+    if decomp is not None and bool(decomp.axes):
+        return out
+    for impl, label in (
+        ("pallas_stage", "fused-stage"),
+        ("pallas_slab", "fused-whole-run-slab"),
+    ):
+        try:
+            probe = solver_cls(
+                dataclasses.replace(cfg, impl=impl, steps_per_exchange=1)
+            )
+            fused = probe._fused_stepper()
+        except ValueError:
+            continue
+        if fused is not None and fused.engaged_label == label:
+            out.append({"impl": impl, "steps_per_exchange": 1})
+    return out
+
+
+def measure_ensemble_candidate(solver_cls, cfg, mesh, decomp, cand,
+                               members: int, iters: int,
+                               reps: int) -> dict:
+    """Median-of-reps MLUPS*members of one candidate MEASURED AT THE
+    ACTUAL B — one wall-timed batched dispatch (launch overhead
+    included: amortizing it is the point), B identical uniform-physics
+    members, under the caller's mesh."""
+    import statistics
+    import time as _time
+
+    from multigpu_advectiondiffusion_tpu.bench.timing import sync
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    es = EnsembleSolver(
+        solver_cls,
+        dataclasses.replace(
+            cfg, impl=cand["impl"], steps_per_exchange=1
+        ),
+        members, mesh=mesh, decomp=decomp,
+    )
+    est = es.initial_state()
+    sync(es.run(est, iters).u)  # compile + warm-up, untimed
+    times = []
+    for _ in range(max(1, reps)):
+        t0 = _time.perf_counter()
+        sync(es.run(est, iters).u)
+        times.append(_time.perf_counter() - t0)
+    med = statistics.median(times)
+    rate = mlups(
+        cfg.grid.num_cells * members, iters, STAGES[cfg.integrator], med
+    )
+    return {
+        "mlups": round(rate, 2),
+        "seconds": round(med, 6),
+        "spread": round(
+            (max(times) - min(times)) / med if med > 0 else 0.0, 4
+        ),
+        "engaged": es.engaged_path()["stepper"],
+    }
+
+
 def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
              iters: int, reps: int, prune_ratio: float,
              ensemble: int = 1) -> dict:
     """Measure the pruned candidate space and persist the winner.
-    ``ensemble > 1`` restricts the space to the rungs the batched
-    engine serves (the slab rung and the k-schedule decline member
-    batching) — measurement stays single-run, the per-member proxy."""
+    ``ensemble > 1`` measures the BATCHED candidate space at the
+    actual B (generic vmap / fused-stage vmap / B-folded slab, under
+    the caller's members mesh) — no single-run proxy; every
+    ``tune:measure`` row carries the member count."""
     import jax
 
     backend = jax.default_backend()
     devices = 1 if mesh is None else mesh.devices.size
+    if ensemble > 1:
+        return _autotune_ensemble(
+            solver_cls, cfg, mesh, decomp, cache, key, iters, reps,
+            ensemble, backend, devices,
+        )
     lshape = (
         cfg.grid.shape
         if mesh is None
         else decomp.local_shape(mesh, cfg.grid.shape)
     )
     cands = candidates(solver_cls, cfg, mesh, decomp)
-    if ensemble > 1:
-        cands = [
-            c for c in cands
-            if c["impl"] != "pallas_slab"
-            and c["steps_per_exchange"] == 1
-        ] or [{"impl": "pallas_stage", "steps_per_exchange": 1}]
     best_model = None
     for c in cands:
         t = modeled_step_seconds(cfg, lshape, c, devices, backend)
@@ -351,6 +429,87 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
         "decision", key=key, impl=decision["impl"],
         steps_per_exchange=decision["steps_per_exchange"],
         mlups=decision["mlups"], source=decision["source"],
+        cache=cache.path,
+    )
+    return decision
+
+
+def _autotune_ensemble(solver_cls, cfg, mesh, decomp, cache, key,
+                       iters, reps, ensemble, backend, devices):
+    """The batched half of :func:`autotune`: enumerate the rungs the
+    ensemble engine serves, MEASURE each at the actual B under the
+    caller's mesh, persist the winner. The cost model has no batched
+    opinion (its per-step roofline does not price vmap/fold overheads
+    or dispatch amortization), so nothing is pruned — every candidate
+    is raced, and the ``tune:measure`` rows carry B so a published
+    batched decision is auditable from the stream."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        member_extent,
+    )
+
+    B = max(1, int(ensemble))
+    msh = member_extent(mesh)
+    cands = ensemble_candidates(solver_cls, cfg, mesh, decomp, B)
+    _emit(
+        "candidates", key=key, ensemble=B, member_sharding=msh,
+        considered=[
+            {k: c[k] for k in ("impl", "steps_per_exchange")}
+            for c in cands
+        ],
+    )
+    measured = []
+    for c in cands:
+        try:
+            m = measure_ensemble_candidate(
+                solver_cls, cfg, mesh, decomp, c, B, iters, reps
+            )
+        except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+            c["error"] = f"{type(exc).__name__}: {exc}"[:200]
+            _emit("measure", key=key, impl=c["impl"],
+                  steps_per_exchange=c["steps_per_exchange"],
+                  ensemble=B, error=c["error"])
+            continue
+        c.update(m)
+        measured.append(c)
+        _emit("measure", key=key, impl=c["impl"],
+              steps_per_exchange=c["steps_per_exchange"],
+              ensemble=B, member_sharding=msh,
+              mlups=m["mlups"], seconds=m["seconds"],
+              engaged=m["engaged"])
+    if not measured:
+        raise RuntimeError(
+            f"autotune: every batched candidate failed for key {key}"
+        )
+    choice = dict(max(measured, key=lambda c: c["mlups"]))
+    choice["source"] = "measured"
+    decision = {
+        "impl": choice["impl"],
+        "steps_per_exchange": 1,
+        "mlups": choice.get("mlups"),
+        "source": "measured",
+        "backend": backend,
+        "devices": devices,
+        "ensemble": B,
+        "member_sharding": msh,
+        "engaged": choice.get("engaged"),
+        "key": key,
+        "tuner": {"iters": iters, "reps": reps, "batched": True},
+        "candidates": [
+            {
+                k: c.get(k)
+                for k in ("impl", "steps_per_exchange", "mlups",
+                          "seconds", "spread", "engaged", "error")
+                if k in c
+            }
+            for c in cands
+        ],
+        "created": time.time(),
+    }
+    cache.put(key, decision)
+    _emit(
+        "decision", key=key, impl=decision["impl"],
+        steps_per_exchange=1, mlups=decision["mlups"],
+        source="measured", ensemble=B, member_sharding=msh,
         cache=cache.path,
     )
     return decision

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Ensemble-engine gate (ISSUE 9):
+# Ensemble-engine gate (ISSUE 9 + the ISSUE 11 mesh round):
 #
 # 1. Cold-vs-warm AOT executable cache selftest: the same batched
 #    ensemble CLI request is run twice against a fresh TPUCFD_AOT_CACHE.
@@ -12,8 +12,13 @@
 #    cleanly against pre-ensemble rounds (BENCH_r01-r05 rows have
 #    neither field), and a dropped ensemble column must surface as a
 #    non-gating coverage note (the MEASURED_FIELDS discipline).
+# 3. Member-sharded mesh selftest (ISSUE 11): the same batched request
+#    on an 8-virtual-device 'members' mesh — the ensemble:dispatch
+#    events must record the member sharding (no silent single-device
+#    fallback), and the warm run must AOT-HIT the member-sharded
+#    executable with zero misses/stores.
 #
-#   ./out/ensemble_gate.sh          # run both selftests
+#   ./out/ensemble_gate.sh          # run all three selftests
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -91,7 +96,54 @@ res2 = cmp.compare(cmp.load_rows(os.path.join(tmp, "stripped.jsonl")),
                    cmp.load_rows(os.path.join(tmp, "new.jsonl")))
 assert res2.ok, "dropped provenance columns must not gate"
 assert any("vs_looped" in n for n in res2.notes), res2.notes
+# member-placement drift is surfaced as a non-gating note (ISSUE 11)
+drift = [dict(new_rows[0]), dict(new_rows[1])]
+drift[1]["member_sharding"] = 8
+write(os.path.join(tmp, "drift.jsonl"), drift)
+res3 = cmp.compare(cmp.load_rows(os.path.join(tmp, "drift.jsonl")),
+                   cmp.load_rows(os.path.join(tmp, "new.jsonl")))
+assert res3.ok, "member-placement drift must not gate"
+assert any("member placement" in n for n in res3.notes), res3.notes
 print("ensemble_gate: compare coverage selftest OK")
+PY
+
+echo "ensemble_gate: member-sharded mesh selftest (8 virtual devices)"
+MESH_ENV=(env JAX_PLATFORMS=cpu
+          XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+          TPUCFD_AOT_CACHE="$TMP/aot_mesh")
+MCMD=(python -m multigpu_advectiondiffusion_tpu.cli diffusion3d
+      --n 16 12 8 --iters 3 --ensemble 8 --mesh members=8
+      --sweep K=0.5:2.0 --impl xla)
+"${MESH_ENV[@]}" "${MCMD[@]}" --metrics "$TMP/mesh_cold.jsonl" \
+    > "$TMP/mesh_cold.out"
+"${MESH_ENV[@]}" "${MCMD[@]}" --metrics "$TMP/mesh_warm.jsonl" \
+    > "$TMP/mesh_warm.out"
+
+python - "$TMP/mesh_cold.jsonl" "$TMP/mesh_warm.jsonl" <<'PY'
+import json, sys
+
+def events(path):
+    return [json.loads(line) for line in open(path) if line.strip()]
+
+for path in sys.argv[1:]:
+    disp = [e for e in events(path) if e["kind"] == "ensemble"]
+    assert disp, f"{path}: no ensemble:dispatch events"
+    for e in disp:
+        assert e["member_sharding"] == 8 and e["devices"] == 8, (
+            f"{path}: batched dispatch fell back off the mesh: {e}"
+        )
+cold = [e for e in events(sys.argv[1]) if e["kind"] == "aot_cache"]
+warm = [e for e in events(sys.argv[2]) if e["kind"] == "aot_cache"]
+assert [e for e in cold if e["name"] == "store" and e.get("persisted")], \
+    f"cold mesh run persisted nothing: {cold}"
+hits = [e for e in warm if e["name"] == "hit"]
+assert hits, f"warm mesh run must hit the AOT cache: {warm}"
+recompiles = [e for e in warm if e["name"] in ("miss", "store")]
+assert not recompiles, f"warm mesh run recompiled: {recompiles}"
+saved = sum(e.get("compile_seconds_saved") or 0 for e in hits)
+print(f"ensemble_gate: mesh selftest OK — member-sharded dispatch over "
+      f"8 devices, {len(hits)} warm AOT hit(s), {saved:.3f}s of "
+      "compile skipped")
 PY
 
 echo "ensemble_gate: OK"

@@ -104,8 +104,9 @@ def test_halo_verifier_proves_all_admitted_combos():
     report = halo_verify.verify_all()
     assert report.ok, "\n".join(str(v) for v in report.violations)
     names = {c.name for c in report.combos if c.admitted}
-    # the matrix genuinely spans (rung, order, k): per-stage/step/
-    # whole-run/slab rungs, WENO orders 5 and 7, k in {1, 2, 3}
+    # the matrix genuinely spans (rung, order, k) AND — since the
+    # mesh-scale ensemble round — the member axis: B-folded slab
+    # instances and the member-sharded mesh layouts
     for expect in (
         "diffusion3d-stage", "diffusion3d-stage[sharded]",
         "diffusion3d-step", "diffusion2d-whole-run",
@@ -114,9 +115,40 @@ def test_halo_verifier_proves_all_admitted_combos():
         "burgers3d-stage[o5]", "burgers3d-stage[o7,sharded]",
         "slab-burgers[o5,k=2]", "slab-burgers[o7,k=2,split]",
         "burgers2d-stage[o7,sharded]",
+        "slab-diffusion[B=2]", "slab-diffusion[B=4]",
+        "slab-burgers[o5,B=4]", "slab-burgers[o7,B=4]",
+        "ensemble-mesh[members=8]", "ensemble-mesh[members=4,dz=2]",
     ):
         assert expect in names, f"combo {expect} missing from the matrix"
-    assert report.checked >= 25
+    assert report.checked >= 36
+    # the spatially sharded member fold must DECLINE (constructor
+    # gate), mirroring the dispatch's loud rejection — never verify
+    declined = {c.name: c.reason for c in report.combos
+                if not c.admitted}
+    assert "slab-diffusion[B=4,sharded]" in declined
+    assert "member" in declined["slab-diffusion[B=4,sharded]"]
+
+
+def test_member_axis_violations_fail_loudly():
+    """Injected member-axis faults are named: a nonzero member halo on
+    a B-folded instance, and a members axis leaking into the spatial
+    decomposition."""
+    combo = next(
+        c for c in halo_verify.default_combos()
+        if c.name == "slab-diffusion[B=4]"
+    )
+    stepper = combo.build()
+    stepper.member_halo = 1  # the cross-member read a refactor could slip
+    violations = halo_verify.verify_stepper(
+        stepper, kernel="slab-diffusion[B=4]"
+    )
+    assert any("halo-free" in v.what for v in violations)
+    res = halo_verify.verify_member_mesh(
+        "bad-mesh", {"members": 4, "dz": 2}, {0: "members"}
+    )
+    assert res.violations
+    assert any("may not shard a grid axis" in v.what
+               for v in res.violations)
 
 
 def test_constants_cross_check_from_first_principles():

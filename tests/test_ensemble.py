@@ -220,19 +220,37 @@ def test_advance_to_ensemble_lands_every_member():
 # --------------------------------------------------------------------- #
 # Loud declines + member-attributed divergence
 # --------------------------------------------------------------------- #
-def test_slab_pin_declines_batching_loudly():
-    with pytest.raises(ValueError, match="slab"):
-        EnsembleSolver(DiffusionSolver, _diff_cfg("pallas_slab"), 4)
+def test_slab_pin_rides_the_b_folded_grid():
+    """Since the mesh-scale round the slab pin is ADMITTED: uniform-
+    physics ensembles fold B into the whole-run slab grid instead of
+    being declined (tests/test_ensemble_mesh.py proves bit-exactness);
+    member-varying operands still decline the pin loudly — the fold
+    bakes uniform physics."""
+    es = EnsembleSolver(DiffusionSolver, _diff_cfg("pallas_slab"), 4)
+    out = es.run(es.initial_state(), 2)
+    assert es.engaged_path()["stepper"] == (
+        "ensemble-fold[fused-whole-run-slab]"
+    )
+    assert out.members == 4
+    with pytest.raises(ValueError, match="uniform physics"):
+        es2 = EnsembleSolver(
+            DiffusionSolver, _diff_cfg("pallas_slab"),
+            [{"diffusivity": 0.5}, {"diffusivity": 2.0}],
+        )
+        es2.run(es2.initial_state(), 1)
 
 
-def test_mesh_declines_batching_loudly(devices):
+def test_spatial_only_mesh_declines_batching_loudly(devices):
+    """A mesh WITHOUT a members axis still declines loudly: a purely
+    spatial mesh shards one member's grid — ensembles compose with a
+    mesh through the 'members' axis (tests/test_ensemble_mesh.py)."""
     from multigpu_advectiondiffusion_tpu.parallel.mesh import (
         Decomposition,
         make_mesh,
     )
 
     mesh = make_mesh({"dz": 2}, devices=devices[:2])
-    with pytest.raises(ValueError, match="mesh"):
+    with pytest.raises(ValueError, match="members"):
         EnsembleSolver(DiffusionSolver, _diff_cfg("xla"), 4,
                        mesh=mesh, decomp=Decomposition.slab("dz"))
 

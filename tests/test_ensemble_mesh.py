@@ -1,0 +1,267 @@
+"""Mesh-scale ensemble serving (ISSUE 11): member-sharded and
+members x z-slab batched dispatch on the 8-virtual-device CPU mesh.
+
+Acceptance pins:
+
+* B=8 on a members-only mesh AND on a members x dz=2 mesh is
+  bit-exact vs the PR 9 single-device ensemble on diffusion, ulp on
+  WENO5;
+* the B-folded slab rung (slab pin, members-only mesh) is bit-exact
+  against per-member slab runs;
+* one diverging member is named by index UNDER SHARDING, the others'
+  results stay valid;
+* the tuner MEASURES batched candidates at the actual B (no
+  single-run proxy), keys by mesh layout, and its ``tune:measure``
+  rows carry B;
+* a mesh without a 'members' axis, a member axis sharding a grid
+  axis, and a non-tiling B all decline loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multigpu_advectiondiffusion_tpu import (
+    BurgersConfig,
+    BurgersSolver,
+    DiffusionConfig,
+    DiffusionSolver,
+    EnsembleSolver,
+    Grid,
+    telemetry,
+)
+from multigpu_advectiondiffusion_tpu.models.state import EnsembleState
+from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+    Decomposition,
+    make_mesh,
+)
+from multigpu_advectiondiffusion_tpu.resilience.errors import (
+    EnsembleMemberDivergedError,
+)
+
+
+def _diff_cfg(impl="xla", shape=(16, 12, 10)):
+    g = Grid.make(*reversed(shape), lengths=tuple(
+        0.1 * n for n in reversed(shape)
+    ))
+    return DiffusionConfig(grid=g, diffusivity=1.0, dtype="float32",
+                           impl=impl, ic="gaussian")
+
+
+def _members(B):
+    return [
+        {"ic_params": (("width", 0.1 + 0.02 * i),)} for i in range(B)
+    ]
+
+
+def _run_pair(solver_cls, cfg, members, mesh, decomp=None, iters=3):
+    """Batched run under the mesh vs the PR 9 single-device ensemble
+    (same member set)."""
+    es_ref = EnsembleSolver(solver_cls, cfg, members)
+    out_ref = es_ref.run(es_ref.initial_state(), iters)
+    es_mesh = EnsembleSolver(solver_cls, cfg, members, mesh=mesh,
+                             decomp=decomp)
+    out_mesh = es_mesh.run(es_mesh.initial_state(), iters)
+    return es_mesh, out_mesh, out_ref
+
+
+# --------------------------------------------------------------------- #
+# Bit-exactness: members-only and members x z-slab vs PR 9 single-device
+# --------------------------------------------------------------------- #
+def test_members_only_mesh_b8_bit_exact_diffusion(devices):
+    mesh = make_mesh({"members": 8})
+    es, out, ref = _run_pair(DiffusionSolver, _diff_cfg(), _members(8),
+                             mesh)
+    eng = es.engaged_path()
+    assert eng["stepper"] == "ensemble-vmap[generic-xla]"
+    assert eng["devices"] == 8 and eng["member_sharding"] == 8
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+    np.testing.assert_array_equal(np.asarray(out.t), np.asarray(ref.t))
+
+
+def test_members_x_zslab_mesh_b8_bit_exact_diffusion(devices):
+    mesh = make_mesh({"members": 4, "dz": 2})
+    es, out, ref = _run_pair(
+        DiffusionSolver, _diff_cfg(), _members(8), mesh,
+        decomp=Decomposition.slab("dz"),
+    )
+    eng = es.engaged_path()
+    assert eng["member_sharding"] == 4 and eng["devices"] == 8
+    assert eng["mesh"] == "members:4,dz:2"
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+
+
+def test_members_mesh_b8_ulp_weno5_burgers(devices):
+    cfg = BurgersConfig(grid=Grid.make(24, 8, 8, lengths=2.0), nu=1e-5,
+                        adaptive_dt=False, dtype="float32", impl="xla")
+    mesh = make_mesh({"members": 8})
+    es, out, ref = _run_pair(BurgersSolver, cfg, _members(8), mesh,
+                             iters=2)
+    # WENO under a resharded lowering reassociates at ulp level — the
+    # PR 4/PR 9 equality grade (diffusion bit-exact, WENO ulp)
+    np.testing.assert_allclose(
+        np.asarray(out.u), np.asarray(ref.u), rtol=0, atol=1e-6,
+    )
+
+
+def test_members_x_zslab_ulp_weno5_burgers(devices):
+    cfg = BurgersConfig(grid=Grid.make(24, 8, 16, lengths=2.0), nu=1e-5,
+                        adaptive_dt=False, dtype="float32", impl="xla")
+    mesh = make_mesh({"members": 4, "dz": 2})
+    es, out, ref = _run_pair(BurgersSolver, cfg, _members(8), mesh,
+                             decomp=Decomposition.slab("dz"), iters=2)
+    np.testing.assert_allclose(
+        np.asarray(out.u), np.asarray(ref.u), rtol=0, atol=1e-6,
+    )
+
+
+def test_member_varying_operands_under_members_mesh(devices):
+    """Scalar sweeps (generic rung, batched operands) compose with
+    member sharding: per-member K and per-member step counts survive
+    the resharding bit-exact."""
+    mesh = make_mesh({"members": 4})
+    members = [{"diffusivity": k} for k in (0.5, 1.0, 1.5, 2.0)]
+    cfg = _diff_cfg()
+    es_ref = EnsembleSolver(DiffusionSolver, cfg, members)
+    est = es_ref.initial_state()
+    t_end = float(est.t[0]) + 0.002
+    ref = es_ref.advance_to(est, t_end)
+    es = EnsembleSolver(DiffusionSolver, cfg, members, mesh=mesh)
+    out = es.advance_to(es.initial_state(), t_end)
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+    np.testing.assert_array_equal(np.asarray(out.it), np.asarray(ref.it))
+
+
+# --------------------------------------------------------------------- #
+# The B-folded slab rung
+# --------------------------------------------------------------------- #
+def test_b_folded_slab_bit_exact_vs_per_member_slab_runs():
+    cfg = _diff_cfg("pallas_slab")
+    es = EnsembleSolver(DiffusionSolver, cfg, _members(4))
+    out = es.run(es.initial_state(), 2)
+    assert es.engaged_path()["stepper"] == (
+        "ensemble-fold[fused-whole-run-slab]"
+    )
+    for i in range(4):
+        ms = es.member_solver(i)
+        ref = ms.run(ms.initial_state(), 2)
+        assert ms.engaged_path()["stepper"] == "fused-whole-run-slab"
+        np.testing.assert_array_equal(
+            np.asarray(out.u[i]), np.asarray(ref.u),
+            err_msg=f"member {i} diverged from its slab single run",
+        )
+
+
+def test_b_folded_slab_under_members_mesh_bit_exact(devices):
+    cfg = _diff_cfg("pallas_slab")
+    mesh = make_mesh({"members": 4})
+    es_ref = EnsembleSolver(DiffusionSolver, cfg, _members(8))
+    ref = es_ref.run(es_ref.initial_state(), 2)
+    es = EnsembleSolver(DiffusionSolver, cfg, _members(8), mesh=mesh)
+    out = es.run(es.initial_state(), 2)
+    assert es.engaged_path()["stepper"] == (
+        "ensemble-fold[fused-whole-run-slab]"
+    )
+    assert es.engaged_path()["member_sharding"] == 4
+    np.testing.assert_array_equal(np.asarray(out.u), np.asarray(ref.u))
+
+
+def test_slab_pin_over_spatial_subgroup_declines_loudly(devices):
+    mesh = make_mesh({"members": 4, "dz": 2})
+    with pytest.raises(ValueError, match="spatial"):
+        EnsembleSolver(
+            DiffusionSolver, _diff_cfg("pallas_slab"), _members(8),
+            mesh=mesh, decomp=Decomposition.slab("dz"),
+        )
+
+
+# --------------------------------------------------------------------- #
+# Member-attributed divergence under sharding
+# --------------------------------------------------------------------- #
+def test_diverging_member_named_under_sharding(devices):
+    mesh = make_mesh({"members": 4})
+    es = EnsembleSolver(DiffusionSolver, _diff_cfg(), _members(8),
+                        mesh=mesh)
+    est = es.initial_state()
+    bad = est.u.at[5, 4, 5, 6].set(jnp.nan)
+    est = EnsembleState(u=bad, t=est.t, it=est.it)
+    out = es.run(est, 2)
+    with pytest.raises(EnsembleMemberDivergedError) as exc:
+        es.check_health(out)
+    assert exc.value.members == [5]
+    # every healthy member stays bit-exact vs its looped single run
+    for i in (0, 3, 7):
+        ms = es.member_solver(i)
+        ref = ms.run(ms.initial_state(), 2)
+        np.testing.assert_array_equal(
+            np.asarray(out.u[i]), np.asarray(ref.u),
+            err_msg=f"healthy member {i} was poisoned under sharding",
+        )
+
+
+# --------------------------------------------------------------------- #
+# Measured batched tuning
+# --------------------------------------------------------------------- #
+def test_tuner_measures_batched_candidates_at_actual_b(
+        devices, tmp_path):
+    from multigpu_advectiondiffusion_tpu import tuning
+
+    tuning.configure(cache_path=str(tmp_path / "tuning.json"),
+                     enabled=True)
+    try:
+        cfg = dataclasses.replace(_diff_cfg(shape=(12, 10, 8)),
+                                  impl="auto")
+        mesh = make_mesh({"members": 8})
+        mpath = str(tmp_path / "ev.jsonl")
+        with telemetry.capture(mpath):
+            es = EnsembleSolver(DiffusionSolver, cfg, 16, mesh=mesh)
+        assert es._tuned["source"] == "measured"
+        assert es._tuned["ensemble"] == 16
+        assert es._tuned["member_sharding"] == 8
+        evs = [json.loads(line) for line in open(mpath)]
+        meas = [e for e in evs if e["kind"] == "tune"
+                and e["name"] == "measure"]
+        # the measurement happened AT the batched shape: every row
+        # carries B (no single-run proxy)
+        assert meas and all(e.get("ensemble") == 16 for e in meas)
+        impls = {e["impl"] for e in meas if "mlups" in e}
+        assert "xla" in impls  # generic rung always races
+        # warm construction resolves from the cache without re-measuring
+        es2 = EnsembleSolver(DiffusionSolver, cfg, 16, mesh=mesh)
+        assert es2._tuned["source"] == "cache"
+        # a different mesh layout is a different key
+        es3 = EnsembleSolver(DiffusionSolver, cfg, 16)
+        assert es3._tuned["key"] != es._tuned["key"]
+    finally:
+        tuning.configure(enabled=False,
+                         cache_path=os.environ.get(
+                             "TPUCFD_TUNING_CACHE", ""))
+
+
+# --------------------------------------------------------------------- #
+# Loud declines
+# --------------------------------------------------------------------- #
+def test_spatial_only_mesh_needs_members_axis(devices):
+    mesh = make_mesh({"dz": 2}, devices=devices[:2])
+    with pytest.raises(ValueError, match="members"):
+        EnsembleSolver(DiffusionSolver, _diff_cfg(), 4, mesh=mesh,
+                       decomp=Decomposition.slab("dz"))
+
+
+def test_member_axis_may_not_shard_a_grid_axis(devices):
+    mesh = make_mesh({"members": 2})
+    with pytest.raises(ValueError, match="halo-free"):
+        EnsembleSolver(DiffusionSolver, _diff_cfg(), 4, mesh=mesh,
+                       decomp=Decomposition.slab("members"))
+
+
+def test_non_tiling_member_count_declines(devices):
+    mesh = make_mesh({"members": 8})
+    with pytest.raises(ValueError, match="multiple"):
+        EnsembleSolver(DiffusionSolver, _diff_cfg(), 6, mesh=mesh)

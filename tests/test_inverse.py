@@ -1,0 +1,119 @@
+"""Gradient-based inverse problem through the batched dispatch
+(ISSUE 11 satellite): ``jax.grad`` flows through
+``advance_to_ensemble`` (bounded-loop mode) w.r.t. the member
+diffusivity operands, and a short descent recovers a perturbed K.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from examples.inverse_diffusivity import recover_diffusivity
+
+
+def test_grad_through_advance_to_ensemble_is_finite_and_signed():
+    """The raw differentiability contract: a (B,) diffusivity operand
+    vector yields a finite per-member gradient whose sign points at
+    the truth (K too small => negative dL/dK past the optimum etc.)."""
+    from multigpu_advectiondiffusion_tpu import (
+        DiffusionConfig,
+        DiffusionSolver,
+        Grid,
+    )
+    from multigpu_advectiondiffusion_tpu.models.state import EnsembleState
+
+    grid = Grid.make(32, lengths=10.0)
+    cfg = DiffusionConfig(grid=grid, diffusivity=1.0, dtype="float32",
+                          impl="xla")
+    solver = DiffusionSolver(cfg)
+    s0 = solver.initial_state()
+    t_end = float(s0.t) + 0.04
+    obs = solver.advance_to(s0, t_end)
+    est0 = EnsembleState(
+        u=jnp.stack([s0.u] * 2), t=jnp.stack([s0.t] * 2),
+        it=jnp.zeros((2,), jnp.int32),
+    )
+
+    def loss(ks):
+        out = solver.advance_to_ensemble(
+            est0, t_end, operands={"diffusivity": ks}, max_steps=48
+        )
+        return jnp.sum(jnp.mean((out.u - obs.u[None]) ** 2, axis=1))
+
+    grads = jax.grad(loss)(jnp.asarray([0.6, 1.8], jnp.float32))
+    g = np.asarray(grads)
+    assert np.isfinite(g).all()
+    # member 0 sits below the truth (K=1): the misfit decreases with
+    # larger K => negative gradient; member 1 above => positive
+    assert g[0] < 0 < g[1], g
+
+
+def test_descent_recovers_perturbed_diffusivity():
+    """Loose-tolerance convergence: every descent trajectory lands
+    within 10% of the true K from guesses up to ~2.5x off."""
+    k_true = 1.3
+    recovered, history = recover_diffusivity(
+        [0.5, 1.0, 2.6], n=32, k_true=k_true, t_window=0.04,
+        iterations=35, lr=0.06, max_steps=48,
+    )
+    rec = np.asarray(recovered)
+    assert np.all(np.abs(rec - k_true) / k_true < 0.10), rec
+    # and the descent actually descended
+    assert history[-1] < 0.2 * history[0], (history[0], history[-1])
+
+
+def test_bounded_mode_matches_while_loop_semantics():
+    """``max_steps`` large enough must reproduce the data-dependent
+    while-loop dispatch exactly (field, time AND per-member step
+    counts) — the differentiable mode is a semantics-preserving
+    re-expression, not an approximation."""
+    from multigpu_advectiondiffusion_tpu import (
+        DiffusionConfig,
+        DiffusionSolver,
+        EnsembleSolver,
+        Grid,
+    )
+
+    grid = Grid.make(12, 10, 8, lengths=(1.2, 1.0, 0.8))
+    cfg = DiffusionConfig(grid=grid, diffusivity=1.0, dtype="float32",
+                          impl="xla", ic="gaussian")
+    members = [{"diffusivity": k} for k in (0.5, 1.0, 2.0)]
+    es = EnsembleSolver(DiffusionSolver, cfg, members)
+    est = es.initial_state()
+    t_end = float(est.t[0]) + 0.002
+    out_while = es.advance_to(est, t_end)
+    out_bounded = es.advance_to(est, t_end, max_steps=64)
+    np.testing.assert_array_equal(
+        np.asarray(out_while.u), np.asarray(out_bounded.u)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_while.t), np.asarray(out_bounded.t)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_while.it), np.asarray(out_bounded.it)
+    )
+
+
+def test_bounded_mode_too_small_budget_is_visible():
+    """An insufficient ``max_steps`` is not silent: members that did
+    not reach t_end report t < t_end (the caller's convergence check
+    sees it), never a wrong field at a lying time."""
+    from multigpu_advectiondiffusion_tpu import (
+        DiffusionConfig,
+        DiffusionSolver,
+        EnsembleSolver,
+        Grid,
+    )
+
+    grid = Grid.make(12, 10, 8, lengths=(1.2, 1.0, 0.8))
+    cfg = DiffusionConfig(grid=grid, diffusivity=1.0, dtype="float32",
+                          impl="xla", ic="gaussian")
+    es = EnsembleSolver(DiffusionSolver, cfg, 2)
+    est = es.initial_state()
+    t_end = float(est.t[0]) + 0.01
+    out = es.advance_to(est, t_end, max_steps=2)
+    assert np.all(np.asarray(out.it) == 2)
+    assert np.all(np.asarray(out.t) < t_end - 1e-9)
