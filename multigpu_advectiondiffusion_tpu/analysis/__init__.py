@@ -1,17 +1,28 @@
 """Project static analysis (`tpucfd-check`): the machine-checked half
-of nine PRs of hand-enforced invariants.
+of ten PRs of hand-enforced invariants.
 
-Three layers (ISSUE 10):
+Four layers (ISSUE 10 + the collective-schedule round, ISSUE 12):
 
 * :mod:`framework` + :mod:`rules` — an AST rule engine (the
   generalization of ``telemetry/schema.scan_emitted``) with domain lint
   rules: closure-captured physics constants in ``build_local``
   closures, host-sync calls inside traced code, non-atomic persistent
-  artifact writes, unregistered telemetry emission sites;
+  artifact writes, unregistered telemetry emission sites, collectives
+  and persistent effects under ``process_index()``-dependent control
+  flow;
 * :mod:`halo_verify` — the stencil/halo consistency verifier, this
-  domain's race detector: proves ghost depth G, exchange depth k*G and
-  the slab trapezoid margins ``(k-1-j)*G`` mutually sufficient for
-  every (rung, order, k) combination the dispatch admits;
+  domain's race detector: proves ghost depth G, exchange depth k*G,
+  the slab trapezoid margins ``(k-1-j)*G`` and any declared in-kernel
+  remote-DMA window mutually sufficient for every (rung, order, k)
+  combination the dispatch admits;
+* :mod:`collective_verify` — the collective-schedule & SPMD
+  consistency verifier, the distributed analogue of the halo pass
+  (MUST/ISP-style MPI verification, statically): extracts every
+  barrier/agree/ppermute/reduce/shard_map site, proves tag uniqueness,
+  join consistency and declared-metadata drift, proves the sharding
+  registry (PartitionSpec axes vs mesh, member-axis rules), and
+  cross-checks the static schedule against measured 2-proc telemetry
+  streams so the analysis cannot drift from the code it models;
 * :mod:`sanitizer` — opt-in ``jax.experimental.checkify``
   instrumentation of the steppers (``--checkify``), surfacing NaN /
   div-by-zero / OOB through the supervisor's rollback path.

@@ -4,9 +4,15 @@
     python -m multigpu_advectiondiffusion_tpu.cli check          # same
     ... check --selftest         # every rule must trip on its seeded
                                  # fixture; the halo verifier must fail
-                                 # an injected off-by-one ghost depth
+                                 # an injected off-by-one ghost depth;
+                                 # the collective verifier must fail
+                                 # seeded deadlock/sharding fixtures
     ... check --json             # machine-readable report
     ... check --list-rules       # the rule table
+    ... check --schedule-trace events_p0.jsonl events_p1.jsonl
+                                 # prove measured per-rank collective
+                                 # sequences are a linearization of
+                                 # the static schedule
 
 Exit codes: 0 clean, 1 violations (or a failed selftest), 2 usage.
 Wired into CI by ``out/lint_gate.sh`` (clean-tree pass + selftest) and
@@ -31,6 +37,15 @@ def configure_parser(p: argparse.ArgumentParser) -> None:
                    help="skip the AST lint rules (halo verifier only)")
     p.add_argument("--skip-halo", action="store_true",
                    help="skip the stencil/halo verifier (lint only)")
+    p.add_argument("--skip-collective", action="store_true",
+                   help="skip the collective-schedule & sharding "
+                        "verifier")
+    p.add_argument("--schedule-trace", nargs="+", default=None,
+                   metavar="EVENTS.jsonl",
+                   help="dynamic cross-check: prove the per-rank "
+                        "collective sequences measured in these "
+                        "telemetry streams are a linearization of the "
+                        "statically extracted schedule")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report on stdout")
     p.add_argument("--list-rules", action="store_true",
@@ -63,11 +78,18 @@ def _selected_rules(arg: Optional[str]):
 def selftest(out=print) -> bool:
     """Every rule trips on its seeded fixture and passes the clean
     twin; the halo verifier proves the shipped combos and fails an
-    injected off-by-one ghost depth naming kernel/axis/depth."""
+    injected off-by-one ghost depth naming kernel/axis/depth; the
+    collective verifier proves the shipped tree and fails seeded
+    deadlock (duplicate-tag / divergent-join), sharding and
+    remote-DMA fixtures naming file/line/tag; the trace cross-check
+    rejects a non-linearized measured sequence."""
     import tempfile
 
     from multigpu_advectiondiffusion_tpu.analysis import all_rules, run_rules
-    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+    from multigpu_advectiondiffusion_tpu.analysis import (
+        collective_verify,
+        halo_verify,
+    )
     from multigpu_advectiondiffusion_tpu.analysis.fixtures import (
         RULE_FIXTURES,
     )
@@ -129,26 +151,187 @@ def selftest(out=print) -> bool:
     else:
         out(f"  ok: injected off-by-one trips ({len(injected)} "
             f"violations, e.g. {injected[0]})")
+    # collective verifier: the shipped tree proves rank-uniform...
+    coll = collective_verify.verify_tree()
+    if not coll.ok:
+        out("FAIL: collective verifier flags the shipped tree:")
+        for v in coll.violations:
+            out(f"  {v}")
+        ok = False
+    else:
+        out(f"  ok: collective verifier ({len(coll.sites)} sites, "
+            f"{len(coll.cases_proven)} sharding cases clean)")
+    # ...a seeded duplicate-tag pair fails, naming file/line/tag...
+    with tempfile.TemporaryDirectory() as d:
+        atomic_write_text(
+            f"{d}/writer_a.py",
+            "from multigpu_advectiondiffusion_tpu.parallel import "
+            "multihost\n\n"
+            "def commit_a():\n"
+            "    multihost.barrier('shared-commit')\n",
+        )
+        atomic_write_text(
+            f"{d}/writer_b.py",
+            "from multigpu_advectiondiffusion_tpu.parallel import "
+            "multihost\n\n"
+            "def commit_b():\n"
+            "    multihost.barrier('shared-commit')\n",
+        )
+        dup = collective_verify.verify_tree(root=d)
+    hits = [v for v in dup.violations
+            if v.rule == "duplicate-collective-tag"]
+    if not hits or "shared-commit" not in hits[0].site:
+        out("FAIL: duplicate-tag fixture did not trip naming the tag")
+        ok = False
+    else:
+        out(f"  ok: seeded duplicate tag trips ({hits[0]})")
+    # ...a seeded rank-divergent join fails, naming the guard...
+    with tempfile.TemporaryDirectory() as d:
+        atomic_write_text(
+            f"{d}/joiner.py",
+            "import jax\n"
+            "from multigpu_advectiondiffusion_tpu.parallel import "
+            "multihost\n\n"
+            "def desync():\n"
+            "    if jax.process_index() == 0:\n"
+            "        multihost.agree('coord-only', [1.0])\n"
+            "    else:\n"
+            "        multihost.barrier('worker-only')\n",
+        )
+        join = collective_verify.verify_tree(root=d)
+    hits = [v for v in join.violations if v.rule == "divergent-join"]
+    if not hits:
+        out("FAIL: divergent-join fixture did not trip")
+        ok = False
+    else:
+        out(f"  ok: seeded divergent join trips ({hits[0]})")
+    # ...the sharding pass fails a bad PartitionSpec axis and a
+    # member-axis-in-spatial layout...
+    bad_cases = [
+        collective_verify.ShardingCase(
+            "selftest-bad-axis", {"dz": 2}, {0: "zd"},
+        ),
+        collective_verify.ShardingCase(
+            "selftest-member-in-spatial", {"members": 4, "dz": 2},
+            {0: "members"}, member=True,
+        ),
+    ]
+    _, sharding = collective_verify.verify_sharding_cases(bad_cases)
+    named = {v.path for v in sharding}
+    if {c.name for c in bad_cases} - named:
+        out("FAIL: sharding fixtures did not all trip: "
+            f"{sorted(named)}")
+        ok = False
+    else:
+        out(f"  ok: seeded sharding fixtures trip "
+            f"({len(sharding)} violations)")
+    # ...a declared remote-DMA window is validated against the
+    # exchange depth (ROADMAP item 2's contract, proven ahead of the
+    # kernel)...
+    stepper = combo.build()
+    depth = stepper.exchange_depth
+    stepper.remote_dma = {"axis": 0, "window_rows": depth, "buffers": 2}
+    if halo_verify.verify_stepper(stepper, kernel=combo.name):
+        out("FAIL: a consistent remote-DMA declaration was rejected")
+        ok = False
+    stepper.remote_dma = {"axis": 0, "window_rows": depth - 1,
+                          "buffers": 1}
+    dma = halo_verify.verify_stepper(stepper, kernel=combo.name)
+    if len(dma) < 2:
+        out("FAIL: inconsistent remote-DMA declaration passed")
+        ok = False
+    else:
+        out(f"  ok: bad remote-DMA window trips ({dma[0]})")
+    # ...and the dynamic cross-check rejects a non-linearization
+    schedule = collective_verify.static_schedule()
+    good = [("barrier", "ckptd-begin:/r"),
+            ("barrier", "ckptd-shards:/r"),
+            ("barrier", "ckptd-commit:/r"),
+            ("agree", "checkpoint")]
+    if collective_verify.verify_trace({0: good, 1: list(good)},
+                                      schedule):
+        out("FAIL: trace cross-check rejected a valid linearization")
+        ok = False
+    shuffled = [good[0], good[2], good[1], good[3]]
+    if not collective_verify.verify_trace({0: shuffled, 1: shuffled},
+                                          schedule):
+        out("FAIL: trace cross-check passed an out-of-order commit "
+            "protocol")
+        ok = False
+    elif not collective_verify.verify_trace({0: good, 1: shuffled},
+                                            schedule):
+        out("FAIL: trace cross-check passed rank-divergent sequences")
+        ok = False
+    else:
+        out("  ok: trace cross-check rejects non-linearizations")
     out("selftest: " + ("PASS" if ok else "FAIL"))
     return ok
+
+
+def _run_schedule_trace(args) -> Optional[bool]:
+    """The dynamic cross-check as a CLI verb: load per-rank telemetry
+    streams, project them onto the collective alphabet and prove the
+    measured sequences linearize the static schedule."""
+    from multigpu_advectiondiffusion_tpu.analysis import collective_verify
+
+    sequences, profiles = {}, {}
+    for i, path in enumerate(args.schedule_trace):
+        with open(path) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+        sequences[i] = collective_verify.collective_sequence(events)
+        profiles[i] = collective_verify.halo_counter_profile(events)
+    schedule = collective_verify.static_schedule(args.root)
+    problems = collective_verify.verify_trace(sequences, schedule)
+    ranks = sorted(profiles)
+    for r in ranks[1:]:
+        if profiles[r] != profiles[ranks[0]]:
+            problems.append(
+                f"ranks {ranks[0]} and {r} traced different halo-"
+                f"exchange site profiles: {profiles[ranks[0]]} vs "
+                f"{profiles[r]}"
+            )
+    for line in problems:
+        print(line)
+    n = sum(len(s) for s in sequences.values())
+    print(
+        f"schedule-trace: {len(problems)} problem(s); {n} measured "
+        f"collective(s) across {len(sequences)} stream(s) vs "
+        f"{len(schedule.alphabet)} static tag template(s), "
+        f"{len(schedule.chains)} chain(s)"
+        + ("" if problems else " — linearization proven")
+    )
+    return False if problems else None
 
 
 def run(args) -> Optional[bool]:
     """Entry point for both the ``check`` subcommand and the module
     CLI. Returns ``False`` (CLI failure) on violations."""
     from multigpu_advectiondiffusion_tpu.analysis import all_rules, run_rules
-    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+    from multigpu_advectiondiffusion_tpu.analysis import (
+        collective_verify,
+        halo_verify,
+    )
 
     if args.list_rules:
         for name, cls in sorted(all_rules().items()):
             print(f"{name}: {' '.join(cls.description.split())}")
         print("halo-verify: stencil/halo consistency verifier — proves "
-              "ghost depth G, exchange depth k*G and the slab trapezoid "
-              "margins (k-1-j)*G sufficient for every admitted "
-              "(rung, order, k) combination")
+              "ghost depth G, exchange depth k*G, the slab trapezoid "
+              "margins (k-1-j)*G and any declared remote-DMA window "
+              "sufficient for every admitted (rung, order, k) "
+              "combination")
+        print("collective-verify: collective-schedule & SPMD "
+              "consistency verifier — extracts every barrier/agree/"
+              "ppermute/reduce/shard_map site, proves tag uniqueness, "
+              "rank-uniform joins, declared-tag drift, entry-point "
+              "reachability and the sharding-case registry "
+              "(PartitionSpec axes vs mesh, member-axis rules); "
+              "--schedule-trace cross-checks measured streams")
         return None
     if args.selftest:
         return True if selftest() else False
+    if args.schedule_trace:
+        return _run_schedule_trace(args)
 
     problems: List[str] = []
     lint = []
@@ -159,6 +342,10 @@ def run(args) -> Optional[bool]:
     if not args.skip_halo:
         halo = halo_verify.verify_all()
         problems.extend(str(v) for v in halo.violations)
+    coll = None
+    if not args.skip_collective:
+        coll = collective_verify.verify_tree(args.root)
+        problems.extend(str(v) for v in coll.violations)
 
     if args.json:
         print(json.dumps({
@@ -173,15 +360,25 @@ def run(args) -> Optional[bool]:
                 "violations": [vars(v) for v in halo.violations]
                 if halo else [],
             },
+            "collective": {
+                "sites": len(coll.sites) if coll else 0,
+                "chains": coll.chains if coll else 0,
+                "cases_proven": coll.cases_proven if coll else [],
+                "violations": [vars(v) for v in coll.violations]
+                if coll else [],
+            },
             "ok": not problems,
         }, indent=2))
     else:
         for line in problems:
             print(line)
         checked = halo.checked if halo else 0
+        sites = len(coll.sites) if coll else 0
+        cases = len(coll.cases_proven) if coll else 0
         print(
             f"tpucfd-check: {len(problems)} violation(s); "
-            f"halo combos proven: {checked}"
+            f"halo combos proven: {checked}; collective sites: "
+            f"{sites}; sharding cases proven: {cases}"
             + ("" if problems else " — clean")
         )
     return False if problems else None

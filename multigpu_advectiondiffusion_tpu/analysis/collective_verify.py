@@ -1,0 +1,955 @@
+"""Collective-schedule & SPMD consistency verifier — the distributed
+analogue of :mod:`halo_verify`.
+
+The reference's MPI layer discovers a mismatched send/recv or a
+rank-divergent barrier by HANGING at runtime; PR 5's watchdog bounds
+the hang, but nothing *proves* the collective schedule sound before a
+multi-chip session burns hardware time. Three passes, mirroring the
+MUST/ISP class of MPI verification tools (PARITY "Static analysis"):
+
+1. **Static schedule extraction + rank-uniformity.** An AST walk over
+   the package finds every collective call site — ``multihost.barrier``
+   / ``agree`` tags, ``exchange_ghosts``/``ppermute`` halo shifts,
+   ``pmax``/``psum`` mesh reductions, ``process_allgather``,
+   ``shard_map`` entries — records the rank-guard context of each
+   (``process_index()``-derived conditions, propagated through names
+   like ``is_coord``), and proves: no collective sits under
+   rank-dependent control flow (the deadlock class — one rank enters
+   the barrier, its peer never will), no two branches of a
+   rank-dependent ``if`` carry different collective schedules
+   (divergent join), every ``barrier``/``agree`` tag is unique per
+   call site (a shadowed tag makes two distinct rendezvous points
+   indistinguishable to the watchdog AND to this verifier's dynamic
+   cross-check), every tag namespace matches the issuing module's
+   declared metadata (``utils/io.CKPTD_BARRIER_TAGS``,
+   ``resilience/supervisor.AGREE_TAGS`` — the ``stencil_spec()``
+   discipline applied to collectives), and every barrier/agree site is
+   reachable from a public entry point (dead rendezvous code would
+   silently escape the dynamic cross-check). Failures name
+   file/line/tag/guard.
+
+2. **Sharding-spec pass.** A registry of mesh layouts the CLI/dispatch
+   admits (:func:`default_sharding_cases` — slab/pencil/block,
+   multi-host compound axes, member(-x-spatial) ensemble meshes) is
+   proven against :class:`~..parallel.mesh.Decomposition` arithmetic:
+   every ``PartitionSpec`` axis exists in the constructed mesh, no
+   mesh axis shards two grid axes, the ``ppermute`` axis-name set
+   equals the ``pmax``/``psum`` reduction set (both derived from the
+   ONE :func:`~..parallel.mesh.reduce_axis_names` source), sharded
+   extents divide the grid, and the member-axis rules (members never
+   in a spatial spec; the B-fold never spatially sharded) generalized
+   here from ``halo_verify.verify_member_mesh`` (which now delegates).
+
+3. **Dynamic cross-check.** :func:`static_schedule` compiles the
+   extracted sites into an alphabet of tag templates plus ordered
+   chains (straight-line same-guard sequences, e.g. the three
+   ``ckptd-*`` checkpoint-commit barriers); :func:`verify_trace`
+   asserts a measured per-rank collective sequence (the existing
+   telemetry stream's ``sync:barrier`` / ``resilience:agree`` events
+   and ``halo.*`` counters — no new instrumentation) is a
+   linearization of that schedule: every measured tag maps to a static
+   site, every rank measured the SAME sequence, and every chain's
+   members appear in chain order per concrete tag instance. The
+   2-proc chaos test (``tests/test_chaos.py``) drives this against
+   real processes, so the verifier cannot drift from the code it
+   models.
+
+Suppression: intentionally rank-divergent sites carry the audited
+``# tpucfd-check: allow[<rule>]`` pragma (on the site or its guard
+line) with a comment stating why they are safe — see the lint rules
+``rank-divergent-collective`` / ``rank-divergent-effect`` in
+:mod:`rules`, which share this module's taint analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from multigpu_advectiondiffusion_tpu.analysis.framework import (
+    ParsedModule,
+    iter_modules,
+)
+
+# --------------------------------------------------------------------- #
+# Rank-taint analysis (shared with the lint rules)
+# --------------------------------------------------------------------- #
+#: call names whose value is rank-dependent: control flow tested on
+#: them diverges between processes
+RANK_SOURCES = {"process_index", "is_coordinator"}
+
+#: collective entry points, by terminal call name -> collective kind.
+#: Entering any of these under rank-divergent control flow is the MPI
+#: deadlock class: one rank arrives at the rendezvous, its peer never
+#: will (or, for ppermute/psum inside shard_map, silent corruption).
+COLLECTIVE_CALLS = {
+    "barrier": "barrier",
+    "sync_global_devices": "barrier",
+    "agree": "agree",
+    "_agree": "agree",
+    "process_allgather": "allgather",
+    "all_gather": "allgather",
+    "ppermute": "ppermute",
+    "exchange_ghosts": "ppermute",
+    "exchange_axis": "ppermute",
+    "pmax": "reduce",
+    "psum": "reduce",
+    "shard_map": "shard_map",
+}
+
+#: entry points the interprocedural reachability walk starts from: the
+#: CLI drivers, the supervised loop, the checkpoint-commit protocol,
+#: the dispatch surface and the distributed bring-up
+ENTRY_POINTS = (
+    "main",
+    "run_solver",
+    "run_ensemble_solver",
+    "supervise_run",
+    "run",
+    "run_to",
+    "step",
+    "advance_to",
+    "run_ensemble",
+    "advance_to_ensemble",
+    "save_checkpoint_sharded",
+    "initialize",
+)
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _fixpoint_taint(root: ast.AST, base: Set[str]) -> Set[str]:
+    """Propagate rank taint through plain-name assignments inside
+    ``root`` to a fixpoint, starting from ``base``."""
+    tainted = set(base)
+
+    def expr_tainted(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if (
+                isinstance(n, ast.Call)
+                and _terminal_name(n.func) in RANK_SOURCES
+            ):
+                return True
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in tainted
+            ):
+                return True
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(root):
+            targets: List[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is None or not expr_tainted(value):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id not in tainted:
+                    tainted.add(t.id)
+                    changed = True
+    return tainted
+
+
+class RankTaint:
+    """Per-scope rank-taint lookup: names whose value derives from
+    ``process_index()`` / ``is_coordinator()`` (``is_coord = jax.
+    process_index() == 0``; ``pid = jax.process_index()``), propagated
+    through assignments to a fixpoint WITHIN each outermost function
+    (closures over a tainted outer local — the ``_write_checkpoint``
+    pattern — see it; an unrelated function reusing the same variable
+    name does not). Plain names only — attribute targets
+    (``self.rank``) are out of scope by design (instance state is
+    constructor policy, not control flow the schedule walks)."""
+
+    def __init__(self, mod: ParsedModule):
+        self._mod = mod
+        self._outer: Dict[ast.AST, ast.AST] = {}
+        tops: List[ast.AST] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._outermost(node) is node:
+                    tops.append(node)
+        module_base = self._module_level_taint(mod, tops)
+        self._by_fn: Dict[ast.AST, Set[str]] = {
+            top: _fixpoint_taint(top, module_base) for top in tops
+        }
+        self._module = module_base
+
+    def _outermost(self, node: ast.AST) -> Optional[ast.AST]:
+        if node in self._outer:
+            return self._outer[node]
+        fn = None
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = cur
+            cur = self._mod.parent(cur)
+        self._outer[node] = fn
+        return fn
+
+    @staticmethod
+    def _module_level_taint(mod: ParsedModule,
+                            tops: Sequence[ast.AST]) -> Set[str]:
+        # a pruned copy of the tree without any function bodies: only
+        # genuinely module-scoped assignments seed every function
+        del tops
+
+        class _Prune(ast.NodeTransformer):
+            def visit_FunctionDef(self, node):
+                return None
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+        pruned = _Prune().visit(
+            ast.parse(mod.source, filename=mod.path)
+        )
+        return _fixpoint_taint(pruned, set())
+
+    def names_for(self, node: ast.AST) -> Set[str]:
+        outer = self._outermost(node)
+        if outer is None:
+            return self._module
+        return self._by_fn.get(outer, self._module)
+
+
+def tainted_names(mod: ParsedModule) -> RankTaint:
+    """Build the per-scope rank-taint lookup for one module (the name
+    is historical: consumers pass the result to :func:`rank_guards`,
+    which resolves the right scope per node)."""
+    return RankTaint(mod)
+
+
+def _expr_rank_dependent(test: ast.AST, tainted: Set[str]) -> bool:
+    for n in ast.walk(test):
+        if (
+            isinstance(n, ast.Call)
+            and _terminal_name(n.func) in RANK_SOURCES
+        ):
+            return True
+        if (
+            isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)
+            and n.id in tainted
+        ):
+            return True
+    return False
+
+
+def rank_guards(
+    mod: ParsedModule, node: ast.AST, taint: RankTaint
+) -> List[Tuple[int, str]]:
+    """``[(lineno, guard_source), ...]`` for every enclosing
+    ``if``/``while``/ternary whose test is rank-dependent and whose
+    body (not test) contains ``node`` — the control-flow contexts under
+    which this node executes on some ranks but not others."""
+    names = taint.names_for(node)
+    out: List[Tuple[int, str]] = []
+    child: ast.AST = node
+    cur = mod.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.If, ast.While, ast.IfExp)):
+            if child is not cur.test and _expr_rank_dependent(
+                cur.test, names
+            ):
+                out.append((cur.lineno, ast.unparse(cur.test)))
+        child, cur = cur, mod.parent(cur)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Collective-site extraction
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class CollectiveSite:
+    """One statically extracted collective call site."""
+
+    kind: str  # barrier | agree | allgather | ppermute | reduce | shard_map
+    tag: Optional[str]  # literal/f-string template ('*' wildcards); None = dynamic
+    path: str
+    line: int
+    function: str  # innermost enclosing function name ('<module>' at top level)
+    guards: Tuple[str, ...]  # ALL enclosing conditional tests (source text)
+
+    def __str__(self) -> str:
+        t = self.tag if self.tag is not None else "<dynamic>"
+        return f"{self.path}:{self.line}: {self.kind}[{t}]"
+
+
+def _tag_template(node: Optional[ast.AST]) -> Optional[str]:
+    """Literal tag -> itself; f-string -> template with ``*`` for every
+    interpolation (``f"ckptd-begin:{d}"`` -> ``ckptd-begin:*``); string
+    concatenation of a literal prefix -> ``prefix*``; else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _tag_template(node.left)
+        if left is not None and not left.endswith("*"):
+            return left + "*"
+    return None
+
+
+def _all_guards(mod: ParsedModule, node: ast.AST) -> Tuple[str, ...]:
+    out = []
+    child: ast.AST = node
+    cur = mod.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.If, ast.While, ast.IfExp)):
+            if child is not cur.test:
+                out.append(ast.unparse(cur.test))
+        child, cur = cur, mod.parent(cur)
+    return tuple(reversed(out))
+
+
+def _enclosing_function_name(mod: ParsedModule, node: ast.AST) -> str:
+    fn = mod.enclosing_function(node)
+    return fn.name if fn is not None else "<module>"
+
+
+def extract_sites(mod: ParsedModule) -> List[CollectiveSite]:
+    """Every collective call site in one module, with tag template and
+    guard context. The *definitions* of the wrappers themselves
+    (``multihost.barrier`` calling ``sync_global_devices``) extract
+    like any other site — their dynamic tags are simply untracked."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        kind = COLLECTIVE_CALLS.get(name or "")
+        if kind is None:
+            continue
+        tag = None
+        if kind in ("barrier", "agree") and node.args:
+            tag = _tag_template(node.args[0])
+        out.append(
+            CollectiveSite(
+                kind=kind,
+                tag=tag,
+                path=mod.relpath,
+                line=node.lineno,
+                function=_enclosing_function_name(mod, node),
+                guards=_all_guards(mod, node),
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Violations + report
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class CollectiveViolation:
+    """One broken collective/SPMD invariant, named precisely."""
+
+    rule: str
+    path: str  # module path, or the sharding-case name
+    line: int
+    site: str  # tag / axis / spec being complained about
+    message: str
+
+    def __str__(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.rule}] {self.site}: "
+            f"{self.message}"
+        )
+
+
+@dataclasses.dataclass
+class CollectiveReport:
+    sites: List[CollectiveSite] = dataclasses.field(default_factory=list)
+    violations: List[CollectiveViolation] = dataclasses.field(
+        default_factory=list
+    )
+    cases_proven: List[str] = dataclasses.field(default_factory=list)
+    chains: int = 0
+    reachable_functions: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# --------------------------------------------------------------------- #
+# Sharding-spec pass (registry-driven; halo_verify.verify_member_mesh
+# delegates here)
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class ShardingCase:
+    """One mesh layout the CLI/dispatch admits, as static data (no
+    devices, no Mesh object — pure axis arithmetic)."""
+
+    name: str
+    mesh_axes: Dict[str, int]
+    spatial: Dict[int, object]  # grid axis -> mesh axis name / tuple
+    ndim: int = 3
+    member: bool = False  # an ensemble mesh (members axis required)
+    global_shape: Optional[Tuple[int, ...]] = None
+
+
+def mesh_layout_violations(
+    name: str,
+    mesh_axes: Dict[str, int],
+    spatial: Dict[int, object],
+    ndim: Optional[int] = None,
+    member: bool = True,
+    global_shape: Optional[Sequence[int]] = None,
+) -> List[Tuple[Optional[int], str, object, object]]:
+    """The ONE registry-driven mesh-layout checker: returns
+    ``(axis, what, expected, actual)`` rows (empty = proven).
+
+    Proves: the ``PartitionSpec`` the decomposition would build names
+    only axes the constructed mesh has; no mesh axis shards two grid
+    axes; the member axis (when required) exists, has extent >= 1 and
+    never shards a grid axis (member sharding is halo-free by
+    construction — a grid-axis mapping would be an undeclared
+    exchange); the ``ppermute`` participant set equals the
+    ``pmax``/``psum`` reduction set (both from
+    :func:`~..parallel.mesh.reduce_axis_names`, the single source);
+    sharded extents divide ``global_shape`` when given."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        MEMBER_AXIS,
+        Decomposition,
+        axis_extent,
+        reduce_axis_names,
+    )
+
+    out: List[Tuple[Optional[int], str, object, object]] = []
+
+    def bad(axis, what, expected, actual):
+        out.append((axis, what, expected, actual))
+
+    if member:
+        if MEMBER_AXIS not in mesh_axes:
+            bad(None, "ensemble mesh must carry a members axis",
+                f"'{MEMBER_AXIS}' in mesh", sorted(mesh_axes))
+            return out
+        if mesh_axes[MEMBER_AXIS] < 1:
+            bad(None, "member axis extent must be >= 1", ">= 1",
+                mesh_axes[MEMBER_AXIS])
+    seen: Dict[str, int] = {}
+    for ax, nm in sorted(spatial.items()):
+        names = tuple(nm) if isinstance(nm, (list, tuple)) else (nm,)
+        if MEMBER_AXIS in names:
+            bad(ax, "the members axis may not shard a grid axis "
+                    "(member sharding is halo-free; a grid-axis "
+                    "mapping would be an undeclared exchange)",
+                "spatial mesh axes only", nm)
+        for n in names:
+            if n != MEMBER_AXIS and n not in mesh_axes:
+                bad(ax, "spatial decomposition names a missing mesh "
+                        "axis", f"one of {sorted(mesh_axes)}", n)
+                continue
+            if n in seen and seen[n] != ax:
+                bad(ax, "mesh axis shards two grid axes (one ppermute "
+                        "neighborhood cannot serve two array "
+                        "dimensions)",
+                    f"{n!r} on one grid axis", f"axes {seen[n]} and {ax}")
+            seen[n] = ax
+        if ndim is not None and not (0 <= ax < ndim):
+            bad(ax, "spatial decomposition maps a grid axis outside "
+                    "the array rank", f"0 <= axis < {ndim}", ax)
+    clean = {
+        ax: nm for ax, nm in spatial.items()
+        if not any(
+            n == MEMBER_AXIS or n not in mesh_axes
+            for n in (tuple(nm) if isinstance(nm, (list, tuple))
+                      else (nm,))
+        )
+    }
+    decomp = Decomposition.of(clean)
+    # single-source reduction/ppermute participant set: the pmax/psum
+    # axis names the step would reduce over must be exactly the axes
+    # the halo exchange ppermutes over (extent > 1)
+    reduce_set = set(reduce_axis_names(decomp, mesh_axes))
+    permute_set = set()
+    for ax, nm in decomp.axes:
+        names = nm if isinstance(nm, tuple) else (nm,)
+        if axis_extent(mesh_axes, nm) > 1:
+            permute_set.update(n for n in names if mesh_axes.get(n, 1) > 1)
+    if reduce_set != permute_set:
+        bad(None, "pmax/psum reduction axes disagree with the ppermute "
+                  "participant set (a reduction spanning different "
+                  "shards than the exchange is silent corruption)",
+            sorted(permute_set), sorted(reduce_set))
+    if global_shape is not None:
+        for ax, nm in decomp.axes:
+            parts = axis_extent(mesh_axes, nm)
+            if ax < len(global_shape) and global_shape[ax] % parts:
+                bad(ax, "sharded extent does not divide the grid axis",
+                    f"{global_shape[ax]} % {parts} == 0",
+                    global_shape[ax] % parts)
+    return out
+
+
+def default_sharding_cases() -> List[ShardingCase]:
+    """The mesh layouts the CLI grammar (``parse_mesh_spec`` /
+    ``parse_ensemble_mesh``) and the dispatch admit, as static cases:
+    slab/pencil/block spatial meshes, the multi-host compound z axis,
+    and the member(-x-spatial) ensemble meshes of PR 11."""
+    return [
+        ShardingCase("slab[dz=4]", {"dz": 4}, {0: "dz"},
+                     global_shape=(48, 16, 16)),
+        ShardingCase("slab2d[dy=2]", {"dy": 2}, {0: "dy"}, ndim=2,
+                     global_shape=(32, 32)),
+        ShardingCase("pencil[dz=2,dy=2]", {"dz": 2, "dy": 2},
+                     {0: "dz", 1: "dy"}, global_shape=(24, 16, 16)),
+        ShardingCase("block[dz=2,dy=2,dx=2]",
+                     {"dz": 2, "dy": 2, "dx": 2},
+                     {0: "dz", 1: "dy", 2: "dx"},
+                     global_shape=(16, 16, 16)),
+        ShardingCase("multihost[dz_dcn=2,dz_ici=4]",
+                     {"dz_dcn": 2, "dz_ici": 4},
+                     {0: ("dz_dcn", "dz_ici")},
+                     global_shape=(24, 16, 16)),
+        ShardingCase("ensemble[members=8]", {"members": 8}, {},
+                     member=True),
+        ShardingCase("ensemble[members=4,dz=2]",
+                     {"members": 4, "dz": 2}, {0: "dz"}, member=True,
+                     global_shape=(24, 16, 16)),
+    ]
+
+
+def verify_sharding_cases(
+    cases: Optional[Sequence[ShardingCase]] = None,
+) -> Tuple[List[str], List[CollectiveViolation]]:
+    """Run the registry; returns ``(proven_case_names, violations)``."""
+    proven: List[str] = []
+    violations: List[CollectiveViolation] = []
+    for case in cases if cases is not None else default_sharding_cases():
+        rows = mesh_layout_violations(
+            case.name, case.mesh_axes, case.spatial, ndim=case.ndim,
+            member=case.member, global_shape=case.global_shape,
+        )
+        if not rows:
+            proven.append(case.name)
+        for axis, what, expected, actual in rows:
+            ax = "-" if axis is None else str(axis)
+            violations.append(CollectiveViolation(
+                rule="sharding-spec",
+                path=case.name,
+                line=0,
+                site=f"axis {ax}",
+                message=f"{what}: expected {expected}, got {actual}",
+            ))
+    return proven, violations
+
+
+# --------------------------------------------------------------------- #
+# Static schedule (alphabet + chains) and the dynamic cross-check
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class TagTemplate:
+    kind: str  # barrier | agree
+    template: str  # 'ckptd-begin:*' / 'checkpoint'
+
+    def match(self, tag) -> Optional[Tuple[str, ...]]:
+        """Captured wildcard values when ``tag`` matches, else None
+        (a non-wildcard template captures ``()``)."""
+        if not isinstance(tag, str):
+            return None
+        pattern = "^" + ".*".join(
+            re.escape(p) for p in self.template.split("*")
+        ) + "$"
+        m = re.match(pattern, tag)
+        if m is None:
+            return None
+        # re-capture the wildcard spans for chain-instance keying
+        cap_pattern = "^" + "(.*)".join(
+            re.escape(p) for p in self.template.split("*")
+        ) + "$"
+        cm = re.match(cap_pattern, tag)
+        return tuple(cm.groups()) if cm else ()
+
+
+@dataclasses.dataclass
+class StaticSchedule:
+    """What the extractor proved the code CAN rendezvous on."""
+
+    alphabet: List[TagTemplate]
+    #: ordered same-function same-guard tag sequences that any single
+    #: execution must respect (e.g. the ckptd begin/shards/commit
+    #: barriers of the checkpoint-commit protocol)
+    chains: List[List[TagTemplate]]
+
+    def lookup(self, kind: str, tag) -> Optional[TagTemplate]:
+        for t in self.alphabet:
+            if t.kind == kind and t.match(tag) is not None:
+                return t
+        return None
+
+
+def static_schedule(root: Optional[str] = None) -> StaticSchedule:
+    """Extract the package's barrier/agree schedule: the tag alphabet
+    and the straight-line chains (sites sharing one innermost function
+    and one guard context, ordered by source line)."""
+    alphabet: Dict[Tuple[str, str], TagTemplate] = {}
+    groups: Dict[Tuple[str, str, Tuple[str, ...]], List[CollectiveSite]] = {}
+    for mod in iter_modules(_root_or_package(root)):
+        for site in extract_sites(mod):
+            if site.kind not in ("barrier", "agree") or site.tag is None:
+                continue
+            key = (site.kind, site.tag)
+            if key not in alphabet:
+                alphabet[key] = TagTemplate(site.kind, site.tag)
+            groups.setdefault(
+                (site.path, site.function, site.guards), []
+            ).append(site)
+    chains = []
+    for sites in groups.values():
+        if len(sites) < 2:
+            continue
+        chain = [
+            TagTemplate(s.kind, s.tag)
+            for s in sorted(sites, key=lambda s: s.line)
+        ]
+        chains.append(chain)
+    return StaticSchedule(
+        alphabet=list(alphabet.values()), chains=chains
+    )
+
+
+def collective_sequence(events: Iterable[dict]) -> List[Tuple[str, str]]:
+    """Project a loaded telemetry stream onto the collective alphabet:
+    ``('barrier', tag)`` for ``sync:barrier`` events, ``('agree', tag)``
+    for ``resilience:agree`` — the measured per-rank schedule."""
+    seq = []
+    for e in events:
+        if e.get("kind") == "sync" and e.get("name") == "barrier":
+            seq.append(("barrier", e.get("tag")))
+        elif e.get("kind") == "resilience" and e.get("name") == "agree":
+            seq.append(("agree", e.get("tag")))
+    return seq
+
+
+def halo_counter_profile(events: Iterable[dict]) -> Dict[tuple, int]:
+    """Multiset of traced halo-exchange sites per stream — identical
+    across ranks when every rank traced the same programs."""
+    from multigpu_advectiondiffusion_tpu.parallel.halo import (
+        exchange_spec,
+    )
+
+    names = set(exchange_spec()["counters"])
+    out: Dict[tuple, int] = {}
+    for e in events:
+        if e.get("kind") == "counter" and e.get("name") in names:
+            mesh_axis = e.get("mesh_axis")
+            if isinstance(mesh_axis, list):  # compound (multi-host) axis
+                mesh_axis = tuple(mesh_axis)
+            key = (e.get("name"), e.get("axis"), mesh_axis)
+            out[key] = out.get(key, 0) + 1
+    return out
+
+
+def verify_trace(
+    sequences: Dict[object, List[Tuple[str, str]]],
+    schedule: Optional[StaticSchedule] = None,
+) -> List[str]:
+    """Prove measured per-rank collective sequences are a linearization
+    of the static schedule. Returns problem strings (empty = proven):
+
+    * every measured tag matches a statically extracted site (the
+      analysis models the code that actually ran);
+    * every rank measured the SAME sequence (rank-uniform execution —
+      the property the static pass proves, observed);
+    * every chain's tags appear in chain order per concrete instance
+      (``ckptd-begin:<dir>`` strictly before ``ckptd-shards:<dir>``
+      before ``ckptd-commit:<dir>``, cycling per checkpoint).
+    """
+    if schedule is None:
+        schedule = static_schedule()
+    problems: List[str] = []
+    for rank, seq in sorted(sequences.items(), key=lambda kv: str(kv[0])):
+        for kind, tag in seq:
+            if schedule.lookup(kind, tag) is None:
+                problems.append(
+                    f"rank {rank}: measured {kind} tag {tag!r} matches "
+                    "no statically extracted call site"
+                )
+    ranks = sorted(sequences, key=str)
+    if len(ranks) > 1:
+        base = sequences[ranks[0]]
+        for rank in ranks[1:]:
+            seq = sequences[rank]
+            if seq != base:
+                n = min(len(seq), len(base))
+                at = next(
+                    (i for i in range(n) if seq[i] != base[i]), n
+                )
+                a = base[at] if at < len(base) else "<end>"
+                b = seq[at] if at < len(seq) else "<end>"
+                problems.append(
+                    f"ranks {ranks[0]} and {rank} measured divergent "
+                    f"collective sequences at position {at}: "
+                    f"{a} vs {b}"
+                )
+    for chain in schedule.chains:
+        for rank, seq in sorted(
+            sequences.items(), key=lambda kv: str(kv[0])
+        ):
+            by_instance: Dict[tuple, List[int]] = {}
+            for kind, tag in seq:
+                for pos, t in enumerate(chain):
+                    if t.kind != kind:
+                        continue
+                    caps = t.match(tag)
+                    if caps is not None:
+                        by_instance.setdefault(caps, []).append(pos)
+                        break
+            for caps, poss in by_instance.items():
+                want = [
+                    i % len(chain) for i in range(len(poss))
+                ]
+                if poss != want:
+                    names = [t.template for t in chain]
+                    problems.append(
+                        f"rank {rank}: chain {names} instance "
+                        f"{caps!r} measured out of order: positions "
+                        f"{poss}, expected {want}"
+                    )
+    return problems
+
+
+# --------------------------------------------------------------------- #
+# Whole-tree pass
+# --------------------------------------------------------------------- #
+def _root_or_package(root: Optional[str]) -> str:
+    import os
+
+    if root is not None:
+        return root
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _call_graph(mods: List[ParsedModule]) -> Dict[str, Set[str]]:
+    """Name-level call graph: function name -> terminal names it
+    calls. Resolution is by terminal name (conservative: homonyms
+    over-connect, which can only make MORE sites reachable — the safe
+    direction for a dead-rendezvous check)."""
+    graph: Dict[str, Set[str]] = {}
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            calls = graph.setdefault(node.name, set())
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = _terminal_name(sub.func)
+                    if name:
+                        calls.add(name)
+    return graph
+
+
+def _reachable(graph: Dict[str, Set[str]]) -> Set[str]:
+    seen: Set[str] = set()
+    stack = [e for e in ENTRY_POINTS if e in graph]
+    while stack:
+        fn = stack.pop()
+        if fn in seen:
+            continue
+        seen.add(fn)
+        for callee in graph.get(fn, ()):
+            if callee in graph and callee not in seen:
+                stack.append(callee)
+    return seen
+
+
+def verify_tree(
+    root: Optional[str] = None,
+    cases: Optional[Sequence[ShardingCase]] = None,
+) -> CollectiveReport:
+    """The full static pass over a package tree: extract every
+    collective site, then prove tag uniqueness, join consistency,
+    declared-metadata drift and entry-point reachability (the last two
+    only against the installed package — fixture trees have no
+    declarations to drift from), plus the sharding-case registry.
+
+    Rank-guard violations per se are the job of the registered lint
+    rules (``rank-divergent-collective`` / ``rank-divergent-effect``),
+    which run in the same ``tpucfd-check`` invocation; this pass owns
+    the cross-module and whole-schedule properties."""
+    is_package = root is None
+    mods = list(iter_modules(_root_or_package(root)))
+    report = CollectiveReport()
+    by_tag: Dict[Tuple[str, str], List[CollectiveSite]] = {}
+    mod_of: Dict[str, ParsedModule] = {m.relpath: m for m in mods}
+    for mod in mods:
+        sites = extract_sites(mod)
+        report.sites.extend(sites)
+        for site in sites:
+            if site.kind in ("barrier", "agree") and site.tag is not None:
+                by_tag.setdefault((site.kind, site.tag), []).append(site)
+        report.violations.extend(_divergent_joins(mod))
+
+    # tag uniqueness: one rendezvous tag = one call site (a shadowed
+    # tag makes two distinct rendezvous points indistinguishable to
+    # the watchdog's suspect attribution and to verify_trace's chains)
+    for (kind, tag), sites in sorted(by_tag.items()):
+        if len(sites) < 2:
+            continue
+        for site in sites[1:]:
+            mod = mod_of.get(site.path)
+            if mod is not None and mod.suppressed(
+                site.line, "duplicate-collective-tag"
+            ):
+                continue
+            first = sites[0]
+            report.violations.append(CollectiveViolation(
+                rule="duplicate-collective-tag",
+                path=site.path,
+                line=site.line,
+                site=f"{kind}:{tag}",
+                message=(
+                    f"{kind} tag {tag!r} already issued at "
+                    f"{first.path}:{first.line} — every rendezvous tag "
+                    "must be unique per call site"
+                ),
+            ))
+
+    if is_package:
+        report.violations.extend(_declared_tag_drift(by_tag))
+        graph = _call_graph(mods)
+        reached = _reachable(graph)
+        report.reachable_functions = len(reached)
+        for site in report.sites:
+            if site.kind not in ("barrier", "agree"):
+                continue
+            if site.function != "<module>" and site.function not in reached:
+                report.violations.append(CollectiveViolation(
+                    rule="unreachable-collective",
+                    path=site.path,
+                    line=site.line,
+                    site=f"{site.kind}:{site.tag}",
+                    message=(
+                        f"rendezvous in {site.function}() is not "
+                        "reachable from any entry point — dead "
+                        "collective code escapes the dynamic "
+                        "cross-check; delete it or add the entry point"
+                    ),
+                ))
+
+    proven, sharding = verify_sharding_cases(cases)
+    report.cases_proven = proven
+    report.violations.extend(sharding)
+    report.chains = len(static_schedule(root).chains) if report.sites else 0
+    return report
+
+
+def _branch_schedule(mod: ParsedModule,
+                     stmts: Sequence[ast.AST]) -> List[Tuple[str, str]]:
+    out = []
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                kind = COLLECTIVE_CALLS.get(
+                    _terminal_name(node.func) or ""
+                )
+                if kind is not None:
+                    tag = None
+                    if kind in ("barrier", "agree") and node.args:
+                        tag = _tag_template(node.args[0])
+                    out.append((kind, tag or "<dynamic>"))
+    return out
+
+
+def _divergent_joins(mod: ParsedModule) -> List[CollectiveViolation]:
+    """Rank-dependent ``if`` statements whose two paths carry different
+    collective schedules: the ranks taking each branch arrive at the
+    join point having executed different rendezvous — the deadlock (or,
+    inside shard_map, corruption) the MPI reference can only discover
+    by hanging."""
+    taint = tainted_names(mod)
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.If):
+            continue
+        if not _expr_rank_dependent(node.test, taint.names_for(node)):
+            continue
+        body = _branch_schedule(mod, node.body)
+        orelse = _branch_schedule(mod, node.orelse)
+        if body == orelse:
+            continue
+        if mod.suppressed(node.lineno, "divergent-join"):
+            continue
+        out.append(CollectiveViolation(
+            rule="divergent-join",
+            path=mod.relpath,
+            line=node.lineno,
+            site=f"if {ast.unparse(node.test)}",
+            message=(
+                "branches of a rank-dependent conditional carry "
+                f"different collective schedules: {body or 'none'} vs "
+                f"{orelse or 'none'} — ranks reach the join point "
+                "having executed different rendezvous"
+            ),
+        ))
+    return out
+
+
+def _declared_tag_drift(
+    by_tag: Dict[Tuple[str, str], List[CollectiveSite]],
+) -> List[CollectiveViolation]:
+    """Both-directions drift guard between the extracted tag namespaces
+    and the issuing modules' declared collective metadata (the
+    ``stencil_spec()`` discipline): an undeclared tag is schema drift;
+    a declared-but-never-issued tag is a stale contract."""
+    from multigpu_advectiondiffusion_tpu.parallel.multihost import (
+        collective_spec,
+    )
+
+    declared = collective_spec()
+    out = []
+    for kind in ("barrier", "agree"):
+        extracted = {tag for (k, tag) in by_tag if k == kind}
+        known = set(declared.get(kind, ()))
+        for tag in sorted(extracted - known):
+            site = by_tag[(kind, tag)][0]
+            out.append(CollectiveViolation(
+                rule="undeclared-collective-tag",
+                path=site.path,
+                line=site.line,
+                site=f"{kind}:{tag}",
+                message=(
+                    f"{kind} tag {tag!r} is not declared in the "
+                    "issuing layer's collective metadata "
+                    "(multihost.collective_spec) — register it like a "
+                    "stencil_spec field"
+                ),
+            ))
+        for tag in sorted(known - extracted):
+            out.append(CollectiveViolation(
+                rule="stale-collective-tag",
+                path="parallel/multihost.py",
+                line=0,
+                site=f"{kind}:{tag}",
+                message=(
+                    f"declared {kind} tag {tag!r} has no issuing call "
+                    "site — stale collective metadata"
+                ),
+            ))
+    return out

@@ -62,6 +62,53 @@ RULE_FIXTURES = {
             "    return float(out.item())  # host side: after the loop\n"
         ),
     },
+    "rank-divergent-collective": {
+        "bad": (
+            "import jax\n"
+            "from multigpu_advectiondiffusion_tpu.parallel import "
+            "multihost\n"
+            "\n"
+            "def commit(path):\n"
+            "    if jax.process_index() == 0:\n"
+            "        multihost.barrier(f'commit:{path}')\n"
+        ),
+        "good": (
+            "import jax\n"
+            "from multigpu_advectiondiffusion_tpu.parallel import "
+            "multihost\n"
+            "\n"
+            "def commit(path):\n"
+            "    multihost.barrier(f'commit:{path}')\n"
+            "    if jax.process_index() == 0:\n"
+            "        print('committed', path)\n"
+        ),
+    },
+    "rank-divergent-effect": {
+        "bad": (
+            "import jax\n"
+            "import json\n"
+            "import os\n"
+            "\n"
+            "def publish(path, obj):\n"
+            "    is_coord = jax.process_index() == 0\n"
+            "    if is_coord:\n"
+            "        with open(path + '.tmp', 'w') as f:\n"
+            "            json.dump(obj, f)\n"
+            "        os.replace(path + '.tmp', path)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "import json\n"
+            "import os\n"
+            "\n"
+            "def publish(path, obj):\n"
+            "    with open(path + '.tmp', 'w') as f:\n"
+            "        json.dump(obj, f)\n"
+            "    os.replace(path + '.tmp', path)\n"
+            "    if jax.process_index() == 0:\n"
+            "        print('published', path)\n"
+        ),
+    },
     "closure-constant": {
         "bad": (
             "class Solver:\n"

@@ -162,7 +162,63 @@ def verify_stepper(stepper, kernel: Optional[str] = None
                    "sharding in one program", "unsharded", "sharded")
     elif mh not in (None, 0):
         bad(None, "declared member-axis halo must be 0", 0, mh)
+    out.extend(_verify_remote_dma(stepper, kern, spec))
     out.extend(_verify_slab_windows(stepper, kern, spec))
+    return out
+
+
+def _verify_remote_dma(stepper, kern: str, spec) -> List[HaloViolation]:
+    """Validate a declared in-kernel remote-DMA exchange window
+    (``stencil_spec()['remote_dma']``, ROADMAP item 2's contract,
+    landed ahead of the kernel — every shipped rung declares None).
+
+    The declaration an in-kernel exchange must satisfy before any
+    hardware run: the pushed window moves EXACTLY the rows the XLA
+    exchange moved (``window_rows == exchange_depth`` — fewer is a
+    stale-ghost read, more lands over live core rows: silent
+    corruption either way), it pushes along the slab axis only
+    (``axis == 0``, the one decomposition the slab rung serves), it is
+    at least double-buffered (``buffers >= 2`` — a single landing
+    buffer serializes the neighbor push against the compute it exists
+    to overlap, and worse, lets a fast neighbor overwrite rows the
+    local step is still reading), and it is declared on a sharded
+    instance (an unsharded stepper has no neighbor to push to)."""
+    dma = spec.get("remote_dma")
+    out: List[HaloViolation] = []
+    if dma is None:
+        return out
+
+    def bad(axis, what, expected, actual):
+        out.append(HaloViolation(kern, axis, what, expected, actual))
+
+    if not isinstance(dma, dict):
+        bad(None, "remote_dma declaration must be a dict",
+            "{'axis', 'window_rows', 'buffers'}", type(dma).__name__)
+        return out
+    missing = sorted(
+        {"axis", "window_rows", "buffers"} - set(dma)
+    )
+    if missing:
+        bad(None, "remote_dma declaration is missing fields",
+            "axis/window_rows/buffers", missing)
+        return out
+    depth = spec["exchange_depth"]
+    if dma["axis"] != 0:
+        bad(dma["axis"], "remote DMA must push along the slab "
+                         "decomposition axis", 0, dma["axis"])
+    if dma["window_rows"] != depth:
+        bad(0, "remote-DMA window disagrees with the exchange depth "
+               "(fewer rows = stale ghosts; more = the push lands "
+               "over live core rows)", depth, dma["window_rows"])
+    if dma["buffers"] < 2:
+        bad(0, "remote-DMA landing zone must be at least "
+               "double-buffered (a single buffer serializes the push "
+               "against the compute it overlaps, and a fast neighbor "
+               "overwrites rows still being read)", ">= 2",
+            dma["buffers"])
+    if not bool(getattr(stepper, "sharded", False)):
+        bad(None, "remote DMA declared on an unsharded stepper "
+                  "(no neighbor to push to)", "sharded", "unsharded")
     return out
 
 
@@ -177,34 +233,24 @@ def verify_member_mesh(name: str, mesh_axes: dict,
     halo-free by construction, so a member axis inside the spatial
     decomposition would be an undeclared exchange), and every spatial
     axis keeps its existing per-subgroup exchange contract (nothing
-    about the spatial halo arithmetic changes under the fold)."""
-    from multigpu_advectiondiffusion_tpu.parallel.mesh import MEMBER_AXIS
+    about the spatial halo arithmetic changes under the fold).
+
+    Since the collective-schedule round this is a thin wrapper over
+    the ONE registry-driven mesh-layout pass
+    (``analysis/collective_verify.mesh_layout_violations``), which
+    additionally proves PartitionSpec/ppermute/reduction-set
+    consistency for the spatial layouts the CLI admits."""
+    from multigpu_advectiondiffusion_tpu.analysis.collective_verify import (
+        mesh_layout_violations,
+    )
 
     res = ComboResult(name=name, admitted=True)
-
-    def bad(axis, what, expected, actual):
+    for axis, what, expected, actual in mesh_layout_violations(
+        name, mesh_axes, spatial, member=True
+    ):
         res.violations.append(
             HaloViolation(name, axis, what, expected, actual)
         )
-
-    if MEMBER_AXIS not in mesh_axes:
-        bad(None, "ensemble mesh must carry a members axis",
-            f"'{MEMBER_AXIS}' in mesh", sorted(mesh_axes))
-        return res
-    if mesh_axes[MEMBER_AXIS] < 1:
-        bad(None, "member axis extent must be >= 1", ">= 1",
-            mesh_axes[MEMBER_AXIS])
-    for ax, nm in sorted(spatial.items()):
-        names = nm if isinstance(nm, tuple) else (nm,)
-        if MEMBER_AXIS in names:
-            bad(ax, "the members axis may not shard a grid axis "
-                    "(member sharding is halo-free; a grid-axis "
-                    "mapping would be an undeclared exchange)",
-                "spatial mesh axes only", nm)
-        for n in names:
-            if n != MEMBER_AXIS and n not in mesh_axes:
-                bad(ax, "spatial decomposition names a missing mesh "
-                        "axis", f"one of {sorted(mesh_axes)}", n)
     return res
 
 

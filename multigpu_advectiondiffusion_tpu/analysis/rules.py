@@ -1,6 +1,6 @@
 """Domain lint rules for the AST engine (:mod:`framework`).
 
-Four invariants, each previously enforced in exactly one hand-written
+Six invariants, each previously enforced in exactly one hand-written
 place (or not at all):
 
 * ``closure-constant`` — the PR 9 ``build_local`` contract: a scalar a
@@ -23,7 +23,20 @@ place (or not at all):
   ``.counter(name)`` call sites the schema registry
   (``telemetry/schema.EVENT_REGISTRY``) does not know — the guard
   against silent schema drift, now one rule of the shared engine
-  instead of a private regex scanner.
+  instead of a private regex scanner;
+* ``rank-divergent-collective`` — a collective entry point (barrier /
+  agree / ppermute / psum / allgather / shard_map) under
+  ``process_index()``-dependent control flow: the MPI deadlock class —
+  one rank arrives at the rendezvous, its peer never will (taint
+  analysis shared with :mod:`collective_verify`, which owns the
+  cross-module schedule properties);
+* ``rank-divergent-effect`` — a persistent write or telemetry emission
+  inside a ``process_index()``-guarded branch without the audited
+  allow-pragma: the classic "rank 0 wrote the checkpoint, rank 1
+  committed it" hazard class. Intentional single-writer sites (the
+  coordinator's gathered-output publishes, the commit-marker protocol)
+  carry ``# tpucfd-check: allow[rank-divergent-effect]`` on the guard
+  with a comment stating why they are safe.
 """
 
 from __future__ import annotations
@@ -300,6 +313,134 @@ class HostSyncInTracedRule(Rule):
                     "sync out of the traced function or thread the "
                     "value in as an operand",
                 )
+
+
+# --------------------------------------------------------------------- #
+# rank-divergent-collective / rank-divergent-effect (taint analysis
+# shared with analysis/collective_verify, which owns the cross-module
+# schedule properties: duplicate tags, divergent joins, declared-tag
+# drift, sharding cases, the dynamic trace cross-check)
+# --------------------------------------------------------------------- #
+def _suppressed_at(mod: ParsedModule, rule: str, node: ast.AST,
+                   guards) -> bool:
+    """Pragma on the offending call, or — the audited idiom — on any
+    enclosing rank-dependent guard line (one audit covers the whole
+    single-writer block instead of one pragma per write)."""
+    if mod.suppressed(node.lineno, rule):
+        return True
+    return any(mod.suppressed(line, rule) for line, _ in guards)
+
+
+@register
+class RankDivergentCollectiveRule(Rule):
+    name = "rank-divergent-collective"
+    description = (
+        "collective entry point (barrier/agree/ppermute/psum/"
+        "allgather/shard_map) under process_index()-dependent control "
+        "flow — the MPI deadlock class: one rank arrives at the "
+        "rendezvous, its peer never will"
+    )
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        from multigpu_advectiondiffusion_tpu.analysis.collective_verify import (  # noqa: E501
+            COLLECTIVE_CALLS,
+            rank_guards,
+            tainted_names,
+        )
+
+        tainted = tainted_names(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = COLLECTIVE_CALLS.get(_terminal_name(node.func) or "")
+            if kind is None:
+                continue
+            guards = rank_guards(mod, node, tainted)
+            if not guards:
+                continue
+            if _suppressed_at(mod, self.name, node, guards):
+                continue
+            line, test = guards[0]
+            yield self.violation(
+                mod, node,
+                f"{kind} collective under the rank-dependent guard "
+                f"`if {test}` (line {line}): ranks that skip the "
+                "branch never reach this rendezvous — hoist the "
+                "collective out of the guard or make the guard "
+                "rank-uniform",
+            )
+
+
+@register
+class RankDivergentEffectRule(Rule):
+    name = "rank-divergent-effect"
+    description = (
+        "persistent write or telemetry emission inside a "
+        "process_index()-guarded branch without the audited "
+        "allow-pragma — the 'rank 0 wrote the checkpoint, rank 1 "
+        "committed it' hazard class"
+    )
+
+    #: writer helpers whose call IS a persistent effect
+    _WRITERS = {
+        "save_binary", "save_checkpoint", "save_checkpoint_sharded",
+        "atomic_write_text", "write_json",
+    }
+    _FS_MUTATORS = {"replace", "remove", "unlink", "rename"}
+
+    def _effects(self, mod: ParsedModule):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = _terminal_name(func)
+            if name in ("open", "fdopen"):
+                mode = None
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = _literal_str(kw.value)
+                if mode is None and len(node.args) >= 2:
+                    mode = _literal_str(node.args[1])
+                if mode and any(c in mode for c in "wx"):
+                    yield node, f"open(..., {mode!r})"
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr in self._FS_MUTATORS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("os", "_os")
+            ):
+                yield node, f"os.{func.attr}(...)"
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("event", "counter")
+            ):
+                yield node, f".{func.attr}(...) telemetry emission"
+            elif name in self._WRITERS:
+                yield node, f"{name}(...)"
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        from multigpu_advectiondiffusion_tpu.analysis.collective_verify import (  # noqa: E501
+            rank_guards,
+            tainted_names,
+        )
+
+        tainted = tainted_names(mod)
+        for node, what in self._effects(mod):
+            guards = rank_guards(mod, node, tainted)
+            if not guards:
+                continue
+            if _suppressed_at(mod, self.name, node, guards):
+                continue
+            line, test = guards[0]
+            yield self.violation(
+                mod, node,
+                f"{what} under the rank-dependent guard `if {test}` "
+                f"(line {line}): a peer that skips the branch sees a "
+                "world where the artifact/event both exists and "
+                "doesn't — audit it with the allow-pragma on the "
+                "guard (stating why single-writer is safe) or make "
+                "the effect rank-uniform",
+            )
 
 
 # --------------------------------------------------------------------- #

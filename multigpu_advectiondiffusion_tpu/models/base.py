@@ -47,6 +47,7 @@ from multigpu_advectiondiffusion_tpu.parallel.halo import (
 from multigpu_advectiondiffusion_tpu.parallel.mesh import (
     Decomposition,
     axis_extent,
+    reduce_axis_names,
     shard_map,
 )
 from multigpu_advectiondiffusion_tpu.timestepping.integrators import INTEGRATORS
@@ -213,14 +214,13 @@ class SolverBase:
     def mesh_reduce_max(self):
         """Cross-device max reduction for this solver's mesh (identity
         when unsharded / all extents 1). Must run inside ``shard_map``.
-        The single source of the pmax axis-name set — the generic step
-        and the fused steppers' adaptive dt must agree exactly."""
+        The pmax axis-name set comes from the ONE
+        ``parallel.mesh.reduce_axis_names`` source — the generic step,
+        the fused steppers' adaptive dt AND the static sharding pass
+        (``analysis/collective_verify``) must agree exactly."""
         if self.mesh is None:
             return None
-        sizes = dict(self.mesh.shape)
-        names = tuple(
-            n for n in self.decomp.mesh_axis_names() if sizes.get(n, 1) > 1
-        )
+        names = reduce_axis_names(self.decomp, self.mesh.shape)
         if not names:
             return None
         return lambda x: lax.pmax(x, names)
@@ -232,10 +232,7 @@ class SolverBase:
         run inside ``shard_map``; ``None`` when unsharded."""
         if self.mesh is None:
             return None
-        sizes = dict(self.mesh.shape)
-        names = tuple(
-            n for n in self.decomp.mesh_axis_names() if sizes.get(n, 1) > 1
-        )
+        names = reduce_axis_names(self.decomp, self.mesh.shape)
         if not names:
             return None
         return lambda x: lax.psum(x, names)

@@ -440,6 +440,11 @@ class _SlabRunStepper:
             "steps_per_exchange": int(self.steps_per_exchange),
             "members": int(self.members),
             "member_halo": int(self.member_halo),
+            # declared in-kernel remote-DMA window (ROADMAP item 2) —
+            # None while the deep exchange rides XLA ppermute between
+            # slab calls; the in-kernel rung will declare it and
+            # halo_verify proves it against exchange_depth up front
+            "remote_dma": getattr(self, "remote_dma", None),
         }
 
     def _check_members(self, members: int) -> int:

@@ -74,6 +74,18 @@ class FusedStepperBase:
     # than silently running the per-step cadence.
     steps_per_exchange = 1
 
+    #: in-kernel remote-DMA exchange contract (ROADMAP item 2), or
+    #: ``None`` (every shipped rung today: the exchange is an XLA
+    #: ppermute between compiled calls). A rung that moves its ghost
+    #: rows inside the Pallas program via ``pltpu.make_async_remote_
+    #: copy`` declares ``{"axis": 0, "window_rows": k*G,
+    #: "buffers": >=2}`` and the static halo verifier proves the
+    #: declaration against the exchange arithmetic BEFORE any hardware
+    #: run — where a schedule mismatch stops being a hang and becomes
+    #: silent corruption (a neighbor push landing over rows the
+    #: consumer already read).
+    remote_dma = None
+
     def stencil_spec(self) -> dict:
         """Queryable stencil/halo metadata — the ``R = 3``-style radius
         constants promoted to a contract the static verifier
@@ -83,7 +95,10 @@ class FusedStepperBase:
         per ghost refresh), ``ghost_depth`` (rows refreshed per
         exchange site, ``>= fused_stages * h``), ``exchange_depth``
         (rows ppermuted per exchange, ``k * ghost_depth``; None for
-        single-chip-only steppers), ``steps_per_exchange`` (k)."""
+        single-chip-only steppers), ``steps_per_exchange`` (k), and
+        ``remote_dma`` (the declared in-kernel exchange window, None
+        while the exchange rides XLA collectives — see the class
+        attribute)."""
         h = int(self.stencil_radius or self.halo)
         return {
             "kernel": self.engaged_label,
@@ -96,6 +111,7 @@ class FusedStepperBase:
             "steps_per_exchange": int(
                 getattr(self, "steps_per_exchange", 1) or 1
             ),
+            "remote_dma": getattr(self, "remote_dma", None),
         }
 
     def _dt_value(self, S):

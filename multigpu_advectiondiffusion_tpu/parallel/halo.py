@@ -31,6 +31,23 @@ from multigpu_advectiondiffusion_tpu.parallel.mesh import (
 )
 
 
+def exchange_spec() -> dict:
+    """Queryable exchange metadata (the ``stencil_spec()`` discipline
+    applied to the halo layer): one exchange site is two ``ppermute``
+    shifts per sharded axis, and every traced site records itself
+    through the listed counters — what the collective-schedule
+    verifier's dynamic cross-check (``analysis/collective_verify.
+    halo_counter_profile``) reads back out of per-rank streams to
+    assert every rank traced the same exchange sites."""
+    return {
+        "ppermute_shifts": 2,
+        "counters": (
+            "halo.exchanges_traced",
+            "halo.bytes_per_execution",
+        ),
+    }
+
+
 def exchange_ghosts(
     u: jnp.ndarray,
     axis: int,

@@ -81,6 +81,20 @@ def axis_extent(sizes, name) -> int:
     return sizes[name]
 
 
+def reduce_axis_names(decomp: "Decomposition", axis_sizes) -> Tuple[str, ...]:
+    """The pmax/psum axis-name set of a decomposition under the given
+    mesh extents: every individual mesh axis in use whose extent
+    exceeds 1. The SINGLE source of the cross-shard reduction set —
+    ``SolverBase.mesh_reduce_max``/``mesh_reduce_sum`` and the static
+    sharding pass (``analysis/collective_verify``) both derive from
+    here, so the reduction a step performs and the one the verifier
+    proves cannot fork."""
+    sizes = dict(axis_sizes)
+    return tuple(
+        n for n in decomp.mesh_axis_names() if sizes.get(n, 1) > 1
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class Decomposition:
     """Maps array axes of the grid to mesh axes.

@@ -213,6 +213,32 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+def collective_spec() -> Dict[str, tuple]:
+    """Queryable collective metadata — the ``stencil_spec()``
+    discipline applied to the host-collective layer: every
+    ``barrier``/``agree`` tag namespace the framework issues, declared
+    by its issuing module and aggregated here, plus the telemetry
+    events each rendezvous emits. The static collective-schedule
+    verifier (``analysis/collective_verify``) holds the extracted call
+    sites to this registry in BOTH directions (an undeclared tag is
+    schema drift; a declared-but-never-issued tag is a stale
+    contract), and its dynamic cross-check reads the listed events
+    back out of the 2-proc chaos streams. ``*`` in a tag is the
+    wildcard for a runtime interpolation (the checkpoint directory)."""
+    from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+        AGREE_TAGS,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.io import (
+        CKPTD_BARRIER_TAGS,
+    )
+
+    return {
+        "barrier": tuple(CKPTD_BARRIER_TAGS),
+        "agree": tuple(AGREE_TAGS),
+        "events": (("sync", "barrier"), ("resilience", "agree")),
+    }
+
+
 # --------------------------------------------------------------------- #
 # Rank-liveness watchdog + timeout-wrapped collectives.
 #

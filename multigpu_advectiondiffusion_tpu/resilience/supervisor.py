@@ -25,6 +25,15 @@ from multigpu_advectiondiffusion_tpu.resilience.sentinel import (
     duplicate_step_check,
 )
 
+#: declared agree-tag namespace of the supervised loop (queryable
+#: collective metadata, aggregated by ``parallel.multihost.
+#: collective_spec``): every coordinated decision this module asserts
+#: across ranks uses exactly one of these tags, and the static
+#: collective-schedule verifier holds the call sites to this list in
+#: both directions — a new ``_agree(...)`` tag must be declared here
+#: or ``tpucfd-check`` fails the tree
+AGREE_TAGS = ("checkpoint", "rollback")
+
 
 @dataclasses.dataclass
 class SupervisorReport:

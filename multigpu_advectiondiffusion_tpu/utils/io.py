@@ -601,6 +601,19 @@ def load_checkpoint(path: str, sharding=None) -> SolverState:
 
 _CKPTD_COMMIT = "COMMIT"
 
+#: declared barrier-tag namespace of the sharded checkpoint-commit
+#: protocol (queryable collective metadata, aggregated by
+#: ``parallel.multihost.collective_spec``; ``*`` = the checkpoint
+#: directory interpolation). Order matters: it IS the commit
+#: protocol's schedule, and the collective-schedule verifier's dynamic
+#: cross-check asserts every measured instance respects it
+#: (begin -> shards -> commit, per directory).
+CKPTD_BARRIER_TAGS = (
+    "ckptd-begin:*",
+    "ckptd-shards:*",
+    "ckptd-commit:*",
+)
+
 
 def save_checkpoint_sharded(
     directory: str,
@@ -632,6 +645,11 @@ def save_checkpoint_sharded(
     # directory is complete-or-uncommitted at every instant.
     commit_path = os.path.join(directory, _CKPTD_COMMIT)
     multi = jax.process_count() > 1
+    # Safe rank divergence: invalidating the stale COMMIT marker is a
+    # single-writer action by design (two ranks racing the same unlink
+    # is the bug), and the ckptd-begin barrier below orders it before
+    # any peer touches a shard byte.
+    # tpucfd-check: allow[rank-divergent-effect]
     if jax.process_index() == 0:
         try:
             os.remove(commit_path)
@@ -694,6 +712,13 @@ def save_checkpoint_sharded(
     # mid-checkpoint surfaces as RankFailureError, not a silent hang.
     if multi:
         multihost.barrier(f"ckptd-shards:{directory}")
+    # Safe rank divergence: the global manifest and the COMMIT marker
+    # have exactly one writer by design; the ckptd-shards barrier
+    # above guarantees every peer's shards are on disk first, and the
+    # ckptd-commit barrier below holds every peer until the commit
+    # landed — the "rank 0 wrote it, rank 1 committed it" hazard this
+    # rule exists for cannot occur between the two barriers.
+    # tpucfd-check: allow[rank-divergent-effect]
     if pid == 0:
         meta = {
             "global_shape": list(gshape),

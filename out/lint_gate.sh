@@ -5,13 +5,28 @@
 #   1. clean-tree pass — tpucfd-check must exit 0 on the shipped
 #      package: every AST lint rule silent (closure constants, host
 #      syncs in traced code, non-atomic artifact writes, unregistered
-#      telemetry emissions) and the stencil/halo verifier proving every
-#      admitted (rung, order, k) combination;
+#      telemetry emissions, rank-divergent collectives/effects), the
+#      stencil/halo verifier proving every admitted (rung, order, k)
+#      combination, and the collective-schedule verifier proving the
+#      distributed layer rank-uniform (unique rendezvous tags, no
+#      divergent joins, declared-tag drift, sharding-case registry);
 #   2. --selftest — every rule must TRIP on its seeded violation
-#      fixture (and pass the clean twin), and the halo verifier must
-#      fail an injected off-by-one ghost depth naming kernel/axis/depth
+#      fixture (and pass the clean twin), the halo verifier must fail
+#      an injected off-by-one ghost depth naming kernel/axis/depth,
+#      and the collective verifier must fail its seeded deadlock
+#      fixtures (rank-guarded barrier, duplicate tag, divergent join),
+#      sharding fixtures (bad PartitionSpec axis, member-in-spatial),
+#      a bad remote-DMA window, and a non-linearized measured schedule
 #      — so a green gate means "checked and clean", never "checker
 #      silently broke".
+#
+# The dynamic half of the collective proof — the 2-proc schedule
+# tracer asserting the MEASURED collective sequence linearizes the
+# static schedule — lives in tests/test_chaos.py
+# (test_schedule_tracer_matches_static_schedule); replay any captured
+# pair of streams by hand with:
+#   python -m multigpu_advectiondiffusion_tpu.analysis \
+#       --schedule-trace run/events_p0.jsonl run/events_p1.jsonl
 #
 #   ./out/lint_gate.sh              # both halves
 #   ./out/lint_gate.sh --selftest   # selftest only
