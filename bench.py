@@ -714,6 +714,10 @@ def main() -> None:
             # whose configuration was MEASURED into place says so, and
             # says what the tuner picked (ISSUE 4)
             "steps_per_exchange": engaged.get("steps_per_exchange", 1),
+            # halo transport actually engaged (collective ppermute vs
+            # in-kernel remote DMA) — sharded rows only ever publish
+            # the transport that really ran (ISSUE 13)
+            "exchange": engaged.get("exchange", "collective"),
             "tuned": engaged.get("tuned"),
             "roofline_pct": (cost or {}).get("roofline_pct"),
             # measured XLA columns (per step; peak_bytes = executable
@@ -771,6 +775,21 @@ def main() -> None:
             row["engagement_error"] = {
                 "tuned_below_baseline": row.get("tuned")
             }
+            mismatches.append(row["metric"])
+        print(json.dumps(row), flush=True)
+
+    # In-kernel halo exchange head-to-head (ISSUE 13): the dma rung vs
+    # the split-overlap collective rung, pinned, on the 2-way z-slab
+    # mesh (the reference's own 2-GPU artifact shape). A dma row that
+    # SILENTLY degraded off the in-kernel transport fails the run; a
+    # config that declined loudly (e.g. no dma-capable backend) is
+    # recorded as declined, not failed.
+    from multigpu_advectiondiffusion_tpu.bench.scaling import (
+        exchange_head_to_head_rows,
+    )
+
+    for row in exchange_head_to_head_rows(on_tpu=on_tpu):
+        if row.get("engagement_error"):
             mismatches.append(row["metric"])
         print(json.dumps(row), flush=True)
 

@@ -242,6 +242,36 @@ def selftest(out=print) -> bool:
         ok = False
     else:
         out(f"  ok: bad remote-DMA window trips ({dma[0]})")
+    # ...the SHIPPED dma rung's declaration proves clean, and an
+    # injected overlapping recv window — a neighbor push landing over
+    # rows the receiver is still computing, the silent-corruption race
+    # — is rejected naming kernel/axis/rows
+    dma_combo = next(
+        c for c in halo_verify.default_combos()
+        if c.name == "slab-diffusion[k=2,dma]"
+    )
+    shipped = dma_combo.build()
+    if halo_verify.verify_stepper(shipped, kernel=dma_combo.name):
+        out("FAIL: the shipped in-kernel dma declaration was rejected")
+        ok = False
+    depth = shipped.exchange_depth
+    shipped.remote_dma = dict(shipped.remote_dma)
+    shipped.remote_dma["recv_windows"] = (
+        (depth, 2 * depth),  # lands ON core rows — must be rejected
+        shipped.remote_dma["recv_windows"][1],
+    )
+    overlap = halo_verify.verify_stepper(shipped, kernel=dma_combo.name)
+    named = [v for v in overlap if "overlaps the receiver's core"
+             in v.what]
+    if not named:
+        out("FAIL: overlapping dma recv window was not rejected")
+        ok = False
+    elif named[0].axis != 0 or str(depth) not in str(named[0]):
+        out("FAIL: overlapping-window violation does not name "
+            "axis/rows")
+        ok = False
+    else:
+        out(f"  ok: overlapping dma window trips ({named[0]})")
     # ...and the dynamic cross-check rejects a non-linearization
     schedule = collective_verify.static_schedule()
     good = [("barrier", "ckptd-begin:/r"),

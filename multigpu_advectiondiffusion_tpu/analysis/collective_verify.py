@@ -97,6 +97,16 @@ COLLECTIVE_CALLS = {
     "pmax": "reduce",
     "psum": "reduce",
     "shard_map": "shard_map",
+    # in-kernel ICI exchange (ops/pallas/fused_slab_run, ISSUE 13):
+    # a remote DMA is a rendezvous too — a rank-divergent start is the
+    # same deadlock class as a rank-guarded barrier (and the interpret
+    # simulator's discharge rule literally requires lockstep SPMD
+    # issue), so the sites are extracted and held to the same
+    # rank-uniformity proofs. The dma rung REPLACES the ppermute site:
+    # its declared metadata rides halo.remote_dma_spec(), aggregated
+    # in multihost.collective_spec()['remote_dma'] and drift-guarded
+    # both directions like the barrier/agree tag namespaces.
+    "make_async_remote_copy": "remote_dma",
 }
 
 #: entry points the interprocedural reachability walk starts from: the
@@ -526,6 +536,11 @@ def default_sharding_cases() -> List[ShardingCase]:
                      {"dz_dcn": 2, "dz_ici": 4},
                      {0: ("dz_dcn", "dz_ici")},
                      global_shape=(24, 16, 16)),
+        # the in-kernel remote-DMA rung rides the same z-slab layout;
+        # registered as its own case so the registry records that the
+        # dma transport's participant ring IS the slab ppermute set
+        ShardingCase("slab[dz=2,exchange=dma]", {"dz": 2}, {0: "dz"},
+                     global_shape=(48, 16, 16)),
         ShardingCase("ensemble[members=8]", {"members": 8}, {},
                      member=True),
         ShardingCase("ensemble[members=4,dz=2]",
@@ -651,9 +666,14 @@ def halo_counter_profile(events: Iterable[dict]) -> Dict[tuple, int]:
     across ranks when every rank traced the same programs."""
     from multigpu_advectiondiffusion_tpu.parallel.halo import (
         exchange_spec,
+        remote_dma_spec,
     )
 
+    # BOTH transports: ppermute counters and the in-kernel remote-DMA
+    # counters — a dma-mode stream profiles rank-uniform without the
+    # verifier reading the absent ppermute pair as a divergence
     names = set(exchange_spec()["counters"])
+    names |= set(remote_dma_spec()["counters"])
     out: Dict[tuple, int] = {}
     for e in events:
         if e.get("kind") == "counter" and e.get("name") in names:
@@ -833,6 +853,7 @@ def verify_tree(
 
     if is_package:
         report.violations.extend(_declared_tag_drift(by_tag))
+        report.violations.extend(_declared_remote_dma_drift(report.sites))
         graph = _call_graph(mods)
         reached = _reachable(graph)
         report.reachable_functions = len(reached)
@@ -906,6 +927,52 @@ def _divergent_joins(mod: ParsedModule) -> List[CollectiveViolation]:
                 f"different collective schedules: {body or 'none'} vs "
                 f"{orelse or 'none'} — ranks reach the join point "
                 "having executed different rendezvous"
+            ),
+        ))
+    return out
+
+
+def _declared_remote_dma_drift(
+    sites: Sequence[CollectiveSite],
+) -> List[CollectiveViolation]:
+    """Both-directions drift guard for the in-kernel remote-DMA
+    transport: the kernel's ``make_async_remote_copy`` sites and the
+    declared metadata (``multihost.collective_spec()['remote_dma']``,
+    sourced from ``parallel.halo.remote_dma_spec``) must agree — the
+    dma rung replaced the ppermute site, and the registry must KNOW
+    that, or the dynamic cross-check would read a dma stream's missing
+    ppermute counters as a stale expectation."""
+    from multigpu_advectiondiffusion_tpu.parallel.multihost import (
+        collective_spec,
+    )
+
+    declared = collective_spec().get("remote_dma")
+    dma_sites = [s for s in sites if s.kind == "remote_dma"]
+    out: List[CollectiveViolation] = []
+    if dma_sites and not declared:
+        s = dma_sites[0]
+        out.append(CollectiveViolation(
+            rule="undeclared-remote-dma",
+            path=s.path,
+            line=s.line,
+            site="remote_dma",
+            message=(
+                "in-kernel remote-DMA site has no declared transport "
+                "metadata (multihost.collective_spec()['remote_dma'] "
+                "/ parallel.halo.remote_dma_spec) — register it like "
+                "a stencil_spec field"
+            ),
+        ))
+    if declared and not dma_sites:
+        out.append(CollectiveViolation(
+            rule="stale-remote-dma",
+            path="parallel/halo.py",
+            line=0,
+            site="remote_dma",
+            message=(
+                "declared remote-DMA transport has no "
+                "make_async_remote_copy site — stale collective "
+                "metadata"
             ),
         ))
     return out

@@ -219,6 +219,46 @@ def _verify_remote_dma(stepper, kern: str, spec) -> List[HaloViolation]:
     if not bool(getattr(stepper, "sharded", False)):
         bad(None, "remote DMA declared on an unsharded stepper "
                   "(no neighbor to push to)", "sharded", "unsharded")
+    # --- send/recv window disjointness + semaphore pairing (the
+    # shipped kernel's full declaration; minimal declarations that
+    # predate the kernel carry only axis/window_rows/buffers) ---
+    interior = tuple(getattr(stepper, "interior_shape", ()) or ())
+    core = None
+    if interior and depth is not None:
+        core = (depth, depth + interior[0])  # padded rows the shard computes
+    rows = dma["window_rows"]
+    for side, win in zip(("lo", "hi"), dma.get("send_windows") or ()):
+        lo, hi = int(win[0]), int(win[1])
+        if hi - lo != rows:
+            bad(0, f"send window ({side}) width disagrees with the "
+                   "declared push size", rows, hi - lo)
+        if core is not None and not (core[0] <= lo and hi <= core[1]):
+            bad(0, f"send window ({side}) reads outside the shard's "
+                   "own core (a push sourcing ghost rows forwards a "
+                   "neighbor's data as if it were this shard's)",
+                f"within core [{core[0]}, {core[1]})", f"[{lo}, {hi})")
+    for side, win in zip(("lo", "hi"), dma.get("recv_windows") or ()):
+        lo, hi = int(win[0]), int(win[1])
+        if hi - lo != rows:
+            bad(0, f"recv window ({side}) width disagrees with the "
+                   "declared push size", rows, hi - lo)
+        if core is not None and not (hi <= core[0] or lo >= core[1]):
+            # THE disjointness proof: pushed rows must never land over
+            # rows the receiving shard computes — an overlap is the
+            # silent-corruption race this mode turns a hang into
+            bad(0, f"recv window ({side}) overlaps the receiver's "
+                   "core rows (a neighbor's push would land over rows "
+                   "the local step is still computing)",
+                f"disjoint from core [{core[0]}, {core[1]})",
+                f"[{lo}, {hi})")
+    sems = dma.get("semaphores")
+    if sems is not None:
+        have = set(sems)
+        if not {"send", "recv"} <= have:
+            bad(0, "remote-DMA semaphores must pair a send and a recv "
+                   "(an unpaired copy either never signals the "
+                   "receiver or never releases the source rows)",
+                "('send', 'recv')", tuple(sems))
     return out
 
 
@@ -505,11 +545,15 @@ def default_combos() -> List[Combo]:
     ))
 
     def slab_diff(k=1, split=False, shape=(24, 10, 12), sharded=True,
-                  members=1):
+                  members=1, dma=False):
+        kw = {}
+        if dma:
+            kw = {"exchange": "dma", "mesh_axis": "dz", "num_shards": 2}
         return SlabRunDiffusionStepper(
             shape, f32, _spacing(3), [1.0] * 3, 1e-4, 2, 0.0,
             global_shape=(shape[0] * 2,) + shape[1:] if sharded else None,
             overlap_split=split, steps_per_exchange=k, members=members,
+            **kw,
         )
 
     combos.append(Combo(
@@ -534,6 +578,13 @@ def default_combos() -> List[Combo]:
         combos.append(Combo(
             f"slab-diffusion[k={k},split]",
             lambda k=k: slab_diff(k=k, split=True),
+        ))
+        # in-kernel remote-DMA transport (ISSUE 13): the shipped
+        # declaration — window arithmetic, send/recv disjointness,
+        # semaphore pairing — proven per admitted cadence
+        combos.append(Combo(
+            f"slab-diffusion[k={k},dma]",
+            lambda k=k: slab_diff(k=k, dma=True),
         ))
 
     for order in (5, 7):
@@ -565,12 +616,16 @@ def default_combos() -> List[Combo]:
             ),
         ))
 
-        def slab_burg(k=1, split=False, order=order):
+        def slab_burg(k=1, split=False, order=order, dma=False):
             shape = (36, 16, 64)
+            kw = {}
+            if dma:
+                kw = {"exchange": "dma", "mesh_axis": "dz",
+                      "num_shards": 2}
             return SlabRunBurgersStepper(
                 shape, f32, _spacing(3), _burg(), "js", 0.0, 1e-3,
                 global_shape=(72,) + shape[1:], order=order,
-                overlap_split=split, steps_per_exchange=k,
+                overlap_split=split, steps_per_exchange=k, **kw,
             )
 
         combos.append(Combo(
@@ -587,7 +642,7 @@ def default_combos() -> List[Combo]:
                 1e-3, order=order, members=4,
             ),
         ))
-        for k in (1, 2):
+        for k in (1, 2, 3):
             combos.append(Combo(
                 f"slab-burgers[o{order},k={k}]",
                 lambda k=k, order=order: slab_burg(k=k, order=order),
@@ -596,6 +651,12 @@ def default_combos() -> List[Combo]:
                 f"slab-burgers[o{order},k={k},split]",
                 lambda k=k, order=order: slab_burg(
                     k=k, split=True, order=order
+                ),
+            ))
+            combos.append(Combo(
+                f"slab-burgers[o{order},k={k},dma]",
+                lambda k=k, order=order: slab_burg(
+                    k=k, dma=True, order=order
                 ),
             ))
     return combos

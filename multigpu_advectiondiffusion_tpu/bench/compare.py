@@ -57,6 +57,24 @@ MEASURED_FIELDS = ("xla_flops", "xla_bytes", "peak_bytes")
 # their absence is never a coverage regression.
 ENSEMBLE_FIELDS = ("ensemble", "vs_looped", "member_sharding", "devices")
 
+# Halo-transport column (ISSUE 13): ``exchange`` records which halo
+# transport a sharded slab row ran — "collective" (XLA ppermute
+# between compiled calls) or "dma" (in-kernel remote-DMA pushes, the
+# whole-run program never leaving Pallas). Same coverage-note
+# discipline: provenance, not gated throughput; rows from rounds
+# before the dma rung carry no field and read as "collective".
+SCHEDULE_FIELDS = ("exchange",)
+
+
+def row_exchange(row: Optional[dict]) -> str:
+    """A row's halo transport; rounds before ISSUE 13 read as the
+    collective default — never a parse error, never a coverage
+    regression."""
+    if not row:
+        return "collective"
+    v = row.get("exchange")
+    return str(v) if v else "collective"
+
 
 def parse_rows(text: str) -> List[dict]:
     """JSON-lines -> row dicts; unparseable lines (the truncated head
@@ -223,12 +241,21 @@ def compare(
             results.append(RowResult(key, "missing",
                                      old=row_value(old)))
             continue
-        for field in MEASURED_FIELDS + ENSEMBLE_FIELDS:
+        for field in MEASURED_FIELDS + ENSEMBLE_FIELDS + SCHEDULE_FIELDS:
             if old.get(field) is not None and new.get(field) is None:
                 notes.append(
                     f"{key}: measured column {field!r} dropped "
                     "(coverage note, non-gating)"
                 )
+        if row_exchange(old) != row_exchange(new):
+            # the same metric measured over a different halo transport
+            # is a different schedule: surfaced, non-gating (the rate
+            # comparison stays — same physics, same work)
+            notes.append(
+                f"{key}: halo transport changed "
+                f"{row_exchange(old)} -> {row_exchange(new)} "
+                "(coverage note, non-gating)"
+            )
         if row_members(old) != row_members(new):
             # a row measured at a different member count is a different
             # workload: flag it as a note (the metric NAME carries the

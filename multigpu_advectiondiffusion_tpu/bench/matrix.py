@@ -347,6 +347,9 @@ def run_case(
         # comm-avoiding cadence in effect + tuner provenance (non-None
         # exactly when impl resolved through "auto")
         "steps_per_exchange": engaged.get("steps_per_exchange", 1),
+        # halo transport actually engaged: collective ppermute or the
+        # in-kernel remote-DMA ring (ISSUE 13)
+        "exchange": engaged.get("exchange", "collective"),
         "tuned": engaged.get("tuned"),
         "seconds": round(best, 4),
         "compile_seconds": round(compile_s, 3),

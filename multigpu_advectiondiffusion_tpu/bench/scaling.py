@@ -158,7 +158,124 @@ def scaling_rows(
                     "steps_per_exchange": engaged.get(
                         "steps_per_exchange", 1
                     ),
+                    # halo transport actually engaged (ISSUE 13)
+                    "exchange": engaged.get("exchange", "collective"),
                     "tuned": engaged.get("tuned"),
                 }
             )
+    return rows
+
+
+def exchange_head_to_head_rows(
+    devices: Sequence | None = None,
+    on_tpu: bool | None = None,
+    models: Sequence[str] = ("diffusion3d", "burgers3d"),
+    reps: int = 5,
+) -> list:
+    """The dma-vs-split-overlap halo-transport head-to-head
+    (ISSUE 13): the same workload, same 2-way z-slab mesh (the
+    reference's own 2-GPU artifact shape), pinned to the slab rung —
+    once with the split-overlap XLA collective exchange, once with the
+    in-kernel remote-DMA exchange. Metric pair
+    ``{model}_dz2_halo_{split|dma}_mlups``.
+
+    Engagement guard: the dma row must have ACTUALLY run the in-kernel
+    transport — a silent degrade back to the collective exchange gets
+    an ``engagement_error`` (bench.py fails the run on it). A loud
+    decline (a backend with neither the Mosaic TPU target nor the CPU
+    interpret simulator) is recorded as a ``declined`` row instead:
+    unservable is a fact, not a regression.
+    """
+    import dataclasses
+
+    import jax
+
+    from multigpu_advectiondiffusion_tpu.models.burgers import BurgersSolver
+    from multigpu_advectiondiffusion_tpu.models.diffusion import (
+        DiffusionSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+
+    devices = list(devices if devices is not None else jax.devices())
+    if on_tpu is None:
+        on_tpu = devices[0].platform != "cpu"
+    rows = []
+    if len(devices) < 2:
+        return rows
+    configs = _configs(on_tpu)
+    for model in models:
+        cfg, iters, baseline = configs[model]
+        if cfg.grid.shape[0] % 2:
+            continue
+        solver_cls = (
+            DiffusionSolver if model.startswith("diffusion")
+            else BurgersSolver
+        )
+        pair = (
+            ("split", dataclasses.replace(
+                cfg, impl="pallas_slab", overlap="split",
+                exchange="collective",
+            )),
+            ("dma", dataclasses.replace(
+                cfg, impl="pallas_slab", overlap="padded",
+                exchange="dma",
+            )),
+        )
+        for name, pcfg in pair:
+            metric = f"{model}_dz2_halo_{name}_mlups"
+            try:
+                solver = solver_cls(
+                    pcfg,
+                    mesh=make_mesh({"dz": 2}, devices=devices[:2]),
+                    decomp=Decomposition.slab("dz"),
+                )
+                engaged = solver.engaged_path("iters")
+                timing = timed_run(
+                    solver, solver.initial_state(), iters, reps=reps
+                )
+            except ValueError as exc:
+                rows.append({
+                    "metric": metric,
+                    "declined": f"{exc}"[:200],
+                })
+                continue
+            stages = STAGES.get(pcfg.integrator, 3)
+            rate = mlups(pcfg.grid.num_cells, iters, stages,
+                         timing.median_seconds)
+            row = {
+                "metric": metric,
+                "value": round(rate, 2),
+                "unit": "MLUPS",
+                "vs_baseline": round(rate / baseline, 3),
+                "per_chip": round(rate / 2, 2),
+                "devices": 2,
+                "spread": round(timing.spread, 4),
+                "outliers": timing.outliers,
+                "raw_spread": round(timing.raw_spread, 4),
+                "engaged": (
+                    engaged["stepper"]
+                    + (f"+{engaged['overlap']}"
+                       if engaged.get("overlap") else "")
+                ),
+                "steps_per_exchange": engaged.get(
+                    "steps_per_exchange", 1
+                ),
+                "exchange": engaged.get("exchange", "collective"),
+            }
+            if name == "dma" and (
+                engaged.get("exchange") != "dma"
+                or engaged.get("degraded")
+            ):
+                row["engagement_error"] = {
+                    "expected_exchange": "dma",
+                    "engaged_exchange": engaged.get("exchange"),
+                    "degraded": engaged.get("degraded"),
+                }
+            rows.append(row)
     return rows

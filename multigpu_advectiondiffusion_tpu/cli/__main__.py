@@ -233,6 +233,18 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "every step — sharded z-slab slab-rung runs "
                         "only; 1 = the reference's per-step MPI cadence; "
                         "with --impl auto the tuner picks K")
+    p.add_argument("--exchange", choices=["collective", "dma"],
+                   default="collective",
+                   help="halo-exchange transport for sharded slab-rung "
+                        "runs: collective = XLA ppermute between "
+                        "compiled calls (default, the reference's MPI "
+                        "shape); dma = in-kernel remote DMA — the "
+                        "sharded whole-run Pallas program pushes its "
+                        "ghost rows to the ±z neighbors itself and "
+                        "never returns to XLA between steps (z-slab "
+                        "meshes, TPU backend or the CPU interpret "
+                        "simulator; validated loudly like --impl pins; "
+                        "with --impl auto the tuner picks it)")
     p.add_argument("--tune", action="store_true",
                    help="allow the --impl auto tuner to MEASURE on a "
                         "cache miss: time the (rung x K) candidate "
@@ -329,6 +341,7 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
         impl=args.impl,
         overlap=args.overlap,
         steps_per_exchange=args.steps_per_exchange,
+        exchange=args.exchange,
     )
     name = f"diffusion{ndim}d" if geometry == "cartesian" else "diffusion_axisym"
     if args.ensemble and args.ensemble > 1:
@@ -389,6 +402,7 @@ def _run_burgers(args, ndim):
         impl=args.impl,
         overlap=args.overlap,
         steps_per_exchange=args.steps_per_exchange,
+        exchange=args.exchange,
     )
     if args.ensemble and args.ensemble > 1:
         return run_ensemble_solver(BurgersSolver, cfg, f"burgers{ndim}d",

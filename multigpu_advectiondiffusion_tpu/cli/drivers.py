@@ -74,11 +74,11 @@ def physics_meta(solver: SolverBase) -> dict:
     state exists), and kernel-strategy knobs that cannot change results."""
     import dataclasses
 
-    # steps_per_exchange is a kernel-strategy knob like impl/overlap: it
-    # changes the exchange cadence, not the physics a checkpoint
-    # continues under
+    # steps_per_exchange/exchange are kernel-strategy knobs like
+    # impl/overlap: they change the exchange cadence/transport, not the
+    # physics a checkpoint continues under
     skip = {"grid", "ic", "ic_params", "impl", "overlap",
-            "steps_per_exchange"}
+            "steps_per_exchange", "exchange"}
     out = {}
     for f in dataclasses.fields(solver.cfg):
         if f.name in skip:

@@ -114,8 +114,10 @@ class SolverBase:
                 cfg,
                 impl=decision["impl"],
                 steps_per_exchange=decision.get("steps_per_exchange", 1),
+                exchange=decision.get("exchange", "collective"),
             )
         self._validate_steps_per_exchange()
+        self._validate_exchange()
 
     def _validate_steps_per_exchange(self) -> None:
         """Gate the communication-avoiding chunk knob the way impl
@@ -147,6 +149,88 @@ class SolverBase:
                 f"(impl='pallas'/'pallas_slab'/'auto'), not "
                 f"impl={self.cfg.impl!r}"
             )
+
+    def _exchange_mode(self) -> str:
+        return str(getattr(self.cfg, "exchange", "collective")
+                   or "collective")
+
+    def _validate_exchange(self) -> None:
+        """Gate the halo-exchange transport knob the way impl strings
+        and ``steps_per_exchange`` are gated: a config that cannot
+        honor ``exchange='dma'`` (the in-kernel remote-DMA whole-run
+        rung) fails at construction instead of silently running the
+        XLA collective cadence. Backend/VMEM eligibility is enforced
+        at dispatch by ``_select_slab``, which raises rather than
+        declines when dma is requested."""
+        if self._exchange_mode() != "dma":
+            return
+        if self.grid.ndim != 3:
+            raise ValueError(
+                "exchange='dma' rides the 3-D sharded slab rung only"
+            )
+        if self.mesh is None:
+            raise ValueError(
+                "exchange='dma' pushes ghost rows between z neighbors "
+                "— it needs a device mesh (an unsharded run has no "
+                "neighbor to push to)"
+            )
+        if any(ax != 0 for ax in self._sharded_axes()):
+            raise ValueError(
+                "exchange='dma' serves z-slab decompositions only"
+            )
+        if self.cfg.impl not in ("pallas", "pallas_slab"):
+            raise ValueError(
+                "exchange='dma' needs the sharded slab rung "
+                "(impl='pallas'/'pallas_slab'/'auto'), not "
+                f"impl={self.cfg.impl!r}"
+            )
+        if getattr(self.cfg, "overlap", None) == "split":
+            raise ValueError(
+                "exchange='dma' replaces the XLA exchange entirely — "
+                "the split-overlap schedule does not compose with it "
+                "(drop overlap='split')"
+            )
+        name = self.decomp.mesh_axis(0)
+        if not isinstance(name, str):
+            raise ValueError(
+                "exchange='dma' cannot ride a compound (multihost) "
+                "mesh axis — remote DMA moves over ICI, not DCN"
+            )
+        if len(dict(self.mesh.shape)) != 1:
+            raise ValueError(
+                "exchange='dma' serves single-axis z-slab meshes: the "
+                "remote-DMA ring addresses logical device ids along "
+                "ONE mesh axis"
+            )
+        if jax.process_count() > 1:
+            raise ValueError(
+                "exchange='dma' is single-process (ICI) only — "
+                "multihost z layouts keep the collective exchange"
+            )
+
+    @staticmethod
+    def _dma_backend_ok() -> bool:
+        """Whether this process can execute the in-kernel remote-DMA
+        program: the Mosaic TPU target, or the CPU backend's interpret
+        simulator (which models the remote copies — the tier-1 test
+        surface). GPU has neither."""
+        from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+            interpret_mode,
+        )
+
+        return jax.default_backend() == "tpu" or interpret_mode()
+
+    def _dma_stepper_kwargs(self) -> dict:
+        """Constructor kwargs arming a slab stepper's in-kernel
+        exchange: the (validated, single, string) z mesh axis and its
+        shard count."""
+        sizes = dict(self.mesh.shape)
+        name = self.decomp.mesh_axis(0)
+        return {
+            "exchange": "dma",
+            "mesh_axis": name,
+            "num_shards": axis_extent(sizes, name),
+        }
 
     # ------------------------------------------------------------------ #
     # To be provided by subclasses
@@ -447,7 +531,33 @@ class SolverBase:
             is_kernel_failure,
         )
 
-        if self._requested_impl != "pallas" or not is_kernel_failure(exc):
+        if not is_kernel_failure(exc):
+            return False
+        if self._exchange_mode() == "dma":
+            # the in-kernel remote-DMA rung has its own ladder: a
+            # Mosaic rejection of the dma program degrades to the
+            # split-overlap XLA exchange on the SAME rung/cadence —
+            # same physics, same k-schedule, comm back between
+            # compiled calls — rather than failing the run
+            ev = {
+                "from": "fused-whole-run-slab[dma]",
+                "to": "fused-whole-run-slab[split]",
+                "reason": f"{type(exc).__name__}: {exc}"[:300],
+            }
+            self._degrade_events.append(ev)
+            from multigpu_advectiondiffusion_tpu import telemetry
+
+            telemetry.event(
+                "ladder", "degrade",
+                **{"from": ev["from"], "to": ev["to"],
+                   "reason": ev["reason"]},
+            )
+            self.cfg = dataclasses.replace(
+                self.cfg, exchange="collective", overlap="split"
+            )
+            self._cache.clear()
+            return True
+        if self._requested_impl != "pallas":
             return False
         if int(getattr(self.cfg, "steps_per_exchange", 1) or 1) > 1:
             # the k-step schedule exists only on the slab rung: falling
@@ -524,6 +634,11 @@ class SolverBase:
                 "steps_per_exchange > 1 needs the sharded slab rung; "
                 f"this config declined fusion: {reason}"
             )
+        if self._exchange_mode() == "dma":
+            raise ValueError(
+                "exchange='dma' needs the sharded slab rung; "
+                f"this config declined fusion: {reason}"
+            )
         return None
 
     def _pallas_f32_gate(self, impl: str) -> str:
@@ -580,12 +695,16 @@ class SolverBase:
             fused = None
         if fused is not None:
             overlap = None
+            exchange = getattr(fused, "exchange", "collective")
             if getattr(fused, "sharded", False):
-                overlap = (
-                    "split"
-                    if getattr(fused, "overlap_split", False)
-                    else "serialized-refresh"
-                )
+                if exchange == "dma":
+                    # the whole-run program exchanges in-kernel: there
+                    # is no XLA-level halo schedule to overlap
+                    overlap = "in-kernel"
+                elif getattr(fused, "overlap_split", False):
+                    overlap = "split"
+                else:
+                    overlap = "serialized-refresh"
             out = {
                 "impl": impl,
                 "stepper": fused.engaged_label,
@@ -595,6 +714,8 @@ class SolverBase:
                 "steps_per_exchange": int(
                     getattr(fused, "steps_per_exchange", 1)
                 ),
+                # halo-exchange transport actually engaged
+                "exchange": exchange,
                 "fallback": None,
             }
             if self._tuned is not None:
@@ -633,6 +754,7 @@ class SolverBase:
             "steps_per_exchange": int(
                 getattr(self.cfg, "steps_per_exchange", 1) or 1
             ),
+            "exchange": self._exchange_mode(),
             "fallback": fallback,
         }
         if self._tuned is not None:
@@ -648,8 +770,8 @@ class SolverBase:
         d = self._tuned or {}
         return {
             k: d.get(k)
-            for k in ("source", "impl", "steps_per_exchange", "mlups",
-                      "key")
+            for k in ("source", "impl", "steps_per_exchange", "exchange",
+                      "mlups", "key")
             if k in d
         }
 
@@ -717,6 +839,12 @@ class SolverBase:
                     for o in axis_offsets(self.decomp, fused.interior_shape)
                 ]
             )
+
+        if getattr(fused, "exchange", "collective") == "dma":
+            # in-kernel remote-DMA exchange: the stepper's whole-run
+            # program moves its own ghost rows over ICI — no ppermute
+            # refresh/exch closures exist at the XLA level
+            return None, offsets_fn, None
 
         if getattr(fused, "overlap_split", False):
             name = self.decomp.mesh_axis(0)
@@ -891,6 +1019,13 @@ class SolverBase:
                 "slab rung, whose k-step deep-halo schedule does not "
                 "fold a member axis — run ensembles at the per-step "
                 "exchange cadence"
+            )
+        if self._exchange_mode() == "dma":
+            raise ValueError(
+                "exchange='dma' rides the spatially sharded slab "
+                "rung, whose in-kernel remote-DMA ring does not fold "
+                "a member axis — the batched ensemble engine keeps "
+                "the collective exchange"
             )
         if getattr(self.cfg, "impl", "xla") == "pallas_slab":
             if self.mesh is not None:

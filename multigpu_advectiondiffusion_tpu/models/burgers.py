@@ -63,6 +63,10 @@ class BurgersConfig:
     # k*G-deep exchange once per k steps on the sharded slab rung;
     # impl="auto" lets the measured tuner pick it
     steps_per_exchange: int = 1
+    # halo-exchange transport (see DiffusionConfig): "collective" (XLA
+    # ppermute between compiled calls) or "dma" (in-kernel remote-DMA
+    # pushes on the sharded whole-run slab rung)
+    exchange: str = "collective"
 
     def __post_init__(self):
         from multigpu_advectiondiffusion_tpu.ops import IMPLS
@@ -79,6 +83,11 @@ class BurgersConfig:
             raise ValueError(
                 "steps_per_exchange must be an int >= 1, got "
                 f"{self.steps_per_exchange!r}"
+            )
+        if self.exchange not in ("collective", "dma"):
+            raise ValueError(
+                f"unknown exchange {self.exchange!r}; "
+                "'collective' or 'dma'"
             )
 
 
@@ -385,9 +394,15 @@ class BurgersSolver(SolverBase):
         error instead of a silent per-stage fallback."""
         cfg = self.cfg
         k = int(getattr(cfg, "steps_per_exchange", 1) or 1)
-        pinned = cfg.impl == "pallas_slab" or k > 1
+        dma = self._exchange_mode() == "dma"
+        pinned = cfg.impl == "pallas_slab" or k > 1 or dma
 
         def decline(reason):
+            if dma:
+                raise ValueError(
+                    f"exchange='dma' needs the sharded slab rung: "
+                    f"{reason}"
+                )
             if k > 1:
                 raise ValueError(
                     f"steps_per_exchange={k} needs the sharded slab "
@@ -396,7 +411,7 @@ class BurgersSolver(SolverBase):
             return None
 
         if self.grid.ndim != 3 or cfg.impl not in ("pallas", "pallas_slab"):
-            return None  # k > 1 on these configs is rejected at __init__
+            return None  # k > 1 / dma on these configs: rejected at __init__
         if mode == "t_end":
             return decline("the slab stepper has no run_to (use --iters)")
         if cfg.adaptive_dt:
@@ -414,6 +429,14 @@ class BurgersSolver(SolverBase):
                 return None
             if any(ax != 0 for ax in self._sharded_axes()):
                 return decline("z-slab decompositions only")
+            if dma and not self._dma_backend_ok():
+                import jax as _jax
+
+                return decline(
+                    "in-kernel remote DMA needs the TPU backend (or "
+                    "the CPU interpret simulator); backend="
+                    f"{_jax.default_backend()!r}"
+                )
         if not slab_cls.supported(lshape, self.dtype, order=cfg.weno_order):
             return decline("local shape exceeds the slab VMEM budget")
         if not pinned and not slab_cls.profitable(
@@ -430,9 +453,13 @@ class BurgersSolver(SolverBase):
             kwargs = {"order": cfg.weno_order}
             if self.mesh is not None:
                 kwargs["global_shape"] = self.grid.shape
-                kwargs["overlap_split"] = self._split_overlap_requested()
+                kwargs["overlap_split"] = (
+                    not dma and self._split_overlap_requested()
+                )
                 if k > 1:
                     kwargs["steps_per_exchange"] = k
+                if dma:
+                    kwargs.update(self._dma_stepper_kwargs())
             self._cache["fused_slab"] = slab_cls(
                 lshape, self.dtype, self.grid.spacing, self.flux,
                 cfg.weno_variant, cfg.nu, dt=self.dt, **kwargs,

@@ -75,6 +75,14 @@ class DiffusionConfig:
     # the sharded slab rung only and is validated at dispatch like the
     # impl ladder. impl="auto" lets the measured tuner pick it.
     steps_per_exchange: int = 1
+    # halo-exchange transport: "collective" (XLA ppermute between
+    # compiled calls — every schedule above) or "dma" (the sharded
+    # whole-run slab rung pushes its ghost rows to the ±z neighbors
+    # from INSIDE the Pallas program via remote DMA and never returns
+    # to XLA between steps; z-slab meshes, TPU backend or the CPU
+    # interpret simulator). Validated like the impl ladder; "auto"
+    # impl lets the measured tuner pick it.
+    exchange: str = "collective"
 
     def __post_init__(self):
         from multigpu_advectiondiffusion_tpu.ops import IMPLS
@@ -93,6 +101,11 @@ class DiffusionConfig:
             raise ValueError(
                 "steps_per_exchange must be an int >= 1, got "
                 f"{self.steps_per_exchange!r}"
+            )
+        if self.exchange not in ("collective", "dma"):
+            raise ValueError(
+                f"unknown exchange {self.exchange!r}; "
+                "'collective' or 'dma'"
             )
         if self.geometry == "axisymmetric" and self.grid.ndim != 2:
             raise ValueError("axisymmetric geometry requires a 2-D (y, r) grid")
@@ -427,9 +440,15 @@ class DiffusionSolver(SolverBase):
         a hard error instead of a silent per-stage fallback."""
         cfg = self.cfg
         k = int(getattr(cfg, "steps_per_exchange", 1) or 1)
-        pinned = cfg.impl == "pallas_slab" or k > 1
+        dma = self._exchange_mode() == "dma"
+        pinned = cfg.impl == "pallas_slab" or k > 1 or dma
 
         def decline(reason):
+            if dma:
+                raise ValueError(
+                    f"exchange='dma' needs the sharded slab rung: "
+                    f"{reason}"
+                )
             if k > 1:
                 raise ValueError(
                     f"steps_per_exchange={k} needs the sharded slab "
@@ -438,7 +457,7 @@ class DiffusionSolver(SolverBase):
             return None
 
         if self.grid.ndim != 3 or cfg.impl not in ("pallas", "pallas_slab"):
-            return None  # k > 1 on these configs is rejected at __init__
+            return None  # k > 1 / dma on these configs: rejected at __init__
         if mode == "t_end":
             # no run_to: advance_to keeps the per-stage path
             return decline("the slab stepper has no run_to (use --iters)")
@@ -464,6 +483,14 @@ class DiffusionSolver(SolverBase):
                     f"local z extent {lshape[0]} cannot serve the "
                     f"{k * slab_cls.halo}-deep exchange"
                 )
+            if dma and not self._dma_backend_ok():
+                import jax as _jax
+
+                return decline(
+                    "in-kernel remote DMA needs the TPU backend (or "
+                    "the CPU interpret simulator); backend="
+                    f"{_jax.default_backend()!r}"
+                )
         if not slab_cls.supported(
             lshape, kernel_dtype, sharded=self.mesh is not None
         ):
@@ -476,9 +503,13 @@ class DiffusionSolver(SolverBase):
             kwargs = {}
             if self.mesh is not None:
                 kwargs["global_shape"] = self.grid.shape
-                kwargs["overlap_split"] = self._split_overlap_requested()
+                kwargs["overlap_split"] = (
+                    not dma and self._split_overlap_requested()
+                )
                 if k > 1:
                     kwargs["steps_per_exchange"] = k
+                if dma:
+                    kwargs.update(self._dma_stepper_kwargs())
             if f64_storage:
                 kwargs["storage_dtype"] = self.dtype
             self._cache["fused_slab"] = slab_cls(

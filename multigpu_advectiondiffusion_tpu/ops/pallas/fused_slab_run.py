@@ -68,6 +68,29 @@ edge calls consuming the ``k*G``-deep operands. Exchange cadence is
 selected per measured tuning decision (``impl='auto'``,
 :mod:`multigpu_advectiondiffusion_tpu.tuning`) or pinned via the
 ``steps_per_exchange`` config knob.
+
+**In-kernel remote-DMA exchange** (``exchange='dma'``, ROADMAP item 2):
+the sharded composition above still breaks out of the Pallas program
+every step (or every k-step block) to run the ``ppermute`` between
+compiled calls. The dma mode instead runs the ENTIRE sharded run as one
+whole-run Pallas program per shard — grid ``(timestep, z-slab)`` like
+the unsharded rung — and moves the ``k*G`` ghost rows over ICI from
+*inside* the kernel via ``pltpu.make_async_remote_copy``: at each
+block's last step the freshly written core edge windows are pushed to
+the ±z neighbors' dedicated 2-slot landing buffer (cyclic ring pushes,
+every shard in lockstep — the wall shards' wrapped slabs land in rows
+the receiver never reads, the ``ppermute`` discipline in-kernel), and
+the next block's first iteration waits the paired send/recv semaphores
+and splices the landed slabs into the read parity's ghost rows with a
+local DMA (wall sides keep their frozen embed BC ghosts). Pushes land
+in the landing buffer only — never over state rows — so a fast
+neighbor can never overwrite rows the local step is still computing;
+the static halo verifier (``analysis/halo_verify``) proves the
+declared send/recv windows (``stencil_spec()['remote_dma']``) against
+exactly that invariant before any hardware run. The in-block step
+windows shrink by the usual ``(k-1-j)*G`` trapezoid, realized on a
+uniform z-block (``bz | lz``, and ``bz | 2G`` when k > 1) with the
+out-of-window grid iterations predicated off.
 """
 
 from __future__ import annotations
@@ -110,6 +133,21 @@ from multigpu_advectiondiffusion_tpu.ops.weno import HALO
 # ceiling is VMEM_LIMIT = 100 MiB; leave headroom for Mosaic's own
 # scheduling slack, as fused_burgers does).
 _VMEM_BUDGET = 72 * 1024 * 1024
+
+
+def _dma_compiler_params():
+    """Mosaic params for the in-kernel remote-DMA program: the scoped
+    VMEM ceiling of every slab kernel, plus the collective id (and,
+    where this jax exposes it, the side-effect pin) the cross-chip
+    DMAs require. Only built on the TPU lowering path — interpret mode
+    passes None like every other slab call — so resolve the params
+    class per jax version (``CompilerParams`` today,
+    ``TPUCompilerParams`` on older releases)."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    kwargs = {"vmem_limit_bytes": VMEM_LIMIT, "collective_id": 0}
+    if "has_side_effects" in getattr(cls, "__dataclass_fields__", {}):
+        kwargs["has_side_effects"] = True
+    return cls(**kwargs)
 
 
 def _check_steps_per_exchange(k, sharded: bool, nz: int, G: int) -> int:
@@ -271,6 +309,202 @@ def _whole_run_kernel(s_in, ss, vs, res, sem_v, sem_w, *, step_fn, bz: int,
             copy_out(i - 1, nslot).wait()
 
 
+def _pick_dma_block(lz: int, G: int, k: int, viable) -> int | None:
+    """Largest z-block serving the uniform-bz in-kernel dma grid: it
+    must tile the final core window exactly (``bz | lz``) and — deep
+    schedules only — every in-block window extent ``lz + 2*(k-1-j)*G``
+    too (``bz | 2G`` suffices, the extents differing by 2G per step)."""
+    for b in range(lz, 0, -1):
+        if lz % b:
+            continue
+        if k > 1 and (2 * G) % b:
+            continue
+        if viable(b):
+            return b
+    return None
+
+
+def _whole_run_dma_kernel(offs, s_in, land_in, ss, land, vs, res, sem_v,
+                          sem_w, sem_land, send_sem, recv_sem, *, step_fn,
+                          bz: int, G: int, k: int, lz: int, n0: int,
+                          n_iters: int, mesh_axis: str, num_shards: int):
+    """Sharded whole-run grid with in-kernel neighbor halo exchange.
+
+    Grid ``(timestep, z-slab)`` per shard; ``ss`` is the stacked
+    ``(2, pz, Y, X)`` ping-pong state (aliased out), ``land`` the
+    dedicated ``(2 slots, 2 sides, k*G, Y, X)`` remote-DMA landing
+    buffer (aliased out; written ONLY by the neighbors' pushes). The
+    schedule, with ``depth = k*G`` and in-block step ``j = s % k``:
+
+    * step ``j`` computes the core extended by ``(k-1-j)*G`` rows per
+      side (the deep-halo trapezoid) on a uniform ``bz`` z-block —
+      grid iterations beyond the step's window are predicated off;
+      slab loads double-buffer within the step, writes drain fully at
+      each step's tail (the next step's reads are exactly this step's
+      output window);
+    * at each block's last step, after the write drain, every shard
+      pushes its freshly written core edge windows (rows
+      ``[depth, 2*depth)`` and ``[pz-2*depth, pz-depth)`` of the
+      parity the next block reads) to the ±z neighbors' landing slot
+      ``(b+1) % 2`` via ``make_async_remote_copy`` — cyclic ring
+      pushes issued by EVERY shard in lockstep (rank-uniform sites;
+      the wall shards' wrapped slabs land in rows the receiver never
+      consumes, mirroring the XLA path's cyclic ``ppermute``);
+    * at each block's first iteration the paired send/recv semaphores
+      are waited (send: my source rows are reusable; recv: the
+      neighbors' rows landed) and the landed slabs are spliced into
+      the read parity's ghost rows with a local DMA — predicated per
+      side on the shard's rank, so the wall sides keep their frozen
+      embed BC ghosts (Dirichlet values / edge replicas, maintained
+      across steps by the step windows' out-of-domain pass-through);
+    * block 0 has no prior block to push for it: its exchange is the
+      same pair of pushes issued at the first iteration from the
+      embedded initial state (the XLA path's block-start refresh of
+      the fresh embed, in-kernel).
+
+    Pushes never address state rows — the landing buffer is the only
+    remote-DMA destination — so the send/recv windows are disjoint
+    from every locally computed row by construction (the invariant
+    ``analysis/halo_verify`` proves from the declared
+    ``remote_dma`` windows), and the 2-slot landing ping-pong plus
+    the block-dependency chain (a neighbor cannot start block ``b+1``
+    before receiving my block-``b`` push) bound the skew: a push for
+    block ``b+2`` cannot arrive before my block-``b`` reads of that
+    slot are done."""
+    del s_in, land_in  # aliased with ss / land
+    depth = k * G
+    pz = lz + 2 * depth
+    box = bz + 2 * G
+    s = jnp.asarray(pl.program_id(0), jnp.int32)
+    jj = jnp.asarray(pl.program_id(1), jnp.int32)
+    two = jnp.asarray(2, jnp.int32)
+    kk = jnp.asarray(k, jnp.int32)
+    j = lax.rem(s, kk)
+    b = lax.div(s, kk)
+    read_par = lax.rem(s, two)
+    write_par = 1 - read_par
+    total = jnp.asarray(n_iters, jnp.int32)
+    if k == 1:
+        n_act = jnp.asarray(n0, jnp.int32)
+    else:
+        # bz | lz and bz | 2G make every in-block extent tile exactly
+        n_act = jnp.asarray(lz // bz, jnp.int32) + (
+            (kk - 1 - j) * jnp.asarray((2 * G) // bz, jnp.int32)
+        )
+    active = jj < n_act
+    oz = offs[0]
+    me = jnp.asarray(lax.axis_index(mesh_axis), jnp.int32)
+    P = jnp.asarray(num_shards, jnp.int32)
+
+    def remote_pair(slot, par):
+        # my top core window -> +z neighbor's LO landing slab; my
+        # bottom -> -z neighbor's HI. Sources sit inside the core
+        # (rows this shard itself computed), destinations inside the
+        # landing buffer only.
+        up = pltpu.make_async_remote_copy(
+            ss.at[par, pl.ds(pz - 2 * depth, depth)],
+            land.at[slot, 0],
+            send_sem.at[slot, 0],
+            recv_sem.at[slot, 0],
+            device_id=lax.rem(me + 1, P),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        down = pltpu.make_async_remote_copy(
+            ss.at[par, pl.ds(depth, depth)],
+            land.at[slot, 1],
+            send_sem.at[slot, 1],
+            recv_sem.at[slot, 1],
+            device_id=lax.rem(me + P - 1, P),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        return up, down
+
+    def land_copy(side: int, par):
+        dst0 = 0 if side == 0 else pz - depth
+        return pltpu.make_async_copy(
+            land.at[lax.rem(b, two), side],
+            ss.at[par, pl.ds(dst0, depth)],
+            sem_land.at[side],
+        )
+
+    # ---- block start: wait the pushes, splice into the read parity's
+    # ghost rows (wall sides keep the frozen embed BC ghosts) ----
+    @pl.when((j == 0) & (jj == 0))
+    def _():
+        @pl.when(b == 0)
+        def _():
+            up, down = remote_pair(jnp.asarray(0, jnp.int32), read_par)
+            up.start()
+            down.start()
+
+        up, down = remote_pair(lax.rem(b, two), read_par)
+        up.wait()
+        down.wait()
+
+        @pl.when(me > 0)
+        def _():
+            land_copy(0, read_par).start()
+            land_copy(0, read_par).wait()
+
+        @pl.when(me < P - 1)
+        def _():
+            land_copy(1, read_par).start()
+            land_copy(1, read_par).wait()
+
+    def copy_in(slab, slot):
+        return pltpu.make_async_copy(
+            ss.at[read_par, pl.ds(j * G + slab * bz, box)],
+            vs.at[slot],
+            sem_v.at[slot],
+        )
+
+    def copy_out(slab, slot):
+        return pltpu.make_async_copy(
+            res.at[slot],
+            ss.at[write_par, pl.ds((j + 1) * G + slab * bz, bz)],
+            sem_w.at[slot],
+        )
+
+    @pl.when(jj == 0)
+    def _():
+        copy_in(jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)).start()
+
+    @pl.when(active & (jj + 1 < n_act))
+    def _():
+        copy_in(jj + 1, lax.rem(jj + 1, two)).start()
+
+    @pl.when(active)
+    def _():
+        slot = lax.rem(jj, two)
+        copy_in(jj, slot).wait()
+        out = step_fn(vs[slot], j, jj, oz)
+
+        @pl.when(jj >= 2)
+        def _():
+            copy_out(jj - 2, slot).wait()
+
+        res[slot] = out
+        copy_out(jj, slot).start()
+
+    # ---- step tail (the step's last grid iteration, active or not):
+    # drain the step's outstanding writes, then — at block ends — push
+    # the fresh core edges for the neighbors' next block
+    @pl.when(jj == n0 - 1)
+    def _():
+        @pl.when(n_act >= 2)
+        def _():
+            copy_out(n_act - 2, lax.rem(n_act - 2, two)).wait()
+
+        copy_out(n_act - 1, lax.rem(n_act - 1, two)).wait()
+
+        @pl.when((j == kk - 1) & (s + 1 < total))
+        def _():
+            up, down = remote_pair(lax.rem(b + 1, two),
+                                   lax.rem(s + 1, two))
+            up.start()
+            down.start()
+
+
 def _step_call_kernel(*refs, step_fn, bz: int, G: int, z_out0: int,
                       n_grid: int, ghost_src, op_rows: int, g_start: int,
                       sharded: bool):
@@ -421,6 +655,16 @@ class _SlabRunStepper:
     #: instance never composes with spatial sharding in one program.
     members = 1
     member_halo = 0
+    #: halo-exchange transport of a sharded instance: "collective"
+    #: (XLA ppermute between the per-step slab calls — every schedule
+    #: above) or "dma" (ONE whole-run Pallas program per shard with
+    #: in-kernel `make_async_remote_copy` neighbor pushes; declared to
+    #: the static verifier via ``remote_dma``); ``_init_exchange``
+    #: sets the instance state
+    exchange = "collective"
+    remote_dma = None
+    mesh_axis = None
+    num_shards = None
 
     def stencil_spec(self) -> dict:
         """Stencil/halo contract of the slab rung (see
@@ -440,12 +684,158 @@ class _SlabRunStepper:
             "steps_per_exchange": int(self.steps_per_exchange),
             "members": int(self.members),
             "member_halo": int(self.member_halo),
+            # halo-exchange transport actually engaged on this instance
+            "exchange": self.exchange,
             # declared in-kernel remote-DMA window (ROADMAP item 2) —
-            # None while the deep exchange rides XLA ppermute between
-            # slab calls; the in-kernel rung will declare it and
-            # halo_verify proves it against exchange_depth up front
+            # None while the exchange rides XLA ppermute between slab
+            # calls; exchange='dma' instances declare it and
+            # halo_verify proves window/disjointness/semaphore pairing
+            # against the exchange arithmetic BEFORE any hardware run
             "remote_dma": getattr(self, "remote_dma", None),
         }
+
+    def _dma_block_viable(self, b: int) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+    def _init_exchange(self, exchange, mesh_axis, num_shards) -> None:
+        """Validate + arm the halo-exchange transport. ``'dma'``
+        requires a sharded z-slab instance with a concrete (string)
+        mesh axis — a compound multihost axis spans DCN, which remote
+        DMA cannot cross — picks the uniform dma z-block, and declares
+        the ``remote_dma`` contract the static verifier proves."""
+        exchange = str(exchange)
+        if exchange not in ("collective", "dma"):
+            raise ValueError(
+                f"unknown exchange mode {exchange!r}; "
+                "'collective' (XLA ppermute) or 'dma' (in-kernel)"
+            )
+        self.exchange = exchange
+        if exchange != "dma":
+            return
+        if not self.sharded:
+            raise ValueError(
+                "exchange='dma' serves sharded (z-slab) slab instances "
+                "only — an unsharded run has no neighbor to push to"
+            )
+        if self.overlap_split:
+            raise ValueError(
+                "exchange='dma' replaces the XLA exchange entirely; "
+                "the split-overlap schedule does not compose with it"
+            )
+        if not isinstance(mesh_axis, str) or num_shards is None:
+            raise ValueError(
+                "exchange='dma' needs the z mesh axis name and shard "
+                "count (a compound/multihost mesh axis cannot host the "
+                "ICI remote-DMA ring)"
+            )
+        self.mesh_axis = mesh_axis
+        self.num_shards = int(num_shards)
+        depth = self.exchange_depth
+        lz = self.interior_shape[0]
+        if lz < depth:
+            raise ValueError(
+                f"local z extent {lz} cannot serve the {depth}-deep "
+                "in-kernel exchange (the pushed core edge windows "
+                "would leave the shard's own rows)"
+            )
+        bz = _pick_dma_block(lz, self.halo, self.k,
+                             self._dma_block_viable)
+        if bz is None:
+            raise ValueError(
+                "no viable uniform z-block for the in-kernel dma grid "
+                f"(lz={lz}, G={self.halo}, k={self.k})"
+            )
+        self._dma_bz = bz
+        self._dma_n0 = (lz + 2 * (self.k - 1) * self.halo) // bz
+        pz = self.padded_shape[0]
+        self.remote_dma = {
+            "axis": 0,
+            "window_rows": depth,
+            "buffers": 2,
+            # pushed rows: my freshly computed core edge windows...
+            "send_windows": ((depth, 2 * depth),
+                             (pz - 2 * depth, pz - depth)),
+            # ...landing OUTSIDE the neighbor's core — first in the
+            # dedicated landing buffer, spliced into these ghost rows
+            "recv_windows": ((0, depth), (pz - depth, pz)),
+            "semaphores": ("send", "recv"),
+            "landing": "dedicated",
+        }
+
+    def _run_dma(self, u, t, num_iters: int, offsets):
+        """The whole sharded run as ONE Pallas program per shard (must
+        run inside ``shard_map``): ghost rows move over ICI from inside
+        the kernel — the program never returns to XLA between steps."""
+        from multigpu_advectiondiffusion_tpu.ops.pallas.stepper_base import (
+            chunk_counts,
+        )
+        from multigpu_advectiondiffusion_tpu.parallel.halo import (
+            record_remote_dma,
+        )
+
+        G, k = self.halo, self.k
+        depth = self.exchange_depth
+        bz, n0 = self._dma_bz, self._dma_n0
+        lz = self.interior_shape[0]
+        full, rem_steps = chunk_counts(num_iters, k)
+        blocks = full + (1 if rem_steps else 0)
+        trailing = self.padded_shape[1:]
+        record_remote_dma(
+            kernel=self.engaged_label,
+            plane_shape=trailing,
+            itemsize=self.dtype.itemsize,
+            window_rows=depth,
+            blocks=blocks,
+            mesh_axis=self.mesh_axis,
+        )
+        kern = functools.partial(
+            _whole_run_dma_kernel,
+            step_fn=lambda v, j, jj, oz: self._step_fn(
+                v, j * G + jj * bz - depth + oz
+            ),
+            bz=bz, G=G, k=k, lz=lz, n0=n0, n_iters=num_iters,
+            mesh_axis=self.mesh_axis, num_shards=self.num_shards,
+        )
+        S = self.embed(u)
+        SS = jnp.stack([S, S])
+        land = jnp.zeros((2, 2, depth) + tuple(trailing), self.dtype)
+        scratch = [
+            pltpu.VMEM((2, bz + 2 * G) + tuple(trailing), self.dtype),
+            pltpu.VMEM((2, bz) + tuple(trailing), self.dtype),
+            pltpu.SemaphoreType.DMA((2,)),   # slab loads
+            pltpu.SemaphoreType.DMA((2,)),   # slab writes
+            pltpu.SemaphoreType.DMA((2,)),   # landing -> state splices
+            pltpu.SemaphoreType.DMA((2, 2)),  # send [slot, side]
+            pltpu.SemaphoreType.DMA((2, 2)),  # recv [slot, side]
+        ]
+        with jax.named_scope(f"tpucfd.{self.engaged_label}[dma]"):
+            out, _ = pl.pallas_call(
+                kern,
+                grid=(num_iters, n0),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ],
+                out_specs=(
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ),
+                out_shape=(
+                    jax.ShapeDtypeStruct(SS.shape, SS.dtype),
+                    jax.ShapeDtypeStruct(land.shape, land.dtype),
+                ),
+                input_output_aliases={1: 0, 2: 1},
+                scratch_shapes=scratch,
+                compiler_params=(
+                    None if interpret_mode() else _dma_compiler_params()
+                ),
+                interpret=interpret_mode(),
+            )(offsets, SS, land)
+        return (
+            self.extract(out[num_iters % 2]),
+            accumulate_t(t, self.dt, num_iters),
+        )
 
     def _check_members(self, members: int) -> int:
         """Validate a declared member fold: the batched grid serves
@@ -704,6 +1094,10 @@ class _SlabRunStepper:
 
         if offsets is None:
             raise ValueError("sharded slab stepper needs offsets")
+        if self.exchange == "dma":
+            # in-kernel remote-DMA exchange: no refresh/exch closures —
+            # the whole run is one Pallas program per shard
+            return self._run_dma(u, t, num_iters, offsets)
         if self.overlap_split:
             if exch is None:
                 raise ValueError("split-overlap slab stepper needs exch")
@@ -867,7 +1261,9 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,
                  band, bc_value, block_z=None, global_shape=None,
                  overlap_split: bool = False, storage_dtype=None,
-                 steps_per_exchange: int = 1, members: int = 1):
+                 steps_per_exchange: int = 1, members: int = 1,
+                 exchange: str = "collective", mesh_axis=None,
+                 num_shards=None):
         nz, ny, nx = interior_shape
         G = _G_DIFF
         self.interior_shape = tuple(interior_shape)
@@ -941,8 +1337,13 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
                          gz0=base_z + 3 * R, a=a3, b=b3)
 
         self._step_fn = step_fn
-        if self.sharded:
+        self._init_exchange(exchange, mesh_axis, num_shards)
+        if self.sharded and self.exchange != "dma":
             self._build_sharded_calls()
+
+    def _dma_block_viable(self, b: int) -> bool:
+        row = _diff_row_bytes(self.interior_shape, self.dtype.itemsize)
+        return b <= _diff_budget_rows(row)
 
     @staticmethod
     def supported(interior_shape, dtype, sharded: bool = False) -> bool:
@@ -1050,7 +1451,8 @@ class SlabRunBurgersStepper(_SlabRunStepper):
                  variant: str, nu: float, dt: float, block_z=None,
                  global_shape=None, overlap_split: bool = False,
                  order: int = 5, steps_per_exchange: int = 1,
-                 members: int = 1):
+                 members: int = 1, exchange: str = "collective",
+                 mesh_axis=None, num_shards=None):
         if order not in HALO:
             raise ValueError(f"unsupported WENO order {order}")
         if order == 7 and variant != "js":
@@ -1206,8 +1608,15 @@ class SlabRunBurgersStepper(_SlabRunStepper):
                          base_z + G, "dyn" if deep else None, d)
 
         self._step_fn = step_fn
-        if self.sharded:
+        self._init_exchange(exchange, mesh_axis, num_shards)
+        if self.sharded and self.exchange != "dma":
             self._build_sharded_calls()
+
+    def _dma_block_viable(self, b: int) -> bool:
+        row = _burg_row_bytes(
+            self.interior_shape, self.dtype.itemsize, self.r
+        )
+        return _burg_live_rows(b, self.r, self.order) * row <= _VMEM_BUDGET
 
     @staticmethod
     def supported(interior_shape, dtype, order: int = 5) -> bool:

@@ -48,6 +48,61 @@ def exchange_spec() -> dict:
     }
 
 
+def remote_dma_spec() -> dict:
+    """Queryable metadata of the IN-KERNEL halo exchange (the
+    ``exchange_spec()`` discipline for the remote-DMA rung,
+    ``ops/pallas/fused_slab_run._whole_run_dma_kernel``): the slab
+    rung's dma mode replaces the ppermute site entirely — ghost rows
+    move over ICI via ``pltpu.make_async_remote_copy`` from inside the
+    Pallas program — so its traffic is recorded through these counters
+    and the ``halo:in_kernel`` event instead of the ppermute pair. The
+    collective-schedule verifier's dynamic cross-check
+    (``analysis/collective_verify.halo_counter_profile``) reads BOTH
+    specs so a dma-mode stream profiles rank-uniform without a stale
+    ppermute expectation."""
+    return {
+        "kernel": "fused-whole-run-slab",
+        "counters": ("halo.dma_bytes_per_execution",),
+        "events": (("halo", "in_kernel"),),
+    }
+
+
+def record_remote_dma(kernel: str, plane_shape, itemsize: int,
+                      window_rows: int, blocks: int,
+                      mesh_axis: str) -> None:
+    """Telemetry record of one in-kernel remote-DMA exchange *site*.
+
+    Runs at TRACE time (the slab rung's ``_run_dma`` executes under
+    ``jit``/``shard_map``), mirroring :func:`_record_exchange`:
+    ``bytes`` is the ICI payload per compiled execution — two
+    ``window_rows``-deep slabs of the padded trailing plane (the rows
+    actually pushed), times ``blocks`` (one exchange per k-step block,
+    the initial embed push included: ``ceil(num_iters / k)`` pushes per
+    run call). The ``halo:in_kernel`` event carries the same facts so
+    ``tpucfd-trace``'s phase breakdown attributes the comm to the
+    in-kernel path instead of reading zero exchanged bytes."""
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    sink = telemetry.get_sink()
+    if not sink.active:
+        return
+    plane = int(itemsize)
+    for n in plane_shape:
+        plane *= int(n)
+    nbytes = 2 * int(window_rows) * plane * int(blocks)
+    sink.counter(
+        "halo.dma_bytes_per_execution", nbytes,
+        axis=0, mesh_axis=mesh_axis, window_rows=int(window_rows),
+        blocks=int(blocks),
+    )
+    sink.event(
+        "halo", "in_kernel",
+        kernel=kernel, axis=0, mesh_axis=mesh_axis,
+        depth=int(window_rows), blocks=int(blocks),
+        bytes_per_execution=nbytes,
+    )
+
+
 def exchange_ghosts(
     u: jnp.ndarray,
     axis: int,

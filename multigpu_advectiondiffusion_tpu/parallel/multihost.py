@@ -225,6 +225,9 @@ def collective_spec() -> Dict[str, tuple]:
     contract), and its dynamic cross-check reads the listed events
     back out of the 2-proc chaos streams. ``*`` in a tag is the
     wildcard for a runtime interpolation (the checkpoint directory)."""
+    from multigpu_advectiondiffusion_tpu.parallel.halo import (
+        remote_dma_spec,
+    )
     from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
         AGREE_TAGS,
     )
@@ -236,6 +239,13 @@ def collective_spec() -> Dict[str, tuple]:
         "barrier": tuple(CKPTD_BARRIER_TAGS),
         "agree": tuple(AGREE_TAGS),
         "events": (("sync", "barrier"), ("resilience", "agree")),
+        # in-kernel remote-DMA exchange (the slab rung's dma mode):
+        # the rendezvous is a Pallas make_async_remote_copy, not a
+        # barrier/agree tag — declared here so the static pass proves
+        # the kernel sites and this registry agree BOTH directions
+        # (an undeclared remote-DMA site is schema drift; a declared
+        # transport with no kernel site is a stale contract)
+        "remote_dma": remote_dma_spec(),
     }
 
 

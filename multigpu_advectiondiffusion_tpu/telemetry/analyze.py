@@ -322,6 +322,8 @@ def phase_breakdown(stream: Stream) -> dict:
     chunk_step_times = []
     halo_bytes_per_exec = 0
     halo_sites = 0
+    dma_bytes = 0
+    dma_blocks = 0
     for ev in stream.events:
         kind, name = ev.get("kind"), ev.get("name")
         if kind == "io" and ev.get("seconds") is not None:
@@ -343,6 +345,13 @@ def phase_breakdown(stream: Stream) -> dict:
             )
         elif kind == "counter" and name == "halo.exchanges_traced":
             halo_sites = max(halo_sites, int(ev.get("total", 0)))
+        elif kind == "halo" and name == "in_kernel":
+            # in-kernel remote-DMA exchange (exchange='dma'): the
+            # compiled program moves its ghost rows over ICI itself —
+            # bytes/blocks arrive per traced run call, blocks folded
+            # in, so no per-step scaling applies
+            dma_bytes += int(ev.get("bytes_per_execution", 0))
+            dma_blocks += int(ev.get("blocks", 0))
 
     per_step = statistics.median(chunk_step_times) if chunk_step_times \
         else None
@@ -356,6 +365,18 @@ def phase_breakdown(stream: Stream) -> dict:
         halo_model_s = costmodel.halo_exchange_seconds(
             float(halo_bytes_per_exec) * steps_seen,
             messages=max(1, halo_sites) * steps_seen,
+        )
+    if dma_bytes:
+        from multigpu_advectiondiffusion_tpu.telemetry import costmodel
+
+        # in-kernel remote-DMA comm (halo:in_kernel events): bytes
+        # arrive with the run call's blocks already folded in — the
+        # phase breakdown attributes the dma rung's comm instead of
+        # reading the absent ppermute counters as zero
+        halo_model_s = (halo_model_s or 0.0) + (
+            costmodel.halo_exchange_seconds(
+                float(dma_bytes), messages=max(1, dma_blocks)
+            )
         )
     accounted = compile_s + step_s + io_s
     return {

@@ -72,6 +72,16 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "failed": {"attempts", "error"},
     },
     "sync": {"barrier": {"tag"}},
+    # in-kernel remote-DMA halo exchange (ops/pallas/fused_slab_run
+    # exchange='dma', recorded by parallel/halo.record_remote_dma): one
+    # event per traced run call — the sharded whole-run program moves
+    # its ghost rows over ICI from inside the Pallas kernel, so this
+    # (plus the halo.dma_bytes_per_execution counter) is the ONLY
+    # telemetry trace of that communication
+    "halo": {
+        "in_kernel": {"kernel", "axis", "depth", "blocks",
+                      "bytes_per_execution"},
+    },
     "tune": {
         "lookup": set(),
         "candidates": set(),
@@ -152,6 +162,9 @@ def validate_event(ev: dict) -> List[str]:
 COUNTER_NAMES: Set[str] = {
     "halo.exchanges_traced",
     "halo.bytes_per_execution",
+    # in-kernel remote-DMA bytes (halo.record_remote_dma): the dma
+    # rung's ICI payload per compiled execution, blocks folded in
+    "halo.dma_bytes_per_execution",
 }
 
 def scan_emitted(

@@ -128,6 +128,7 @@ def resolve(solver_cls, cfg, mesh, decomp, ensemble: int = 1) -> dict:
         decision = {
             "impl": "pallas",
             "steps_per_exchange": 1,
+            "exchange": "collective",
             "source": "untuned-heuristic",
             "key": key,
         }

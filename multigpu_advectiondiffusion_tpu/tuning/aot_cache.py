@@ -110,6 +110,7 @@ def dispatch_key(solver, program_key, steps=None) -> str:
         base,
         f"impl={getattr(solver.cfg, 'impl', 'xla')}",
         f"k={int(getattr(solver.cfg, 'steps_per_exchange', 1) or 1)}",
+        f"ex={getattr(solver.cfg, 'exchange', 'collective')}",
         f"prog={program_key}",
         f"steps={steps}",
     ])

@@ -122,17 +122,22 @@ def candidates(solver_cls, cfg, mesh, decomp) -> list:
     dispatch layer already owns — the tuner never re-implements VMEM /
     dtype / decomposition gates, it asks them."""
     probe = solver_cls(
-        dataclasses.replace(cfg, impl="pallas", steps_per_exchange=1),
+        dataclasses.replace(
+            cfg, impl="pallas", steps_per_exchange=1,
+            exchange="collective",
+        ),
         mesh=mesh,
         decomp=decomp,
     )
     kind = costmodel.solver_kind(cfg)
-    out = [{"impl": "pallas", "steps_per_exchange": 1}]
+    out = [{"impl": "pallas", "steps_per_exchange": 1,
+            "exchange": "collective"}]
     fused = probe._fused_stepper()
     if fused is None or probe.grid.ndim != 3 or kind is None:
         return out  # heuristic best-available is the only candidate
     fixed_dt = not getattr(cfg, "adaptive_dt", False)
-    out = [{"impl": "pallas_stage", "steps_per_exchange": 1}]
+    out = [{"impl": "pallas_stage", "steps_per_exchange": 1,
+            "exchange": "collective"}]
     slab_ok = fixed_dt
     if slab_ok:
         # slab eligibility via the dispatch's own gate: a pinned probe
@@ -140,7 +145,8 @@ def candidates(solver_cls, cfg, mesh, decomp) -> list:
         try:
             pin = solver_cls(
                 dataclasses.replace(
-                    cfg, impl="pallas_slab", steps_per_exchange=1
+                    cfg, impl="pallas_slab", steps_per_exchange=1,
+                    exchange="collective",
                 ),
                 mesh=mesh,
                 decomp=decomp,
@@ -152,13 +158,46 @@ def candidates(solver_cls, cfg, mesh, decomp) -> list:
             slab_ok = False
     if not slab_ok:
         return out
-    out.append({"impl": "pallas_slab", "steps_per_exchange": 1})
+    out.append({"impl": "pallas_slab", "steps_per_exchange": 1,
+                "exchange": "collective"})
     if mesh is not None and _zslab_only(probe):
         lz = probe.decomp.local_shape(mesh, cfg.grid.shape)[0]
         G = _fused_halo(kind, cfg)
         for k in K_CANDIDATES[1:]:
             if lz >= k * G:
-                out.append({"impl": "pallas_slab", "steps_per_exchange": k})
+                out.append({"impl": "pallas_slab",
+                            "steps_per_exchange": k,
+                            "exchange": "collective"})
+        # in-kernel remote-DMA rung (exchange='dma'): eligibility is
+        # asked from the dispatch's own gates (backend, single-axis
+        # mesh, uniform dma block viability) by constructing a pinned
+        # probe per servable cadence — a raise means the combo cannot
+        # engage. The rung has no credible static cost model (its
+        # point is comm/compute overlap the roofline cannot see), so
+        # it is never pruned: it enters the decision only by WINNING
+        # measurements.
+        for k in K_CANDIDATES:
+            if lz < k * G:
+                continue
+            try:
+                pin = solver_cls(
+                    dataclasses.replace(
+                        cfg, impl="pallas_slab", steps_per_exchange=k,
+                        exchange="dma",
+                    ),
+                    mesh=mesh,
+                    decomp=decomp,
+                )
+                eng = pin.engaged_path()
+            except ValueError:
+                continue
+            if (
+                eng["stepper"] == "fused-whole-run-slab"
+                and eng.get("exchange") == "dma"
+            ):
+                out.append({"impl": "pallas_slab",
+                            "steps_per_exchange": k,
+                            "exchange": "dma"})
     return out
 
 
@@ -171,6 +210,10 @@ def modeled_step_seconds(cfg, lshape, cand, devices: int,
 
     kind = costmodel.solver_kind(cfg)
     if kind is None:
+        return None
+    if cand.get("exchange", "collective") == "dma":
+        # the in-kernel rung's value is overlap the per-step roofline
+        # cannot price; no opinion -> never pruned, always measured
         return None
     stepper = {
         "pallas_slab": "fused-whole-run-slab",
@@ -229,6 +272,7 @@ def measure_candidate(solver_cls, cfg, mesh, decomp, cand,
             cfg,
             impl=cand["impl"],
             steps_per_exchange=cand["steps_per_exchange"],
+            exchange=cand.get("exchange", "collective"),
         ),
         mesh=mesh,
         decomp=decomp,
@@ -368,8 +412,8 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
         # assumption, i.e. the tuner pruned with measured numbers
         peaks=costmodel.peak_info(backend),
         considered=[
-            {k: c[k] for k in ("impl", "steps_per_exchange",
-                               "modeled_us", "pruned")}
+            {k: c.get(k) for k in ("impl", "steps_per_exchange",
+                                   "exchange", "modeled_us", "pruned")}
             for c in cands
         ],
     )
@@ -388,12 +432,14 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
                 c["error"] = f"{type(exc).__name__}: {exc}"[:200]
                 _emit("measure", key=key, impl=c["impl"],
                       steps_per_exchange=c["steps_per_exchange"],
+                      exchange=c.get("exchange", "collective"),
                       error=c["error"])
                 continue
             c.update(m)
             measured.append(c)
             _emit("measure", key=key, impl=c["impl"],
                   steps_per_exchange=c["steps_per_exchange"],
+                  exchange=c.get("exchange", "collective"),
                   mlups=m["mlups"], seconds=m["seconds"])
         if not measured:
             raise RuntimeError(
@@ -404,6 +450,7 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
     decision = {
         "impl": choice["impl"],
         "steps_per_exchange": choice["steps_per_exchange"],
+        "exchange": choice.get("exchange", "collective"),
         "mlups": choice.get("mlups"),
         "source": choice["source"],
         "backend": backend,
@@ -415,9 +462,9 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
         "candidates": [
             {
                 k: c.get(k)
-                for k in ("impl", "steps_per_exchange", "modeled_us",
-                          "pruned", "mlups", "seconds", "spread",
-                          "engaged", "error")
+                for k in ("impl", "steps_per_exchange", "exchange",
+                          "modeled_us", "pruned", "mlups", "seconds",
+                          "spread", "engaged", "error")
                 if k in c
             }
             for c in cands
@@ -428,6 +475,7 @@ def autotune(solver_cls, cfg, mesh, decomp, cache: TuningCache, key: str,
     _emit(
         "decision", key=key, impl=decision["impl"],
         steps_per_exchange=decision["steps_per_exchange"],
+        exchange=decision["exchange"],
         mlups=decision["mlups"], source=decision["source"],
         cache=cache.path,
     )
@@ -485,6 +533,7 @@ def _autotune_ensemble(solver_cls, cfg, mesh, decomp, cache, key,
     decision = {
         "impl": choice["impl"],
         "steps_per_exchange": 1,
+        "exchange": "collective",
         "mlups": choice.get("mlups"),
         "source": "measured",
         "backend": backend,

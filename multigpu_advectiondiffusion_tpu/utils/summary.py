@@ -128,6 +128,8 @@ class RunSummary:
                 line += f", overlap={e['overlap']}"
             if e.get("steps_per_exchange", 1) != 1:
                 line += f", steps/exchange={e['steps_per_exchange']}"
+            if e.get("exchange", "collective") != "collective":
+                line += f", exchange={e['exchange']}"
             line += ")"
             print(f" kernel path        : {line}")
             if e.get("tuned"):

@@ -12,13 +12,15 @@
 #      divergent joins, declared-tag drift, sharding-case registry);
 #   2. --selftest — every rule must TRIP on its seeded violation
 #      fixture (and pass the clean twin), the halo verifier must fail
-#      an injected off-by-one ghost depth naming kernel/axis/depth,
-#      and the collective verifier must fail its seeded deadlock
-#      fixtures (rank-guarded barrier, duplicate tag, divergent join),
-#      sharding fixtures (bad PartitionSpec axis, member-in-spatial),
-#      a bad remote-DMA window, and a non-linearized measured schedule
-#      — so a green gate means "checked and clean", never "checker
-#      silently broke".
+#      an injected off-by-one ghost depth naming kernel/axis/depth
+#      AND an injected overlapping remote-DMA recv window (a neighbor
+#      push landing over rows the receiver is still computing) naming
+#      kernel/axis/rows, and the collective verifier must fail its
+#      seeded deadlock fixtures (rank-guarded barrier, duplicate tag,
+#      divergent join), sharding fixtures (bad PartitionSpec axis,
+#      member-in-spatial), a bad remote-DMA window, and a
+#      non-linearized measured schedule — so a green gate means
+#      "checked and clean", never "checker silently broke".
 #
 # The dynamic half of the collective proof — the 2-proc schedule
 # tracer asserting the MEASURED collective sequence linearizes the

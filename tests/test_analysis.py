@@ -119,9 +119,14 @@ def test_halo_verifier_proves_all_admitted_combos():
         "slab-diffusion[B=2]", "slab-diffusion[B=4]",
         "slab-burgers[o5,B=4]", "slab-burgers[o7,B=4]",
         "ensemble-mesh[members=8]", "ensemble-mesh[members=4,dz=2]",
+        # in-kernel remote-DMA transport (ISSUE 13): the shipped
+        # declaration proven per admitted cadence and order
+        "slab-diffusion[k=1,dma]", "slab-diffusion[k=3,dma]",
+        "slab-burgers[o5,k=1,dma]", "slab-burgers[o5,k=3]",
+        "slab-burgers[o7,k=2,dma]", "slab-burgers[o7,k=3,dma]",
     ):
         assert expect in names, f"combo {expect} missing from the matrix"
-    assert report.checked >= 36
+    assert report.checked >= 49
     # the spatially sharded member fold must DECLINE (constructor
     # gate), mirroring the dispatch's loud rejection — never verify
     declined = {c.name: c.reason for c in report.combos
@@ -382,6 +387,60 @@ def test_remote_dma_on_unsharded_stepper_declines():
     }
     violations = halo_verify.verify_stepper(stepper)
     assert any("no neighbor" in v.what for v in violations)
+
+
+def test_remote_dma_disjointness_and_semaphore_pairing():
+    """The shipped dma rung's full declaration proves clean; an
+    injected overlapping recv window (push landing over the receiver's
+    core — the silent-corruption race), an out-of-core send window and
+    an unpaired semaphore set are each rejected, named."""
+    combo = next(
+        c for c in halo_verify.default_combos()
+        if c.name == "slab-diffusion[k=2,dma]"
+    )
+    stepper = combo.build()
+    assert not halo_verify.verify_stepper(stepper, kernel=combo.name)
+    spec = stepper.stencil_spec()
+    assert spec["exchange"] == "dma"
+    assert spec["remote_dma"]["buffers"] >= 2
+    depth = stepper.exchange_depth
+    pz = stepper.padded_shape[0]
+
+    stepper.remote_dma = dict(spec["remote_dma"])
+    stepper.remote_dma["recv_windows"] = (
+        (depth, 2 * depth), (pz - depth, pz),
+    )
+    v = halo_verify.verify_stepper(stepper, kernel=combo.name)
+    assert any("overlaps the receiver's core" in x.what for x in v)
+
+    stepper.remote_dma = dict(spec["remote_dma"])
+    stepper.remote_dma["send_windows"] = (
+        (0, depth), (pz - 2 * depth, pz - depth),
+    )
+    v = halo_verify.verify_stepper(stepper, kernel=combo.name)
+    assert any("outside the shard's own core" in x.what for x in v)
+
+    stepper.remote_dma = dict(spec["remote_dma"])
+    stepper.remote_dma["semaphores"] = ("send",)
+    v = halo_verify.verify_stepper(stepper, kernel=combo.name)
+    assert any("pair a send and a recv" in x.what for x in v)
+
+
+def test_collective_registry_knows_the_dma_rung():
+    """The dma rung replaces the ppermute site: its kernel sites are
+    extracted as ``remote_dma`` collectives, the declared transport
+    metadata (multihost.collective_spec <- halo.remote_dma_spec)
+    matches both directions, and the dynamic counter profile reads the
+    dma counters — no stale-ppermute false positive on a dma stream."""
+    report = collective_verify.verify_tree()
+    assert report.ok
+    assert any(s.kind == "remote_dma" for s in report.sites)
+    assert "slab[dz=2,exchange=dma]" in report.cases_proven
+    prof = collective_verify.halo_counter_profile([
+        {"kind": "counter", "name": "halo.dma_bytes_per_execution",
+         "axis": 0, "mesh_axis": "dz", "total": 1024},
+    ])
+    assert prof == {("halo.dma_bytes_per_execution", 0, "dz"): 1}
 
 
 def test_verify_trace_accepts_linearization_and_rejects_drift():

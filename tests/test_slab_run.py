@@ -262,6 +262,197 @@ def test_slab_sharded_serialized_refresh_matches_unsharded(devices):
     assert float(jnp.max(jnp.abs(out.u - ref.u))) == 0.0
 
 
+def test_slab_dma_exchange_matches_collective(devices):
+    """The in-kernel remote-DMA exchange (exchange='dma', ISSUE 13):
+    the sharded whole-run program pushes its ghost rows to the ±z
+    neighbors from inside the Pallas kernel instead of breaking out to
+    an XLA ppermute between per-step calls. Same rows move, same
+    per-cell op sequence computes — diffusion must match the collective
+    transport bit-for-bit, at both the per-step (k=1) and the deep
+    (k=2) exchange cadence, in interpret mode on a dz=2 mesh."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(16, 16, 72, lengths=2.0)
+    for k in (1, 2):
+        ref_s = DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype="float32",
+                            impl="pallas_slab", steps_per_exchange=k),
+            mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+        )
+        ref = ref_s.run(ref_s.initial_state(), 5)
+        s = DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype="float32",
+                            impl="pallas_slab", steps_per_exchange=k,
+                            exchange="dma"),
+            mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+        )
+        eng = s.engaged_path()
+        assert eng["stepper"] == "fused-whole-run-slab"
+        assert eng["exchange"] == "dma"
+        assert eng["overlap"] == "in-kernel"
+        fused = s._fused_stepper()
+        spec = fused.stencil_spec()
+        assert spec["remote_dma"] is not None
+        assert spec["remote_dma"]["window_rows"] == fused.exchange_depth
+        out = s.run(s.initial_state(), 5)
+        assert float(jnp.max(jnp.abs(out.u - ref.u))) == 0.0, k
+        assert float(out.t) == float(ref.t)
+
+
+def test_slab_dma_burgers_matches_collective(devices):
+    """WENO5 Burgers through the dma transport vs the collective
+    transport: identical consumed values (the wall replicas are
+    re-synthesized in VMEM either way), ulp-level equality like every
+    sharded WENO pin."""
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(16, 16, 72, lengths=2.0)
+    ref_b = BurgersSolver(
+        BurgersConfig(grid=grid, nu=1e-5, adaptive_dt=False,
+                      dtype="float32", impl="pallas_slab"),
+        mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+    )
+    ref = ref_b.run(ref_b.initial_state(), 4)
+    sb = BurgersSolver(
+        BurgersConfig(grid=grid, nu=1e-5, adaptive_dt=False,
+                      dtype="float32", impl="pallas_slab",
+                      exchange="dma"),
+        mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+    )
+    assert sb.engaged_path()["exchange"] == "dma"
+    out = sb.run(sb.initial_state(), 4)
+    a, d = np.asarray(out.u), np.asarray(ref.u)
+    scale = max(float(np.max(np.abs(d))), 1e-30)
+    assert float(np.max(np.abs(a - d))) <= _ULPS * scale
+    assert float(out.t) == float(ref.t)
+
+
+def test_slab_dma_declines_loudly(devices):
+    """exchange='dma' is pin-semantics: every config that cannot host
+    the in-kernel exchange fails at construction/dispatch instead of
+    silently running the collective cadence — unsharded, pencil
+    meshes, split-overlap, non-TPU/non-interpret backends, and the
+    batched ensemble engine."""
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    grid = Grid.make(16, 16, 72, lengths=2.0)
+
+    # unsharded: no neighbor to push to
+    with pytest.raises(ValueError, match="needs a device mesh"):
+        DiffusionSolver(DiffusionConfig(grid=grid, dtype="float32",
+                                        impl="pallas_slab",
+                                        exchange="dma"))
+    # pencil mesh: the remote-DMA ring is z-slab only
+    with pytest.raises(ValueError, match="z-slab"):
+        DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype="float32",
+                            impl="pallas_slab", exchange="dma"),
+            mesh=make_mesh({"dz": 2, "dy": 2}),
+            decomp=Decomposition.of({0: "dz", 1: "dy"}),
+        )
+    # split-overlap: nothing left at the XLA level to overlap
+    with pytest.raises(ValueError, match="split-overlap"):
+        DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype="float32",
+                            impl="pallas_slab", exchange="dma",
+                            overlap="split"),
+            mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+        )
+    # generic impl cannot host it
+    with pytest.raises(ValueError, match="sharded slab rung"):
+        DiffusionSolver(
+            DiffusionConfig(grid=grid, dtype="float32", impl="xla",
+                            exchange="dma"),
+            mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+        )
+    # ensemble fold: the member axis does not ride the dma ring (the
+    # inner solver is unsharded-spatial, so the mesh gate fires first;
+    # _ensemble_gate backstops the batched dispatch itself)
+    with pytest.raises(ValueError, match="dma"):
+        EnsembleSolver(
+            DiffusionSolver,
+            DiffusionConfig(grid=grid, dtype="float32",
+                            impl="pallas_slab", exchange="dma"),
+            4,
+        )
+
+
+def test_slab_dma_backend_gate(devices, monkeypatch):
+    """A backend with neither the Mosaic TPU target nor the CPU
+    interpret simulator (i.e. a real CPU/GPU lowering) declines the
+    dma rung LOUDLY at dispatch — never a silent collective run."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas import laplacian
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+
+    s = DiffusionSolver(
+        DiffusionConfig(grid=Grid.make(16, 16, 72, lengths=2.0),
+                        dtype="float32", impl="pallas_slab",
+                        exchange="dma"),
+        mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+    )
+    monkeypatch.setattr(laplacian, "interpret_mode", lambda: False)
+    with pytest.raises(ValueError, match="remote DMA needs the TPU"):
+        s._fused_stepper()
+
+
+def test_slab_dma_mosaic_rejection_degrades_to_split(devices, monkeypatch):
+    """The dma rung's own ladder: a Mosaic rejection of the in-kernel
+    program degrades to the split-overlap COLLECTIVE exchange on the
+    same rung/cadence (recorded in engaged_path()['degraded']), and the
+    run completes with the collective trajectory."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas import fused_slab_run
+    from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+        Decomposition,
+        make_mesh,
+    )
+    from multigpu_advectiondiffusion_tpu.resilience.errors import (
+        SimulatedMosaicError,
+    )
+
+    grid = Grid.make(16, 16, 72, lengths=2.0)
+    ref_s = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas_slab"),
+        mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+    )
+    ref = ref_s.run(ref_s.initial_state(), 4)
+
+    def boom(self, *a, **kw):
+        raise SimulatedMosaicError("Mosaic rejected the dma program")
+
+    monkeypatch.setattr(
+        fused_slab_run._SlabRunStepper, "_run_dma", boom
+    )
+    s = DiffusionSolver(
+        DiffusionConfig(grid=grid, dtype="float32", impl="pallas_slab",
+                        exchange="dma"),
+        mesh=make_mesh({"dz": 2}), decomp=Decomposition.slab("dz"),
+    )
+    out = s.run(s.initial_state(), 4)
+    engaged = s.engaged_path()
+    assert engaged["stepper"] == "fused-whole-run-slab"
+    assert engaged["exchange"] == "collective"
+    chain = [(e["from"], e["to"]) for e in engaged["degraded"]]
+    assert chain == [
+        ("fused-whole-run-slab[dma]", "fused-whole-run-slab[split]")
+    ]
+    assert float(jnp.max(jnp.abs(out.u - ref.u))) == 0.0
+
+
 def test_slab_sharded_declines_off_design(devices):
     """Sharded slab stays pinned-only and z-slab-only: plain 'pallas'
     under a mesh keeps the measured per-stage path, pencil meshes

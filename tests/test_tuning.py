@@ -258,7 +258,39 @@ def test_candidate_space_scales_with_shard_depth(devices):
     adaptive = dataclasses.replace(shallow, adaptive_dt=True)
     cands = tuning.candidates(BurgersSolver, adaptive, _mesh2(devices),
                               dec)
-    assert cands == [{"impl": "pallas_stage", "steps_per_exchange": 1}]
+    assert cands == [{"impl": "pallas_stage", "steps_per_exchange": 1,
+                      "exchange": "collective"}]
+
+
+def test_dma_rung_is_a_measured_candidate(devices):
+    """ISSUE 13 acceptance: the in-kernel remote-DMA rung enters the
+    tuner's candidate space (per servable cadence, asked from the
+    dispatch's own gates), is NEVER cost-model-pruned (no credible
+    static model for in-kernel overlap — it engages only by winning
+    measurements), and a persisted decision records ``exchange``."""
+    dec = Decomposition.slab("dz")
+    cfg = DiffusionConfig(
+        grid=Grid.make(8, 8, 72, lengths=2.0), dtype="float32",
+        impl="auto",
+    )
+    cands = tuning.candidates(DiffusionSolver, cfg, _mesh2(devices), dec)
+    dma = [c for c in cands if c.get("exchange") == "dma"]
+    assert dma, cands
+    assert all(c["impl"] == "pallas_slab" for c in dma)
+    # collective candidates keep their modeled pruning metric; the dma
+    # rung has no static opinion and must always be measured
+    assert tuning.modeled_step_seconds(
+        cfg, (36, 8, 8), dma[0], 2, "cpu"
+    ) is None
+    s = DiffusionSolver(cfg, mesh=_mesh2(devices), decomp=dec)
+    d = s._tuned
+    assert d["source"] == "measured"
+    assert "exchange" in d
+    measured = {
+        (c.get("impl"), c.get("steps_per_exchange"), c.get("exchange"))
+        for c in d["candidates"] if c.get("mlups") is not None
+    }
+    assert any(ex == "dma" for _, _, ex in measured), measured
 
 
 def test_auto_on_unsharded_3d_measures_slab_vs_stage():
