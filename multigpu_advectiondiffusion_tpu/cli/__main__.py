@@ -290,6 +290,14 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                         "recompiling (aot_cache:hit events; xla:cost "
                         "records compile_seconds_saved). Corrupt/stale "
                         "entries are misses, writes are atomic")
+    p.add_argument("--dt-scale", type=float, default=1.0, metavar="F",
+                   help="scale the initial time step (fixed-dt "
+                        "solvers) or CFL (adaptive) by F before the "
+                        "run — the scheduler's dt-backoff INHERITANCE "
+                        "knob: a retried job starts at the reduced dt "
+                        "its failed attempt backed off to instead of "
+                        "re-diverging at full dt (applied after resume "
+                        "validation; 1.0 = off)")
     p.add_argument("--overlap", default="padded",
                    choices=["padded", "split"],
                    help="sharded halo schedule: 'padded' exchanges before "
@@ -376,6 +384,7 @@ def _run_diffusion(args, ndim, geometry="cartesian"):
                       snapshots=args.snapshots,
                       snapshot_stride=args.snapshot_stride,
                       snapshot_max_bytes=args.snapshot_max_bytes,
+                      dt_scale=args.dt_scale,
                       metrics_path=getattr(args, "metrics", None),
                       metrics_max_bytes=args.metrics_max_bytes)
 
@@ -432,6 +441,7 @@ def _run_burgers(args, ndim):
                       snapshots=args.snapshots,
                       snapshot_stride=args.snapshot_stride,
                       snapshot_max_bytes=args.snapshot_max_bytes,
+                      dt_scale=args.dt_scale,
                       metrics_path=getattr(args, "metrics", None),
                       metrics_max_bytes=args.metrics_max_bytes)
 
@@ -592,6 +602,29 @@ def build_parser() -> argparse.ArgumentParser:
                             "proves every rule trips on a seeded "
                             "violation")
     check_cli.configure_parser(p)
+
+    # crash-safe multi-run scheduler (service/): a journaled queue of
+    # run requests multiplexed onto the device budget
+    from multigpu_advectiondiffusion_tpu.service import cli as service_cli
+
+    p = sub.add_parser("serve",
+                       help="run the crash-safe job scheduler daemon: "
+                            "journaled queue, admission control "
+                            "(memory watermarks + AOT-warm), priority "
+                            "preemption via the checkpoint-and-exit-75 "
+                            "path, bounded per-policy retries; "
+                            "--verify replays and linearization-checks "
+                            "the journal offline (README 'Service "
+                            "mode')")
+    service_cli.configure_serve(p)
+
+    p = sub.add_parser("submit",
+                       help="park one run request in the scheduler's "
+                            "spool (atomic; works while no daemon "
+                            "runs): submit --root DIR [--priority N "
+                            "--devices P] -- diffusion3d --n ... "
+                            "--iters ...")
+    service_cli.configure_submit(p)
 
     return ap
 

@@ -181,6 +181,7 @@ def run_ensemble_solver(solver_cls, cfg, name: str, args, aliases=None):
         "--diag-every": getattr(args, "diag_every", 0),
         "--progress": getattr(args, "progress", False),
         "--watchdog-timeout": getattr(args, "watchdog_timeout", 0.0),
+        "--dt-scale": (getattr(args, "dt_scale", 1.0) or 1.0) != 1.0,
     }
     offending = [k for k, v in unsupported.items() if v]
     if offending:
@@ -405,6 +406,7 @@ def _run_solver(
     snapshots: int = 0,
     snapshot_stride: int = 1,
     snapshot_max_bytes: int = 0,
+    dt_scale: float = 1.0,
 ) -> RunSummary:
     """Execute the timed solve exactly the way the reference drivers do:
     untimed warm-up/compile, barrier-sandwiched hot loop
@@ -540,6 +542,28 @@ def _run_solver(
     else:
         state = solver.initial_state()
     start_it = int(state.it)
+
+    if dt_scale and float(dt_scale) != 1.0:
+        # dt-backoff inheritance (--dt-scale, the scheduler's retry
+        # knob): start at the reduced step a failed attempt backed off
+        # to. Applied AFTER resume validation — the checkpoint's
+        # recorded physics are compared against the unscaled config —
+        # and through the same scale_dt path the supervisor's in-run
+        # backoff uses, so the two schedules compose.
+        from multigpu_advectiondiffusion_tpu.resilience.supervisor import (
+            scale_dt,
+        )
+
+        what = scale_dt(solver, float(dt_scale))
+        from multigpu_advectiondiffusion_tpu import telemetry
+
+        telemetry.event(
+            "resilience", "dt_inherit",
+            factor=float(dt_scale), action=what,
+        )
+        if is_coord:
+            print(f"dt-scale {float(dt_scale):g}: {what} "
+                  "(inherited backoff)")
 
     # measured introspection: run-scoped device-memory watermarks
     # (supervised chunks sample at cadence; every run samples at the

@@ -252,6 +252,67 @@ def sdc_at_step(solver, step: int, once: bool = True,
         solver.step = orig
 
 
+@contextlib.contextmanager
+def disk_full(targets=("checkpoint", "journal"), times: Optional[int] = None):
+    """Within the context, the named durable-write paths raise
+    ``OSError(ENOSPC)`` — the disk-full fault the scheduler must
+    degrade under instead of dying (ISSUE 14 satellite):
+
+    * ``'checkpoint'`` — ``utils/io.save_checkpoint`` and
+      ``save_checkpoint_sharded`` (a job's checkpoint write fails; the
+      scheduler classifies the attempt ``disk_full``, retries once,
+      then marks the job failed with forensics);
+    * ``'journal'`` — the scheduler journal's raw write
+      (``service/journal.Journal._write``; the journal must park the
+      record, mark itself degraded, and heal in order once the disk
+      frees up).
+
+    ``times=N`` fires only the first N writes (the freed-disk
+    recovery case); ``None`` fires for the context's whole extent.
+    Yields the fired-count dict like the other injectors."""
+    import errno
+
+    from multigpu_advectiondiffusion_tpu.utils import io as io_mod
+
+    fired = {"count": 0}
+
+    def _should_fire() -> bool:
+        if times is not None and fired["count"] >= times:
+            return False
+        fired["count"] += 1
+        return True
+
+    saved = []
+
+    def _patch(owner, name):
+        orig = getattr(owner, name)
+        saved.append((owner, name, orig))
+
+        def inner(*a, **kw):
+            if _should_fire():
+                raise OSError(
+                    errno.ENOSPC, "No space left on device (injected)"
+                )
+            return orig(*a, **kw)
+
+        setattr(owner, name, inner)
+
+    try:
+        if "checkpoint" in targets:
+            _patch(io_mod, "save_checkpoint")
+            _patch(io_mod, "save_checkpoint_sharded")
+        if "journal" in targets:
+            from multigpu_advectiondiffusion_tpu.service.journal import (
+                Journal,
+            )
+
+            _patch(Journal, "_write")
+        yield fired
+    finally:
+        for owner, name, fn in saved:
+            setattr(owner, name, fn)
+
+
 def torn_ckptd_write(directory: str, mode: str = "uncommitted") -> None:
     """Tear a sharded ``.ckptd`` checkpoint directory the way a
     mid-write crash (or bit-rot) would, so the verification/resume path

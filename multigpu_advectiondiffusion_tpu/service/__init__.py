@@ -1,0 +1,60 @@
+"""Crash-safe multi-run scheduler (ISSUE 14, ROADMAP item 5): a
+journaled queue of CLI run requests multiplexed onto the device budget.
+See ``service/daemon.py`` for the architecture and README "Service
+mode" for usage."""
+
+from multigpu_advectiondiffusion_tpu.service.admission import (
+    AdmissionController,
+    WarmLedger,
+    latest_watermark,
+    warm_key,
+)
+from multigpu_advectiondiffusion_tpu.service.daemon import (
+    EXIT_PREEMPTED,
+    EXIT_RANK_FAILURE,
+    EXIT_SDC,
+    InProcessRunner,
+    Scheduler,
+    SubprocessRunner,
+    classify_failure,
+)
+from multigpu_advectiondiffusion_tpu.service.journal import (
+    Journal,
+    verify_records,
+)
+from multigpu_advectiondiffusion_tpu.service.queue import (
+    ALLOWED_TRANSITIONS,
+    STATES,
+    TERMINAL_STATES,
+    JobQueue,
+    JobRecord,
+    JobSpec,
+    ingest_spool,
+    new_job_id,
+    submit_to_spool,
+)
+
+__all__ = [
+    "ALLOWED_TRANSITIONS",
+    "AdmissionController",
+    "EXIT_PREEMPTED",
+    "EXIT_RANK_FAILURE",
+    "EXIT_SDC",
+    "InProcessRunner",
+    "Journal",
+    "JobQueue",
+    "JobRecord",
+    "JobSpec",
+    "STATES",
+    "Scheduler",
+    "SubprocessRunner",
+    "TERMINAL_STATES",
+    "WarmLedger",
+    "classify_failure",
+    "ingest_spool",
+    "latest_watermark",
+    "new_job_id",
+    "submit_to_spool",
+    "verify_records",
+    "warm_key",
+]

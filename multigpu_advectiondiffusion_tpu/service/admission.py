@@ -1,0 +1,165 @@
+"""Admission control: memory watermarks + AOT-warm admission.
+
+Two inputs, both *measured* rather than modeled:
+
+* **device-memory watermarks** (PR 7): every supervised job streams
+  ``mem:watermark`` events into its own telemetry sink; the controller
+  tail-reads the running jobs' streams, sums their latest peaks, adds
+  the candidate's *expected* peak (from the warm ledger when a prior
+  identical job recorded one) and defers admission while the total
+  would breach the configured budget. No budget (0) = unmetered — the
+  CPU container has no device limit to respect.
+* **the AOT executable cache** (PR 9): a job whose exact request
+  already ran to completion against the shared per-root cache is
+  *warm* — admitting it costs a deserialize, not a compile. The warm
+  ledger maps the request fingerprint to the measured facts of the
+  completed run (compile seconds the cache now saves, the observed
+  memory peak) and is rebuilt from the journal on recovery, so a
+  restarted scheduler keeps its warm knowledge.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+
+def warm_key(argv, mesh_arg: Optional[str] = None) -> str:
+    """Fingerprint of one run request: the spec argv plus the granted
+    mesh (a different mesh compiles a different executable, so it is a
+    different warmth)."""
+    body = json.dumps([list(argv), mesh_arg or ""])
+    return hashlib.sha256(body.encode()).hexdigest()[:16]
+
+
+def latest_watermark(events_path: str,
+                     tail_bytes: int = 131072) -> Optional[int]:
+    """The newest ``mem:watermark`` peak (bytes) in a job's telemetry
+    stream, read from a bounded tail so the admission pass stays O(1)
+    per running job. None when the stream (or the event) is absent."""
+    try:
+        size = os.path.getsize(events_path)
+        with open(events_path, "rb") as f:
+            f.seek(max(0, size - tail_bytes))
+            text = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    peak = None
+    for line in text.splitlines():
+        if '"mem"' not in line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue  # torn tail / partial first line of the window
+        if ev.get("kind") == "mem" and ev.get("name") == "watermark":
+            got = ev.get("peak_bytes") or ev.get("bytes_in_use")
+            if got is not None:
+                peak = int(got)
+    return peak
+
+
+class WarmLedger:
+    """Request fingerprint -> measured facts of a completed identical
+    run. Journal-rebuilt (the scheduler records the ledger entry in the
+    job's ``done`` transition payload), so warmth survives the
+    scheduler's own death exactly like the queue does."""
+
+    def __init__(self):
+        self._entries: Dict[str, dict] = {}
+
+    def observe(self, key: str, compile_seconds: float = 0.0,
+                peak_bytes: Optional[int] = None) -> dict:
+        entry = {
+            "compile_seconds": float(compile_seconds or 0.0),
+            "peak_bytes": int(peak_bytes) if peak_bytes else None,
+        }
+        self._entries[key] = entry
+        return entry
+
+    def lookup(self, key: str) -> Optional[dict]:
+        return self._entries.get(key)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class AdmissionController:
+    """Decides admit/defer for the highest-priority runnable job.
+
+    ``decide`` returns ``(verdict, info)`` where verdict is ``"admit"``
+    or ``"defer"``; info carries the granted device count, warmth and
+    the memory accounting — the fields the ``sched:admit``/
+    ``sched:defer`` events publish.
+    """
+
+    def __init__(self, device_budget: int = 1,
+                 mem_budget_bytes: int = 0,
+                 ledger: Optional[WarmLedger] = None):
+        self.device_budget = max(1, int(device_budget))
+        self.mem_budget_bytes = int(mem_budget_bytes or 0)
+        self.ledger = ledger if ledger is not None else WarmLedger()
+
+    # ------------------------------------------------------------------ #
+    def grant_devices(self, requested: int, free: int) -> int:
+        """The elastic slice rule: the largest divisor of the request
+        that fits the free devices (>= 1) — a preempted 4-way job
+        resumes 2-way when only 2 devices freed up, never 3-way into a
+        grid its request was not shaped for."""
+        want = max(1, int(requested or 1))
+        free = max(0, int(free))
+        if free <= 0:
+            return 0
+        for d in range(min(want, free), 0, -1):
+            if want % d == 0:
+                return d
+        return 1
+
+    def mesh_arg(self, spec, granted: int) -> Optional[str]:
+        if granted <= 1:
+            return None
+        return spec.mesh_template.format(devices=granted)
+
+    # ------------------------------------------------------------------ #
+    def observed_memory(self, running_streams: List[str]) -> int:
+        """Sum of the running jobs' latest watermark peaks."""
+        total = 0
+        for path in running_streams:
+            peak = latest_watermark(path)
+            if peak:
+                total += peak
+        return total
+
+    def decide(self, record, free_slots: int, free_devices: int,
+               running_streams: List[str]) -> tuple:
+        spec = record.spec
+        if free_slots <= 0:
+            return "defer", {"reason": "slots", "free_slots": 0}
+        granted = self.grant_devices(spec.devices, free_devices)
+        if granted <= 0:
+            return "defer", {
+                "reason": "devices",
+                "requested": spec.devices,
+                "free_devices": free_devices,
+            }
+        key = warm_key(spec.argv, self.mesh_arg(spec, granted))
+        warm = self.ledger.lookup(key)
+        info = {
+            "granted_devices": granted,
+            "warm": warm is not None,
+            "warm_key": key,
+            "expected_compile_seconds_saved": (
+                warm["compile_seconds"] if warm else None
+            ),
+        }
+        if self.mem_budget_bytes:
+            in_use = self.observed_memory(running_streams)
+            estimate = (warm or {}).get("peak_bytes") or 0
+            info.update(mem_in_use=in_use, mem_estimate=estimate,
+                        mem_budget=self.mem_budget_bytes)
+            if in_use + estimate > self.mem_budget_bytes:
+                info["reason"] = "memory"
+                return "defer", info
+        return "admit", info

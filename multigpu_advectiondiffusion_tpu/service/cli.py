@@ -1,0 +1,171 @@
+"""``serve``/``submit`` CLI verbs for the crash-safe scheduler.
+
+::
+
+    # submit three requests (works with or without a live daemon)
+    python -m multigpu_advectiondiffusion_tpu.cli submit --root runs/ \
+        --job-id j1 -- diffusion3d --n 64 64 64 --iters 2000 \
+        --checkpoint-every 100 --sentinel-every 100
+    # start the daemon; --until-idle returns once the queue drains
+    python -m multigpu_advectiondiffusion_tpu.cli serve --root runs/ \
+        --max-concurrent 2 --devices 8 --until-idle
+    # offline: replay + linearization-check the journal
+    python -m multigpu_advectiondiffusion_tpu.cli serve --root runs/ \
+        --verify --require-complete
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def configure_serve(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--root", required=True, metavar="DIR",
+                   help="scheduler root: journal.jsonl, spool/, "
+                        "jobs/<id>/ namespaces, the shared AOT cache "
+                        "and the daemon's sched_events.jsonl live here")
+    p.add_argument("--max-concurrent", type=int, default=1, metavar="N",
+                   help="run slots: jobs admitted at once (default 1)")
+    p.add_argument("--devices", type=int, default=1, metavar="P",
+                   help="device budget the admission controller "
+                        "carves mesh slices from (default 1)")
+    p.add_argument("--mem-budget-mb", type=int, default=0, metavar="MB",
+                   help="defer admission while the running jobs' "
+                        "measured mem:watermark peaks plus the "
+                        "candidate's expected peak exceed this "
+                        "(0 = unmetered)")
+    p.add_argument("--poll", type=float, default=0.2, metavar="S",
+                   help="scheduler loop cadence in seconds")
+    p.add_argument("--until-idle", action="store_true",
+                   help="exit once every job is terminal (the gate/CI "
+                        "mode); default: serve until SIGTERM/SIGINT, "
+                        "which drains running jobs through their "
+                        "checkpoint-and-exit-75 preemption path first")
+    p.add_argument("--no-aot-cache", action="store_true",
+                   help="disable the shared per-root AOT executable "
+                        "cache (warm admission loses its "
+                        "deserialize-instead-of-compile path)")
+    p.add_argument("--verify", action="store_true",
+                   help="no daemon: replay the journal, print the "
+                        "queue state table, and exit nonzero when the "
+                        "journal does not linearize (illegal or "
+                        "out-of-order transitions)")
+    p.add_argument("--require-complete", action="store_true",
+                   help="with --verify: also fail when any submitted "
+                        "job never reached done/failed, or the journal "
+                        "has torn lines — the sched_gate.sh assertion")
+    p.set_defaults(fn=run_serve)
+
+
+def configure_submit(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--root", required=True, metavar="DIR")
+    p.add_argument("--job-id", default=None,
+                   help="stable id (default: generated); also the "
+                        "job's directory name under <root>/jobs/")
+    p.add_argument("--priority", type=int, default=0,
+                   help="higher runs first; a strictly higher arrival "
+                        "preempts a running lower-priority job through "
+                        "the checkpoint-and-exit-75 path")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="bounded retry budget per failure policy")
+    p.add_argument("--devices", type=int, default=0,
+                   help="device request; the scheduler grants the "
+                        "largest divisor that fits the free slice "
+                        "(elastic resume may re-admit on a smaller "
+                        "slice than the first attempt ran on)")
+    p.add_argument("--mesh-template", default="dz={devices}",
+                   help="mesh spec formatted with the granted device "
+                        "count when > 1 (default 'dz={devices}')")
+    p.add_argument("--env", action="append", default=[],
+                   metavar="KEY=VAL",
+                   help="environment override for the job's worker "
+                        "process; repeatable")
+    p.add_argument("argv", nargs=argparse.REMAINDER,
+                   help="the job's CLI request after '--': model + "
+                        "flags (the scheduler owns --save/--metrics/"
+                        "--resume/--mesh/--aot-cache)")
+    p.set_defaults(fn=run_submit)
+
+
+def run_serve(args) -> None:
+    from multigpu_advectiondiffusion_tpu.service.daemon import Scheduler
+    from multigpu_advectiondiffusion_tpu.service.journal import (
+        Journal,
+        verify_records,
+    )
+    from multigpu_advectiondiffusion_tpu.service.queue import JobQueue
+
+    if args.verify:
+        journal_path = os.path.join(args.root, "journal.jsonl")
+        records, torn = Journal.replay(journal_path)
+        problems = verify_records(
+            records, torn=torn,
+            require_complete=args.require_complete,
+        )
+        # the state table, rebuilt exactly the way recovery would
+        q, report = JobQueue.replay(Journal(journal_path, fsync=False))
+        print(f"-- journal {journal_path}: {len(records)} record(s), "
+              f"{torn} torn line(s), {len(q.jobs)} job(s)")
+        for rec in sorted(q.jobs.values(), key=lambda r: r.order):
+            print(f"   {rec.job_id:<24} {rec.state:<13} "
+                  f"attempts={rec.attempts} "
+                  f"failures={len(rec.failures)} "
+                  f"dt_scale={rec.dt_scale:g}")
+        for msg in report.get("problems", []):
+            problems.append(f"replay: {msg}")
+        for msg in problems:
+            print(f"   PROBLEM: {msg}", file=sys.stderr)
+        if problems:
+            raise SystemExit(1)
+        print("-- journal linearizes")
+        return None
+
+    sched = Scheduler(
+        args.root,
+        max_concurrent=args.max_concurrent,
+        device_budget=args.devices,
+        mem_budget_bytes=args.mem_budget_mb * (1 << 20),
+        poll_seconds=args.poll,
+        aot_cache=not args.no_aot_cache,
+    )
+    try:
+        outcome = sched.serve(until_idle=args.until_idle)
+    finally:
+        sched.close()
+    states = outcome.get("states", {})
+    print(f"-- serve: {outcome.get('reason')}; "
+          + ", ".join(f"{k}={v}" for k, v in sorted(states.items())))
+    if outcome.get("reason") == "stalled":
+        raise SystemExit(2)
+    return None
+
+
+def run_submit(args) -> None:
+    from multigpu_advectiondiffusion_tpu.service.queue import (
+        JobSpec,
+        new_job_id,
+        submit_to_spool,
+    )
+
+    argv = list(args.argv)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    env = {}
+    for item in args.env:
+        key, _, val = item.partition("=")
+        env[key] = val
+    spec = JobSpec(
+        job_id=args.job_id or new_job_id(),
+        argv=argv,
+        priority=args.priority,
+        max_retries=args.max_retries,
+        devices=args.devices,
+        mesh_template=args.mesh_template,
+        env=env,
+    )
+    path = submit_to_spool(args.root, spec)
+    print(f"-- submitted {spec.job_id} (priority {spec.priority}) "
+          f"-> {path}")
+    return None

@@ -1,0 +1,241 @@
+"""Write-ahead journal for the job scheduler: crash-safe by replay.
+
+One JSONL file, append-only. Every record is a *commit record*: the
+line carries a CRC32 of its own canonical serialization, and the append
+flushes + fsyncs before the caller acts on the transition — the WAL
+discipline (journal first, act second), so a scheduler SIGKILLed at any
+instant can rebuild its exact queue state by replaying the journal.
+
+Failure containment mirrors the rest of the repo:
+
+* **torn tails** — a crash mid-append leaves a partial last line (or a
+  line whose CRC no longer matches). Replay skips and *counts* torn
+  lines instead of failing, the ``telemetry/analyze.load_stream``
+  discipline for crashed ranks' event streams;
+* **ENOSPC** (``resilience/faults.disk_full``) — an append that cannot
+  reach the disk retries once, then parks the record in an in-memory
+  pending buffer and marks the journal *degraded* instead of killing
+  the daemon; the next successful append drains the buffer in order,
+  so a freed disk heals the journal without losing sequencing.
+
+Records are dicts with an envelope of ``seq`` (strictly increasing),
+``wall`` (epoch seconds), ``type`` (``submit``/``state``/``note``) and
+the caller's fields; the ``crc`` field commits the rest.
+"""
+
+from __future__ import annotations
+
+import binascii
+import json
+import os
+import time
+from typing import List, Optional, Tuple
+
+JOURNAL_SCHEMA = 1
+
+
+def _crc(body: str) -> str:
+    return f"{binascii.crc32(body.encode()) & 0xFFFFFFFF:08x}"
+
+
+def _seal(rec: dict) -> str:
+    """Serialize ``rec`` with its commit CRC appended."""
+    body = json.dumps(rec, sort_keys=True)
+    return json.dumps({**rec, "crc": _crc(body)}, sort_keys=True)
+
+
+def _check(rec: dict) -> bool:
+    """True when ``rec``'s CRC commits its own content."""
+    got = rec.get("crc")
+    if not isinstance(got, str):
+        return False
+    body = {k: v for k, v in rec.items() if k != "crc"}
+    return _crc(json.dumps(body, sort_keys=True)) == got
+
+
+class Journal:
+    """Append-side handle. Replay is a classmethod so readers never
+    need (or take) the writer's file handle."""
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self._fsync = bool(fsync)
+        self._f = None
+        self.degraded = False
+        self._pending: List[str] = []
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        # continue the sequence a previous incarnation committed — the
+        # replay cost is paid once, at open
+        records, _ = self.replay(path)
+        self._seq = max((r.get("seq", 0) for r in records), default=0)
+
+    # ------------------------------------------------------------------ #
+    def append(self, rtype: str, **fields) -> dict:
+        """Journal one commit record; returns the record (its
+        ``durable`` key is False only while the journal is degraded and
+        the record sits in the pending buffer)."""
+        self._seq += 1
+        rec = {
+            "seq": self._seq,
+            "wall": round(time.time(), 6),
+            "type": str(rtype),
+            **fields,
+        }
+        line = _seal(rec)
+        durable = self._commit(line)
+        rec["durable"] = durable
+        return rec
+
+    def _commit(self, line: str) -> bool:
+        """Drain any pending records, then write ``line``; one retry on
+        an OSError (ENOSPC and friends), then degrade instead of raise."""
+        backlog = self._pending + [line]
+        for attempt in (0, 1):
+            try:
+                self._write("\n".join(backlog) + "\n")
+                self._pending = []
+                self.degraded = False
+                return True
+            except OSError:
+                # a failed write leaves the handle in an unknown state;
+                # reopen before the retry
+                self._close_handle()
+                if attempt == 0:
+                    continue
+                self._pending = backlog
+                self.degraded = True
+                return False
+        return False  # unreachable
+
+    def _write(self, text: str) -> None:
+        """The raw durable write (patched by ``faults.disk_full``)."""
+        if self._f is None or self._f.closed:
+            self._f = open(self.path, "a")
+        self._f.write(text)
+        self._f.flush()
+        if self._fsync:
+            os.fsync(self._f.fileno())
+
+    def _close_handle(self) -> None:
+        try:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
+        except OSError:
+            pass
+        self._f = None
+
+    def close(self) -> None:
+        if self._pending:
+            # last chance for parked records (disk may have freed up)
+            self._commit_pending_best_effort()
+        self._close_handle()
+
+    def _commit_pending_best_effort(self) -> None:
+        backlog, self._pending = self._pending, []
+        try:
+            self._write("\n".join(backlog) + "\n")
+            self.degraded = False
+        except OSError:
+            self._pending = backlog
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def replay(path: str) -> Tuple[List[dict], int]:
+        """Read every committed record, tolerating torn lines. Returns
+        ``(records, torn_count)`` — torn means unparseable JSON, a
+        non-dict line, or a CRC that no longer commits its content
+        (a mid-write crash or bit rot)."""
+        if not os.path.exists(path):
+            return [], 0
+        records: List[dict] = []
+        torn = 0
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    continue
+                if not isinstance(rec, dict) or not _check(rec):
+                    torn += 1
+                    continue
+                records.append(rec)
+        return records, torn
+
+
+def verify_records(records: List[dict],
+                   torn: int = 0,
+                   allowed_transitions=None,
+                   require_complete: bool = False) -> List[str]:
+    """Structural linearization check over replayed records: sequence
+    numbers strictly increase, every transition names a submitted job,
+    every (from, to) pair is legal, and — with ``require_complete`` —
+    every submitted job reached a terminal state. Returns a list of
+    problem strings (empty = the journal linearizes)."""
+    from multigpu_advectiondiffusion_tpu.service.queue import (
+        ALLOWED_TRANSITIONS,
+        TERMINAL_STATES,
+    )
+
+    allowed = allowed_transitions or ALLOWED_TRANSITIONS
+    problems: List[str] = []
+    last_seq: Optional[int] = None
+    state: dict = {}
+    for rec in records:
+        seq = rec.get("seq")
+        if not isinstance(seq, int):
+            problems.append(f"record without integer seq: {rec}")
+            continue
+        if last_seq is not None and seq <= last_seq:
+            problems.append(
+                f"seq {seq} does not advance past {last_seq}"
+            )
+        last_seq = seq
+        rtype = rec.get("type")
+        job = rec.get("job")
+        if rtype == "submit":
+            if job in state:
+                problems.append(f"seq {seq}: duplicate submit of {job!r}")
+            state[job] = "queued"
+        elif rtype == "state":
+            if job not in state:
+                problems.append(
+                    f"seq {seq}: transition for unsubmitted job {job!r}"
+                )
+                continue
+            frm, to = rec.get("from"), rec.get("to")
+            if frm != state[job]:
+                problems.append(
+                    f"seq {seq}: {job!r} transition from {frm!r} but "
+                    f"journal has it in {state[job]!r}"
+                )
+            if (frm, to) not in allowed:
+                problems.append(
+                    f"seq {seq}: illegal transition {frm!r} -> {to!r} "
+                    f"for {job!r}"
+                )
+            state[job] = to
+        elif rtype != "note":
+            problems.append(f"seq {seq}: unknown record type {rtype!r}")
+    if require_complete:
+        if torn:
+            problems.append(f"{torn} torn journal line(s)")
+        for job, st in sorted(state.items()):
+            if st not in TERMINAL_STATES:
+                problems.append(
+                    f"job {job!r} never reached a terminal state "
+                    f"(journal leaves it {st!r})"
+                )
+    return problems

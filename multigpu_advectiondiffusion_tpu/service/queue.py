@@ -1,0 +1,312 @@
+"""Job specs, the per-job state machine, and the journal-backed queue.
+
+States follow the lifecycle the scheduler journals::
+
+    queued -> admitted -> running -> checkpointed -> done | failed
+                 |            \\--------/    |
+                 \\<--------- (requeue: preempted / retry / recovery)
+
+Every transition is appended to the write-ahead journal *before* the
+queue's in-memory state changes (``service/journal.py``), so a replay
+reconstructs exactly what the dead scheduler knew. Requeues carry their
+reason (preemption, a classified failure with its retry policy, or
+crash recovery) in the journal payload — the per-job failure ledger is
+rebuilt from those records, not from a second source of truth.
+
+Submission also works while no daemon runs: ``submit_to_spool`` parks
+an atomic spec file under ``<root>/spool/`` and the daemon ingests it
+into the journal on its next pass (the crash-safe mailbox — two
+processes never append to one journal).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from multigpu_advectiondiffusion_tpu.service.journal import Journal
+
+#: lifecycle states (ISSUE 14); ``preempted`` is transient — the
+#: scheduler requeues a preempted job in the same pass
+STATES = (
+    "queued", "admitted", "running", "checkpointed", "preempted",
+    "done", "failed",
+)
+TERMINAL_STATES = frozenset({"done", "failed"})
+
+#: legal (from, to) pairs — ``verify_records`` holds the journal to
+#: this table, so a buggy scheduler write trips the gate
+ALLOWED_TRANSITIONS = frozenset({
+    ("queued", "admitted"),
+    ("admitted", "running"),
+    ("admitted", "queued"),          # recovery: admitted but never ran
+    ("running", "checkpointed"),
+    ("running", "preempted"),
+    ("running", "done"),
+    ("running", "failed"),
+    ("running", "queued"),           # retry / crash recovery
+    ("checkpointed", "preempted"),
+    ("checkpointed", "done"),
+    ("checkpointed", "failed"),
+    ("checkpointed", "queued"),      # retry / crash recovery
+    ("preempted", "queued"),         # requeue for elastic resume
+})
+
+#: flags the scheduler owns — a spec carrying one would fight the
+#: per-job namespacing (``--save``), the journal (``--resume``) or the
+#: daemon's device accounting (``--mesh``)
+_FORBIDDEN_FLAGS = (
+    "--save", "--metrics", "--resume", "--coordinator",
+    "--num-processes", "--process-id", "--aot-cache", "--mesh",
+    "--dt-scale",
+)
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """One run request: the CLI argv (model + physics/supervision
+    flags) plus scheduling metadata. JSON round-trips for the spool
+    and the journal."""
+
+    job_id: str
+    argv: List[str]
+    priority: int = 0
+    max_retries: int = 2
+    #: device request (0/1 = unsharded); the scheduler grants the
+    #: largest divisor of this that fits the free slice — the elastic
+    #: "whatever mesh slice frees up" rule
+    devices: int = 0
+    #: mesh spec template formatted with the *granted* device count
+    mesh_template: str = "dz={devices}"
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.job_id or "/" in self.job_id or ".." in self.job_id:
+            raise ValueError(f"bad job id {self.job_id!r}")
+        if not self.argv:
+            raise ValueError("empty job argv")
+        bad = sorted(
+            {f for f in _FORBIDDEN_FLAGS
+             for a in self.argv if a == f or a.startswith(f + "=")}
+        )
+        if bad:
+            raise ValueError(
+                f"job {self.job_id}: {bad} are scheduler-owned flags — "
+                "the daemon assigns per-job directories, telemetry "
+                "sinks, resume sources, meshes and inherited dt scales "
+                "itself"
+            )
+        if self.devices and self.devices < 0:
+            raise ValueError("devices request must be >= 0")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, data: dict) -> "JobSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+
+def new_job_id() -> str:
+    return f"job-{int(time.time())}-{uuid.uuid4().hex[:6]}"
+
+
+@dataclasses.dataclass
+class JobRecord:
+    """In-memory view of one job, rebuilt from the journal on replay."""
+
+    spec: JobSpec
+    state: str = "queued"
+    order: int = 0            # FIFO tiebreak within a priority band
+    attempts: int = 0
+    pid: Optional[int] = None
+    granted_devices: int = 0
+    #: inherited dt backoff across attempts (``--dt-scale``): a
+    #: diverged attempt multiplies it by the spec's --dt-backoff
+    dt_scale: float = 1.0
+    #: failure ledger: one entry per failed attempt — rc, policy,
+    #: reason, wall (rebuilt from requeue/failed journal payloads)
+    failures: List[dict] = dataclasses.field(default_factory=list)
+    preempt_requested: bool = False
+    warm: bool = False
+
+    @property
+    def job_id(self) -> str:
+        return self.spec.job_id
+
+    def sort_key(self) -> tuple:
+        return (-self.spec.priority, self.order)
+
+
+class JobQueue:
+    """The journal-backed queue: every mutation journals first."""
+
+    def __init__(self, journal: Journal):
+        self.journal = journal
+        self.jobs: Dict[str, JobRecord] = {}
+        self._order = 0
+
+    # ------------------------------------------------------------------ #
+    def submit(self, spec: JobSpec) -> JobRecord:
+        spec.validate()
+        if spec.job_id in self.jobs:
+            raise ValueError(f"job id {spec.job_id!r} already submitted")
+        self.journal.append("submit", job=spec.job_id,
+                            spec=spec.to_json())
+        return self._apply_submit(spec)
+
+    def _apply_submit(self, spec: JobSpec) -> JobRecord:
+        self._order += 1
+        rec = JobRecord(spec=spec, order=self._order)
+        self.jobs[spec.job_id] = rec
+        return rec
+
+    def transition(self, job_id: str, to: str, **info) -> JobRecord:
+        rec = self.jobs[job_id]
+        frm = rec.state
+        if (frm, to) not in ALLOWED_TRANSITIONS:
+            raise ValueError(
+                f"illegal transition {frm!r} -> {to!r} for {job_id}"
+            )
+        self.journal.append("state", job=job_id,
+                            **{"from": frm, "to": to}, **info)
+        self._apply_transition(rec, frm, to, info)
+        return rec
+
+    def _apply_transition(self, rec: JobRecord, frm: str, to: str,
+                          info: dict) -> None:
+        rec.state = to
+        if "pid" in info:
+            rec.pid = info["pid"]
+        if "attempt" in info:
+            rec.attempts = max(rec.attempts, int(info["attempt"]))
+        if "granted_devices" in info:
+            rec.granted_devices = int(info["granted_devices"])
+        if "dt_scale" in info:
+            rec.dt_scale = float(info["dt_scale"])
+        if "failure" in info and isinstance(info["failure"], dict):
+            rec.failures.append(info["failure"])
+        if to == "queued":
+            rec.pid = None
+            rec.preempt_requested = False
+            rec.granted_devices = 0  # the reservation frees with the slot
+
+    # ------------------------------------------------------------------ #
+    def runnable(self) -> List[JobRecord]:
+        """Queued jobs, highest priority first, FIFO within a band."""
+        return sorted(
+            (r for r in self.jobs.values() if r.state == "queued"),
+            key=JobRecord.sort_key,
+        )
+
+    def in_flight(self) -> List[JobRecord]:
+        return [r for r in self.jobs.values()
+                if r.state in ("admitted", "running", "checkpointed")]
+
+    def open_jobs(self) -> List[JobRecord]:
+        return [r for r in self.jobs.values()
+                if r.state not in TERMINAL_STATES]
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def replay(cls, journal: Journal) -> Tuple["JobQueue", dict]:
+        """Rebuild a queue from ``journal.path``. Illegal records are
+        skipped (and reported) rather than fatal — a half-written
+        journal must still yield the best-effort queue a recovering
+        daemon can act on."""
+        records, torn = Journal.replay(journal.path)
+        q = cls(journal)
+        problems: List[str] = []
+        for rec in records:
+            rtype, job = rec.get("type"), rec.get("job")
+            if rtype == "submit":
+                try:
+                    spec = JobSpec.from_json(rec.get("spec") or {})
+                    spec.validate()
+                except (TypeError, ValueError) as err:
+                    problems.append(f"seq {rec.get('seq')}: bad spec: {err}")
+                    continue
+                if spec.job_id in q.jobs:
+                    problems.append(
+                        f"seq {rec.get('seq')}: duplicate submit {job!r}"
+                    )
+                    continue
+                q._apply_submit(spec)
+            elif rtype == "state":
+                r = q.jobs.get(job)
+                if r is None:
+                    problems.append(
+                        f"seq {rec.get('seq')}: state for unknown {job!r}"
+                    )
+                    continue
+                frm, to = rec.get("from"), rec.get("to")
+                if frm != r.state or (frm, to) not in ALLOWED_TRANSITIONS:
+                    problems.append(
+                        f"seq {rec.get('seq')}: skipping illegal "
+                        f"{frm!r}->{to!r} for {job!r} (state {r.state!r})"
+                    )
+                    continue
+                q._apply_transition(r, frm, to, rec)
+        report = {
+            "records": len(records),
+            "torn_lines": torn,
+            "problems": problems,
+            "jobs": len(q.jobs),
+        }
+        return q, report
+
+
+# --------------------------------------------------------------------- #
+# Spool: the multi-writer-safe submission mailbox
+# --------------------------------------------------------------------- #
+def spool_dir(root: str) -> str:
+    return os.path.join(root, "spool")
+
+
+def submit_to_spool(root: str, spec: JobSpec) -> str:
+    """Atomically park ``spec`` for the daemon (tmp + rename in the
+    spool directory, the repo's persistent-write discipline). Usable
+    while no daemon runs — specs wait until one ingests them."""
+    spec.validate()
+    d = spool_dir(root)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{spec.job_id}.json")
+    if os.path.exists(path):
+        raise ValueError(f"job id {spec.job_id!r} already spooled")
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".spec_", suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(spec.to_json(), f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def ingest_spool(root: str, queue: JobQueue) -> List[JobRecord]:
+    """Move every parked spec into the journal-backed queue; a spec
+    whose id the journal already knows (the daemon died between
+    journaling and unlinking) is deduplicated by dropping the spool
+    file. Unparseable spec files are left in place for the operator.
+    Returns the newly ingested records."""
+    d = spool_dir(root)
+    if not os.path.isdir(d):
+        return []
+    ingested: List[JobRecord] = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                spec = JobSpec.from_json(json.load(f))
+            spec.validate()
+        except (ValueError, TypeError, OSError):
+            continue  # malformed spec: leave for the operator
+        if spec.job_id not in queue.jobs:
+            ingested.append(queue.submit(spec))
+        os.remove(path)
+    return ingested

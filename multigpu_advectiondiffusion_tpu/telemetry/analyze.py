@@ -652,6 +652,82 @@ def perf_events(streams: List[Stream]) -> dict:
     return {"outliers": outliers, "histograms": histograms}
 
 
+def scheduler_timeline(streams: List[Stream]) -> dict:
+    """Queue timeline from a scheduler's ``sched:*``/``job:*`` events
+    (``service/daemon.py`` streams them to ``sched_events.jsonl``):
+    per-job state trajectories with aligned times, attempt counts,
+    preemptions and retry classifications — the consumable view of the
+    journal. Empty dict when the streams carry no scheduler events."""
+    jobs: Dict[str, dict] = {}
+    preempts = []
+    recoveries = []
+
+    def _job(jid) -> dict:
+        return jobs.setdefault(jid, {
+            "job": jid, "states": [], "attempts": 0, "priority": None,
+            "retries": [], "warm": None, "final": None,
+        })
+
+    for s in streams:
+        for ev in s.events:
+            kind, name = ev.get("kind"), ev.get("name")
+            if kind == "job":
+                j = _job(ev.get("job"))
+                gt = round(s.gt(ev), 6)
+                if name == "submit":
+                    j["priority"] = ev.get("priority")
+                    j["states"].append({"t": gt, "state": "queued"})
+                elif name == "state":
+                    j["states"].append(
+                        {"t": gt, "state": ev.get("to"),
+                         "reason": ev.get("reason")}
+                    )
+                    j["final"] = ev.get("to")
+                elif name == "start":
+                    j["attempts"] = max(
+                        j["attempts"], int(ev.get("attempt") or 0)
+                    )
+                    if j["warm"] is None:
+                        j["warm"] = ev.get("warm")
+            elif kind == "sched":
+                if name == "preempt":
+                    preempts.append({
+                        "t": round(s.gt(ev), 6),
+                        "victim": ev.get("victim"),
+                        "for_job": ev.get("for_job"),
+                        "blocked": ev.get("blocked"),
+                    })
+                elif name == "retry":
+                    _job(ev.get("job"))["retries"].append({
+                        "t": round(s.gt(ev), 6),
+                        "policy": ev.get("policy"),
+                        "dt_scale": ev.get("dt_scale"),
+                    })
+                elif name == "recover":
+                    recoveries.append({
+                        "t": round(s.gt(ev), 6),
+                        "records": ev.get("records"),
+                        "torn_lines": ev.get("torn_lines"),
+                        "adopted": ev.get("adopted"),
+                        "requeued": ev.get("requeued"),
+                    })
+    if not jobs and not recoveries:
+        return {}
+    for j in jobs.values():
+        ts = [p["t"] for p in j["states"]]
+        j["span_s"] = (
+            round(max(ts) - min(ts), 6) if len(ts) > 1 else 0.0
+        )
+    return {
+        "jobs": sorted(
+            jobs.values(),
+            key=lambda j: j["states"][0]["t"] if j["states"] else 0.0,
+        ),
+        "preemptions": preempts,
+        "recoveries": recoveries,
+    }
+
+
 # --------------------------------------------------------------------- #
 # The report
 # --------------------------------------------------------------------- #
@@ -671,6 +747,9 @@ class TraceReport:
     # per-rank observable trajectories, tolerance-rule breaches and the
     # Gaussian decay-rate fit — empty on undiagnosed runs
     physics: dict = dataclasses.field(default_factory=dict)
+    # scheduler queue timeline (sched:*/job:* events from a service
+    # daemon's stream) — empty on batch-mode streams
+    queue: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -821,6 +900,29 @@ class TraceReport:
                         f"[{v['rule']}]: {v['message']}")
             else:
                 add("   no tolerance-rule violations")
+        if self.queue.get("jobs") or self.queue.get("recoveries"):
+            add("-" * 68)
+            add(" job queue timeline (scheduler sched:*/job:* events)")
+            for rc in self.queue.get("recoveries", ()):
+                add(f"   t={rc['t']:.3f} recovery: "
+                    f"{rc.get('records')} journal record(s), "
+                    f"{rc.get('torn_lines')} torn, "
+                    f"{rc.get('adopted')} adopted, "
+                    f"{rc.get('requeued')} requeued")
+            for j in self.queue.get("jobs", ()):
+                chain = " -> ".join(
+                    p["state"] for p in j["states"]
+                ) or "?"
+                warm = " [warm]" if j.get("warm") else ""
+                add(f"   {j['job']} (pri {j.get('priority')}, "
+                    f"{j['attempts']} attempt(s), "
+                    f"{j['span_s']:.3f} s){warm}: {chain}")
+                for r in j.get("retries", ()):
+                    add(f"      retry [{r['policy']}] at t={r['t']:.3f}"
+                        f" dt_scale={r.get('dt_scale')}")
+            for p in self.queue.get("preemptions", ()):
+                add(f"   preempt: {p['victim']} -> {p['for_job']} "
+                    f"(blocked on {p.get('blocked')}) at t={p['t']:.3f}")
         add("=" * 68)
         return "\n".join(lines)
 
@@ -848,4 +950,5 @@ def analyze(paths: Sequence[str]) -> TraceReport:
         perf=perf_events(streams),
         xla=measured_introspection(streams),
         physics=physics_diagnostics(streams),
+        queue=scheduler_timeline(streams),
     )

@@ -51,6 +51,9 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "preempt": {"step"},
         "agree": {"tag", "values"},
         "elastic_resume": {"checkpoint", "saved_processes", "processes"},
+        # dt-backoff inheritance (--dt-scale): a scheduler-retried job
+        # starts at the reduced step its failed attempt backed off to
+        "dt_inherit": {"factor", "action"},
     },
     "rank": {
         "watchdog_armed": {"timeout", "interval", "processes"},
@@ -129,6 +132,32 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     # event per caught NaN/div0/OOB, before SanitizerError enters the
     # supervisor's rollback path
     "sanitizer": {"trip": {"message", "errors"}},
+    # crash-safe multi-run scheduler (service/daemon.py): the daemon's
+    # own decisions, streamed to <root>/sched_events.jsonl — recovery
+    # replays, admission verdicts (warm/deferred), priority
+    # preemptions, classified retries, journal-degradation warnings
+    "sched": {
+        "start": {"root", "max_concurrent", "device_budget"},
+        "recover": {"records", "torn_lines", "jobs", "adopted",
+                    "requeued", "completed"},
+        "admit": {"job", "granted_devices", "warm"},
+        "defer": {"job", "reason"},
+        "preempt": {"victim", "for_job", "blocked"},
+        "retry": {"job", "attempt", "policy", "dt_scale"},
+        "adopt": {"job", "pid"},
+        "journal_degraded": {"pending"},
+        "stop": {"reason", "states"},
+    },
+    # per-job lifecycle in the scheduler's stream, namespaced by job
+    # id: every journal transition is mirrored as a job:state event so
+    # tpucfd-trace can render the queue timeline without reading the
+    # journal
+    "job": {
+        "submit": {"job", "priority"},
+        "state": {"job", "from", "to"},
+        "start": {"job", "attempt"},
+        "exit": {"job", "rc", "seconds"},
+    },
     "crash": {None: {"message"}},
 }
 

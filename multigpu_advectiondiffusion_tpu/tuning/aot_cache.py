@@ -88,13 +88,46 @@ def _environment_facts() -> dict:
     }
 
 
+def _physics_fingerprint(cfg) -> str:
+    """Hash of the config fields that bake into a compiled program's
+    CONSTANTS (diffusivity/nu/cfl/bc/weno/...). The tuner's key
+    deliberately abstracts over physics scalars — two runs differing
+    only in K share a kernel *choice* — but they do NOT share an
+    *executable*: dt (= c·dx²/K for diffusion) is a compiled-in
+    constant, so a K=0.7 run deserializing a K=1.0 blob would march
+    the wrong clock. Same skip set as ``cli.drivers.physics_meta``
+    plus the grid (its shape already keys via the tuner/avals)."""
+    import dataclasses
+    import json
+
+    skip = {"grid", "ic", "ic_params", "impl", "overlap",
+            "steps_per_exchange", "exchange"}
+    out = {}
+    for f in dataclasses.fields(cfg):
+        if f.name in skip:
+            continue
+        v = getattr(cfg, f.name)
+        if isinstance(v, tuple):
+            v = list(v)
+        try:
+            json.dumps(v)
+        except TypeError:
+            continue  # non-serializable (callable source term): unkeyed
+        out[f.name] = v
+    body = json.dumps(out, sort_keys=True)
+    return hashlib.sha256(body.encode()).hexdigest()[:16]
+
+
 def dispatch_key(solver, program_key, steps=None) -> str:
     """The cache key for one dispatch-cache entry: the tuner's config
     key (solver, shape, dtype, integrator, mesh, backend — and, for the
     ensemble programs, the member count B riding ``program_key``) plus
-    the program identity and the compile-relevant kernel knobs. The
-    caller (``xprof``) appends the argument-aval fingerprint at first
-    call, when the concrete operands exist."""
+    the physics fingerprint (scalars like K/nu/cfl compile into the
+    executable as constants — the cross-job sharing the scheduler's
+    per-root cache makes possible is exactly where that collision
+    bites), the program identity and the compile-relevant kernel
+    knobs. The caller (``xprof``) appends the argument-aval
+    fingerprint at first call, when the concrete operands exist."""
     import jax
 
     from multigpu_advectiondiffusion_tpu.tuning.autotuner import make_key
@@ -106,11 +139,16 @@ def dispatch_key(solver, program_key, steps=None) -> str:
         )
     except Exception:  # noqa: BLE001 — an unkeyable config just misses
         base = type(solver).__name__
+    try:
+        phys = _physics_fingerprint(solver.cfg)
+    except Exception:  # noqa: BLE001 — an unkeyable config just misses
+        phys = "?"
     return "|".join([
         base,
         f"impl={getattr(solver.cfg, 'impl', 'xla')}",
         f"k={int(getattr(solver.cfg, 'steps_per_exchange', 1) or 1)}",
         f"ex={getattr(solver.cfg, 'exchange', 'collective')}",
+        f"phys={phys}",
         f"prog={program_key}",
         f"steps={steps}",
     ])

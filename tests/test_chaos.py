@@ -641,6 +641,282 @@ def test_watchdog_scope_classifies_generic_error(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# Crash-safe scheduler (ISSUE 14): SIGKILL the daemon mid-queue ->
+# restart -> journal replay completes every job bit-exact; priority
+# preemption round-trips through exit 75 + elastic resharded resume
+# --------------------------------------------------------------------- #
+from multigpu_advectiondiffusion_tpu.service import (  # noqa: E402
+    Journal,
+    JobSpec,
+    Scheduler,
+    submit_to_spool,
+)
+
+# j1/j3 are identical (the warm-admission pair); j2 is the mid-queue
+# victim — iters sized so the post-first-checkpoint runway (~2 s of
+# chunked dispatches) dwarfs the test's kill-detection latency
+_SJOB = ["diffusion2d", "--n", "24", "16", "--checkpoint-every", "500",
+         "--iters", "50000"]
+_SJOB_K = [*_SJOB, "--K", "0.7"]
+
+
+def _launch_daemon(root, log_path, max_concurrent=1, devices=1):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + (
+            os.pathsep + os.environ["PYTHONPATH"]
+            if os.environ.get("PYTHONPATH") else ""
+        ),
+    }
+    fh = open(log_path, "a")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multigpu_advectiondiffusion_tpu.cli",
+         "serve", "--root", str(root), "--until-idle",
+         "--max-concurrent", str(max_concurrent),
+         "--devices", str(devices), "--poll", "0.1"],
+        stdout=fh, stderr=subprocess.STDOUT, env=env,
+    )
+    return proc, fh
+
+
+def _journal_records(root):
+    records, _ = Journal.replay(os.path.join(str(root), "journal.jsonl"))
+    return records
+
+
+def _running_pid(root, job_id):
+    pid = None
+    for r in _journal_records(root):
+        if (r.get("type") == "state" and r.get("job") == job_id
+                and r.get("to") == "running"):
+            pid = r.get("pid")
+    return pid
+
+
+def _sched_events(root):
+    return [
+        json.loads(line)
+        for line in open(os.path.join(str(root), "sched_events.jsonl"))
+        if line.strip()
+    ]
+
+
+def _kill_daemon_mid_job(tmp_path, root, victim, round_tag):
+    """Start the daemon, wait for ``victim`` to be running with a
+    committed checkpoint, SIGKILL the daemon, and prove the pdeathsig
+    took the worker down too (so the restart must RESUME, not adopt)."""
+    proc, fh = _launch_daemon(root, tmp_path / f"daemon_{round_tag}.log")
+    victim_dir = os.path.join(str(root), "jobs", victim)
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail(
+                    f"daemon exited rc={proc.returncode} before the "
+                    "kill window:\n"
+                    + open(tmp_path / f"daemon_{round_tag}.log")
+                    .read()[-3000:]
+                )
+            if (_running_pid(root, victim) is not None
+                    and find_latest_checkpoint(
+                        victim_dir, report=lambda m: None)):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"{victim} never reached a committed checkpoint")
+        pid = _running_pid(root, victim)
+        faults.kill_rank(proc)  # SIGKILL: no cleanup, no final journal
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        fh.close()
+    # PR_SET_PDEATHSIG: the in-flight worker dies with its daemon —
+    # the restart exercises journal replay + --resume auto, never a
+    # live-orphan adoption
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail(f"worker {pid} survived the daemon's death")
+    assert not os.path.exists(os.path.join(victim_dir, "summary.json")), (
+        "victim finished before the kill — no mid-run coverage"
+    )
+
+
+def test_scheduler_sigkill_midqueue_replay_bit_exact(tmp_path):
+    root = tmp_path / "root"
+    # uninterrupted references, one per distinct config
+    refs = {}
+    for tag, argv in (("a", _SJOB), ("b", _SJOB_K)):
+        d = tmp_path / f"ref_{tag}"
+        cli_main([*argv, "--save", str(d)])
+        refs[tag] = (d / "result.bin").read_bytes()
+
+    for jid, argv in (("j1", _SJOB), ("j2", _SJOB_K), ("j3", _SJOB)):
+        submit_to_spool(str(root), JobSpec(job_id=jid, argv=list(argv)))
+
+    _kill_daemon_mid_job(tmp_path, root, "j2", "t1")
+
+    # restart: replay the journal, resume j2 from its checkpoint,
+    # run j3 (warm — j1's identical request completed before the kill)
+    proc2, fh2 = _launch_daemon(root, tmp_path / "daemon2.log")
+    try:
+        rc = proc2.wait(timeout=600)
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+            proc2.wait(timeout=30)
+        fh2.close()
+    assert rc == 0, open(tmp_path / "daemon2.log").read()[-3000:]
+
+    # the journal linearizes and every job is terminal
+    assert cli_main(["serve", "--root", str(root), "--verify",
+                     "--require-complete"]) is None
+
+    # bit-exact vs the uninterrupted runs (f32 diffusion)
+    for jid, tag in (("j1", "a"), ("j2", "b"), ("j3", "a")):
+        got = (root / "jobs" / jid / "result.bin").read_bytes()
+        assert got == refs[tag], f"{jid} diverged from its reference"
+
+    # j1 completed before the kill and was NOT re-run on restart
+    runs = [r for r in _journal_records(root)
+            if r.get("type") == "state" and r.get("to") == "running"]
+    assert len([r for r in runs if r["job"] == "j1"]) == 1
+    assert len([r for r in runs if r["job"] == "j2"]) == 2
+
+    evs = _sched_events(root)
+    recover = [e for e in evs
+               if e["kind"] == "sched" and e["name"] == "recover"][-1]
+    assert recover["requeued"] >= 1
+    # warm admission after the restart: the ledger replayed from the
+    # journal, and j3's dispatches all came from the AOT cache
+    admits = {e["job"]: e for e in evs
+              if e["kind"] == "sched" and e["name"] == "admit"}
+    assert admits["j3"]["warm"] is True
+    j3_aot = [
+        e["name"]
+        for e in (json.loads(line) for line in open(
+            root / "jobs" / "j3" / "events.jsonl") if line.strip())
+        if e["kind"] == "aot_cache"
+    ]
+    assert "hit" in j3_aot
+    assert not [n for n in j3_aot if n in ("miss", "store")], (
+        "warm job recompiled"
+    )
+
+
+@pytest.mark.slow
+def test_scheduler_kill_restart_soak(tmp_path):
+    """Multi-round soak: the SIGKILL -> replay -> resume cycle must
+    hold up under repetition (fresh root per round)."""
+    ref_dir = tmp_path / "ref"
+    cli_main([*_SJOB_K, "--save", str(ref_dir)])
+    ref = (ref_dir / "result.bin").read_bytes()
+    for round_idx in range(3):
+        root = tmp_path / f"root{round_idx}"
+        submit_to_spool(str(root),
+                        JobSpec(job_id="j", argv=list(_SJOB_K)))
+        _kill_daemon_mid_job(tmp_path, root, "j", f"soak{round_idx}")
+        proc, fh = _launch_daemon(root, tmp_path / "daemon_soak.log")
+        try:
+            assert proc.wait(timeout=600) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+            fh.close()
+        assert (root / "jobs" / "j" / "result.bin").read_bytes() == ref
+        assert cli_main(["serve", "--root", str(root), "--verify",
+                         "--require-complete"]) is None
+
+
+def test_scheduler_priority_preemption_elastic_roundtrip(tmp_path):
+    """A high-priority arrival preempts the running low-priority job
+    through the checkpoint-and-exit-75 path; the victim requeues and
+    resumes ELASTICALLY on the smaller mesh slice left free (dz=4
+    first attempt, dz=2 resume from the same .ckptd) — final state
+    bit-exact vs an uninterrupted unsharded run."""
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    low_argv = ["diffusion3d", *GRID, "--iters", "160",
+                "--checkpoint-every", "20", "--checkpoint-sharded",
+                "--sentinel-every", "20"]
+    high_argv = ["diffusion3d", *GRID, "--iters", "60", "--K", "0.8",
+                 "--checkpoint-every", "20", "--checkpoint-sharded",
+                 "--sentinel-every", "20"]
+
+    ref_dir = tmp_path / "ref"
+    cli_main([*low_argv, "--save", str(ref_dir)])
+    ref = (ref_dir / "result.bin").read_bytes()
+
+    sched = Scheduler(str(tmp_path / "root"), max_concurrent=2,
+                      device_budget=4, poll_seconds=0.05,
+                      aot_cache=False, fsync=False)
+    sched.submit(JobSpec(job_id="low", argv=low_argv, priority=0,
+                         devices=4, env=env))
+    low_dir = sched.job_dir("low")
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        sched.tick()
+        if (sched.queue.jobs["low"].state in ("running", "checkpointed")
+                and find_latest_checkpoint(low_dir,
+                                           report=lambda m: None)):
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("low never reached a committed checkpoint")
+
+    sched.submit(JobSpec(job_id="high", argv=high_argv, priority=5,
+                         devices=2, env=env))
+    while time.time() < deadline:
+        sched.tick()
+        if not sched.queue.open_jobs():
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail(
+            f"queue never drained: "
+            f"{[(r.job_id, r.state) for r in sched.queue.jobs.values()]}"
+        )
+    sched.close()
+
+    low, high = sched.queue.jobs["low"], sched.queue.jobs["high"]
+    assert low.state == "done" and high.state == "done"
+    assert low.attempts == 2  # preempted once, resumed once
+    assert low.failures == []  # preemption never burns a retry
+
+    evs = _sched_events(sched.root)
+    preempts = [e for e in evs
+                if e["kind"] == "sched" and e["name"] == "preempt"]
+    assert preempts and preempts[0]["victim"] == "low"
+    assert preempts[0]["for_job"] == "high"
+    # the journaled chain went through the documented exit-75 path
+    chain = [(r.get("from"), r.get("to"))
+             for r in _journal_records(sched.root)
+             if r.get("type") == "state" and r.get("job") == "low"]
+    assert ("preempted", "queued") in chain
+    assert os.path.exists(os.path.join(low_dir, "result.bin"))
+    # elastic resharded resume: attempt 1 held the full dz=4 slice,
+    # attempt 2 restored the same .ckptd onto the free dz=2 slice
+    # while high held the other two devices
+    starts = {(e["job"], e["attempt"]): e for e in evs
+              if e["kind"] == "job" and e["name"] == "start"}
+    assert starts[("low", 1)]["mesh"] == "dz=4"
+    assert starts[("low", 2)]["mesh"] == "dz=2"
+    assert starts[("high", 1)]["mesh"] == "dz=2"
+
+    got = (tmp_path / "root" / "jobs" / "low" / "result.bin").read_bytes()
+    assert got == ref, "preempt/resume trajectory diverged"
+
+
+# --------------------------------------------------------------------- #
 # Crash-path telemetry flush (satellite): the JSONL tail survives an
 # uncaught structured error — the post-mortem evidence
 # --------------------------------------------------------------------- #
