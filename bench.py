@@ -36,7 +36,11 @@ def _cases(on_tpu: bool):
     B_DIFF2D = BASELINES_MLUPS["diffusion2d"][0]
     B_BURG3D = BASELINES_MLUPS["burgers3d_512"][0]
     B_BURG2D = BASELINES_MLUPS["burgers2d_multigpu"][0]
+    B_ADR3D = BASELINES_MLUPS["adr3d"][0]
+    B_ADR2D = BASELINES_MLUPS["adr2d"][0]
     from multigpu_advectiondiffusion_tpu import (
+        ADRConfig,
+        ADRSolver,
         BurgersConfig,
         BurgersSolver,
         DiffusionConfig,
@@ -212,6 +216,36 @@ def _cases(on_tpu: bool):
                           adaptive_dt=False, impl="pallas_axis")
         )
 
+    def adr3d():
+        # the title workload (ISSUE 15): variable-K advection–
+        # diffusion–reaction on the fused per-stage rung — same
+        # tile-aligned grid class as the diffusion headline
+        g = (
+            Grid.make(508, 204, 160, lengths=(12.7, 5.1, 4.0))
+            if on_tpu
+            else Grid.make(64, 28, 16, lengths=(1.6, 0.7, 0.4))
+        )
+        return ADRSolver(
+            ADRConfig(grid=g, dtype="float32", impl="pallas",
+                      velocity=0.5, kappa_variation=0.2,
+                      reaction_rate=0.25)
+        )
+
+    def adr2d():
+        # 2-D ADR rides the generic rung (the fused ADR kernel is 3-D
+        # only); the row pins that expectation so a future fused 2-D
+        # rung shows up as an engagement change, not silently
+        g = (
+            Grid.make(1001, 1001, lengths=20.0)
+            if on_tpu
+            else Grid.make(65, 65, lengths=2.0)
+        )
+        return ADRSolver(
+            ADRConfig(grid=g, dtype="float32", impl="xla",
+                      velocity=0.5, kappa_variation=0.2,
+                      reaction_rate=0.25)
+        )
+
     it = (lambda n: n) if on_tpu else (lambda n: min(n, 4))
     # rows: (metric, make_solver, mode, work, baseline, expected) where
     # mode is "iters" (fixed-count run) or "t_end" (the drivers' native
@@ -274,6 +308,14 @@ def _cases(on_tpu: bool):
         # whole-run calls must dwarf the per-call sync jitter
         ("burgers2d_weno7_mlups", burg2d_weno7, "iters", it(12000),
          BASELINES_MLUPS["burgers2d_weno7"][0], {"fused-whole-run"}),
+        # the title ADR workload (ISSUE 15): 3-D on the fused per-stage
+        # rung (engagement-guarded like every fused row), 2-D on the
+        # generic rung; baselines are the nearest published diffusion
+        # anchors — the reference never shipped ADR (matrix.py note)
+        ("adr3d_mlups", adr3d, "iters", it(404), B_ADR3D,
+         {"fused-stage"}),
+        ("adr2d_mlups", adr2d, "iters", it(2000), B_ADR2D,
+         {"generic-xla"}),
     ]
 
 

@@ -21,14 +21,7 @@ compute/communication overlap.
 
 from multigpu_advectiondiffusion_tpu.core.grid import Grid
 from multigpu_advectiondiffusion_tpu.core.bc import Boundary
-from multigpu_advectiondiffusion_tpu.models.diffusion import (
-    DiffusionConfig,
-    DiffusionSolver,
-)
-from multigpu_advectiondiffusion_tpu.models.burgers import (
-    BurgersConfig,
-    BurgersSolver,
-)
+from multigpu_advectiondiffusion_tpu.models import registry
 from multigpu_advectiondiffusion_tpu.models.state import (
     EnsembleState,
     SolverState,
@@ -41,13 +34,19 @@ __version__ = "0.1.0"
 __all__ = [
     "Grid",
     "Boundary",
-    "DiffusionConfig",
-    "DiffusionSolver",
-    "BurgersConfig",
-    "BurgersSolver",
     "SolverState",
     "EnsembleState",
     "EnsembleSolver",
+    "registry",
     "telemetry",
     "__version__",
 ]
+
+# every registered solver family's config/solver classes export here —
+# derived from models/registry.py (a new family registers itself; this
+# list is never edited by hand)
+for _spec in registry.specs():
+    for _cls in (_spec.config_cls, _spec.solver_cls):
+        globals()[_cls.__name__] = _cls
+        __all__.append(_cls.__name__)
+del _spec, _cls

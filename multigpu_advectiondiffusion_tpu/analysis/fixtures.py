@@ -109,6 +109,52 @@ RULE_FIXTURES = {
             "        print('published', path)\n"
         ),
     },
+    "registry-completeness": {
+        "bad": (
+            "from multigpu_advectiondiffusion_tpu.models.registry "
+            "import ModelSpec, register_model\n"
+            "\n"
+            "class ToyConfig:\n"
+            "    pass\n"
+            "\n"
+            "class ToySolver:\n"
+            "    def stencil_spec(self):\n"
+            "        return {'stage_radius': 1}\n"
+            "\n"
+            "    def diagnostics_spec(self):\n"
+            "        return {}\n"
+            "\n"
+            "register_model(ModelSpec(\n"
+            "    name='toy', config_cls=ToyConfig,\n"
+            "    solver_cls=ToySolver, description='half-wired',\n"
+            "))\n"
+        ),
+        "good": (
+            "from multigpu_advectiondiffusion_tpu.models.registry "
+            "import ModelSpec, register_model\n"
+            "\n"
+            "class ToyConfig:\n"
+            "    pass\n"
+            "\n"
+            "class ToySolver:\n"
+            "    def stencil_spec(self):\n"
+            "        return {'stage_radius': 1}\n"
+            "\n"
+            "    def diagnostics_spec(self):\n"
+            "        return {}\n"
+            "\n"
+            "    def ensemble_operands(self):\n"
+            "        return {}\n"
+            "\n"
+            "    def cfl_rule(self):\n"
+            "        return {'kind': 'static', 'dt': 1e-3}\n"
+            "\n"
+            "register_model(ModelSpec(\n"
+            "    name='toy', config_cls=ToyConfig,\n"
+            "    solver_cls=ToySolver, description='fully wired',\n"
+            "))\n"
+        ),
+    },
     "closure-constant": {
         "bad": (
             "class Solver:\n"

@@ -479,23 +479,12 @@ def _spacing(n):
     return (0.1,) * n
 
 
-def default_combos() -> List[Combo]:
-    """Every (rung, order, k) combination the dispatch's eligibility
-    gates admit, as cheap constructor calls (layout math only — no
-    tracing, no devices). Combos a gate declines are recorded as
-    declined, mirroring the dispatch's own loud rejections."""
+def _diffusion_combos() -> List[Combo]:
+    """The diffusion family's admitted (rung, order, k) battery."""
     import jax.numpy as jnp
 
-    from multigpu_advectiondiffusion_tpu.ops.flux import burgers as _burg
     from multigpu_advectiondiffusion_tpu.ops.pallas.fused2d_sharded import (
-        ShardedFusedBurgers2DStepper,
         ShardedFusedDiffusion2DStepper,
-    )
-    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
-        FusedBurgersStepper,
-    )
-    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (
-        FusedBurgers2DStepper,
     )
     from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
         FusedDiffusionStepper,
@@ -507,7 +496,6 @@ def default_combos() -> List[Combo]:
         StepFusedDiffusionStepper,
     )
     from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
-        SlabRunBurgersStepper,
         SlabRunDiffusionStepper,
     )
 
@@ -586,7 +574,29 @@ def default_combos() -> List[Combo]:
             f"slab-diffusion[k={k},dma]",
             lambda k=k: slab_diff(k=k, dma=True),
         ))
+    return combos
 
+
+def _burgers_combos() -> List[Combo]:
+    """The Burgers family's admitted (rung, order, k) battery."""
+    import jax.numpy as jnp
+
+    from multigpu_advectiondiffusion_tpu.ops.flux import burgers as _burg
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused2d_sharded import (
+        ShardedFusedBurgers2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers import (
+        FusedBurgersStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_burgers2d import (
+        FusedBurgers2DStepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_slab_run import (
+        SlabRunBurgersStepper,
+    )
+
+    f32 = jnp.float32
+    combos: List[Combo] = []
     for order in (5, 7):
         def burg3d(order=order, **kw):
             return FusedBurgersStepper(
@@ -662,14 +672,117 @@ def default_combos() -> List[Combo]:
     return combos
 
 
+def _adr_combos() -> List[Combo]:
+    """The ADR family's battery (ISSUE 15): the fused per-stage rung
+    at its stencil radius = max(advective upwind 1, diffusive O4 2)
+    taps — constant-K, variable-K, and the shard-local instance."""
+    import jax.numpy as jnp
+
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_adr import (
+        FusedADRStepper,
+    )
+
+    f32 = jnp.float32
+
+    def adr3d(**kw):
+        return FusedADRStepper(
+            (24, 10, 12), f32, _spacing(3), 1.0, (0.5, 0.25, 0.0),
+            0.3, 1e-4, 2, 0.0, **kw,
+        )
+
+    return [
+        Combo("adr3d-stage", adr3d),
+        Combo("adr3d-stage[varK]",
+              lambda: adr3d(kappa_variation=0.2)),
+        Combo("adr3d-stage[sharded]",
+              lambda: adr3d(kappa_variation=0.2,
+                            global_shape=(48, 10, 12))),
+    ]
+
+
+#: family name -> combo battery builder. Resolved against the solver
+#: registry (models/registry.py): a REGISTERED family missing here is
+#: a coverage FAILURE in verify_all, never a silent gap.
+FAMILY_COMBOS = {
+    "diffusion": _diffusion_combos,
+    "burgers": _burgers_combos,
+    "adr": _adr_combos,
+}
+
+#: expected combo-matrix size per family — asserted by verify_all, so
+#: a combo that silently falls out of a battery (a dropped k, order or
+#: coefficient mode) is a counted coverage failure, not a quiet shrink
+#: (ISSUE 15 satellite).
+EXPECTED_FAMILY_COMBOS = {
+    "diffusion": 18,  # 5 stage/step/2d + 1 unsharded slab + 3 B-fold
+    #                 + 3k x {plain, split, dma}
+    "burgers": 30,    # 2 orders x (4 stage/2d + 2 slab + 3k x 3 modes)
+    "adr": 3,         # per-stage: const-K, var-K, sharded
+}
+
+
+def family_combos():
+    """``(combos_by_family, missing_families)``: every registered
+    solver family's battery, resolved through the registry — the halo
+    verifier's matrix derives from registration, not from a hand-kept
+    list."""
+    from multigpu_advectiondiffusion_tpu.models import registry
+
+    by_family = {}
+    missing = []
+    for name in registry.names():
+        builder = FAMILY_COMBOS.get(name)
+        if builder is None:
+            missing.append(name)
+            continue
+        by_family[name] = builder()
+    return by_family, missing
+
+
+def default_combos() -> List[Combo]:
+    """Every registered family's battery, flattened (the historical
+    API; coverage/count accounting lives in :func:`verify_all`)."""
+    by_family, _ = family_combos()
+    out: List[Combo] = []
+    for combos in by_family.values():
+        out.extend(combos)
+    return out
+
+
 def verify_all(combos: Optional[List[Combo]] = None) -> HaloReport:
     """Run the battery over every admitted combination; declined
     combinations (a constructor gate raised, as the dispatch would)
     are recorded with their reason, not silently dropped. The default
-    battery also proves the ensemble mesh layouts
+    battery resolves the combo matrix through the solver registry:
+    a registered family with NO battery, or a battery whose size
+    drifted from :data:`EXPECTED_FAMILY_COMBOS`, is a coverage
+    violation. It also proves the ensemble mesh layouts
     (:func:`default_member_meshes`) member-axis-halo-free."""
     report = HaloReport(constant_violations=verify_constants())
-    for combo in combos if combos is not None else default_combos():
+    if combos is None:
+        by_family, missing = family_combos()
+        for fam in missing:
+            report.constant_violations.append(HaloViolation(
+                f"registry[{fam}]", None,
+                "registered solver family has no halo-verifier combo "
+                "battery (FAMILY_COMBOS) — a new family must prove its "
+                "rungs, not skip the matrix",
+                "a FAMILY_COMBOS entry", "missing",
+            ))
+        run_list: List[Combo] = []
+        for fam, fam_combos in by_family.items():
+            expected = EXPECTED_FAMILY_COMBOS.get(fam)
+            if expected is not None and len(fam_combos) != expected:
+                report.constant_violations.append(HaloViolation(
+                    f"registry[{fam}]", None,
+                    "combo-matrix size drifted (a silently dropped "
+                    "combination is a coverage failure)",
+                    expected, len(fam_combos),
+                ))
+            run_list.extend(fam_combos)
+    else:
+        run_list = combos
+    for combo in run_list:
         res = ComboResult(name=combo.name, admitted=True)
         try:
             stepper = combo.build()

@@ -1,6 +1,6 @@
 """Domain lint rules for the AST engine (:mod:`framework`).
 
-Six invariants, each previously enforced in exactly one hand-written
+Seven invariants, each previously enforced in exactly one hand-written
 place (or not at all):
 
 * ``closure-constant`` — the PR 9 ``build_local`` contract: a scalar a
@@ -36,7 +36,13 @@ place (or not at all):
   committed it" hazard class. Intentional single-writer sites (the
   coordinator's gathered-output publishes, the commit-marker protocol)
   carry ``# tpucfd-check: allow[rank-divergent-effect]`` on the guard
-  with a comment stating why they are safe.
+  with a comment stating why they are safe;
+* ``registry-completeness`` — a ``register_model()``'d solver class
+  missing any method of the plugin registration contract
+  (``models/registry.REQUIRED_SOLVER_CONTRACT``: ``stencil_spec`` /
+  ``diagnostics_spec`` / ``ensemble_operands`` / ``cfl_rule``) in its
+  own class body: a half-wired plugin fails statically (and at
+  ``register_model``), never at dispatch.
 """
 
 from __future__ import annotations
@@ -441,6 +447,76 @@ class RankDivergentEffectRule(Rule):
                 "guard (stating why single-writer is safe) or make "
                 "the effect rank-uniform",
             )
+
+
+# --------------------------------------------------------------------- #
+# registry-completeness
+# --------------------------------------------------------------------- #
+@register
+class RegistryCompletenessRule(Rule):
+    name = "registry-completeness"
+    description = (
+        "a register_model()'d solver class must declare the full "
+        "plugin contract (stencil_spec/diagnostics_spec/"
+        "ensemble_operands/cfl_rule) in its own class body — a "
+        "half-wired plugin must fail statically, not at dispatch "
+        "(the static twin of models/registry.register_model's "
+        "runtime check)"
+    )
+
+    def _spec_solver_name(self, call: ast.Call) -> Optional[str]:
+        """The solver class name a register_model(...) call binds:
+        the ``solver_cls=Name`` keyword of the call itself or of a
+        nested ModelSpec(...) constructor."""
+        for node in ast.walk(call):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "solver_cls" and isinstance(
+                    kw.value, ast.Name
+                ):
+                    return kw.value.id
+        return None
+
+    def check(self, mod: ParsedModule) -> Iterable[Violation]:
+        from multigpu_advectiondiffusion_tpu.models.registry import (
+            REQUIRED_SOLVER_CONTRACT,
+        )
+
+        classes = {
+            n.name: n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, ast.ClassDef)
+        }
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal_name(node.func) != "register_model":
+                continue
+            solver_name = self._spec_solver_name(node)
+            if solver_name is None:
+                continue  # dynamic spec: runtime check still applies
+            cls = classes.get(solver_name)
+            if cls is None:
+                continue  # class from another module: out of AST scope
+            declared = {
+                b.name
+                for b in cls.body
+                if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            missing = [
+                m for m in REQUIRED_SOLVER_CONTRACT if m not in declared
+            ]
+            if missing:
+                yield self.violation(
+                    mod, node,
+                    f"registered solver {solver_name} does not declare "
+                    f"contract method(s) {missing} in its class body — "
+                    "every plugin must ship the full "
+                    "stencil_spec/diagnostics_spec/ensemble_operands/"
+                    "cfl_rule contract (models/registry."
+                    "REQUIRED_SOLVER_CONTRACT)",
+                )
 
 
 # --------------------------------------------------------------------- #

@@ -66,6 +66,41 @@ ENSEMBLE_FIELDS = ("ensemble", "vs_looped", "member_sharding", "devices")
 SCHEDULE_FIELDS = ("exchange",)
 
 
+def row_family(key: Optional[str]) -> Optional[str]:
+    """The solver family a metric/name belongs to, resolved through
+    the plugin registry's name-prefix convention (``adr3d_mlups`` ->
+    ``adr``); ``None`` for rows outside the family namespace (scaling
+    composites like ``ensemble_*`` resolve through their embedded
+    family name). Never raises — coverage notes must survive arbitrary
+    artifacts."""
+    if not key:
+        return None
+    try:
+        from multigpu_advectiondiffusion_tpu.models import registry
+
+        fam = registry.family_of_run_name(key)
+        if fam is not None:
+            return fam
+        # composite rows: ensemble_<family>..., <family> embedded
+        for name in registry.names():
+            if name in key:
+                return name
+    except Exception:
+        pass
+    return None
+
+
+def family_coverage(rows: Dict[str, dict]):
+    """``{family: row_count}`` over a round's rows — the per-family
+    coverage surface the gate's notes read."""
+    out: Dict[str, int] = {}
+    for key in rows:
+        fam = row_family(key)
+        if fam:
+            out[fam] = out.get(fam, 0) + 1
+    return out
+
+
 def row_exchange(row: Optional[dict]) -> str:
     """A row's halo transport; rounds before ISSUE 13 read as the
     collective default — never a parse error, never a coverage
@@ -230,6 +265,25 @@ def compare(
     reported as ``added`` and never fails."""
     results: List[RowResult] = []
     notes: List[str] = []
+    # per-FAMILY coverage notes (ISSUE 15): a whole solver family
+    # vanishing (or shrinking) between rounds is surfaced by name even
+    # when the per-metric missing failures are being read row by row —
+    # future rounds cannot silently drop the ADR family the repo is
+    # named after
+    old_fams = family_coverage(old_rows)
+    new_fams = family_coverage(new_rows)
+    for fam in sorted(set(old_fams) - set(new_fams)):
+        notes.append(
+            f"model family {fam!r} had {old_fams[fam]} row(s) in the "
+            "prior round and NONE in this one (family coverage "
+            "dropped; the per-metric MISSING failures below gate it)"
+        )
+    for fam in sorted(set(old_fams) & set(new_fams)):
+        if new_fams[fam] < old_fams[fam]:
+            notes.append(
+                f"model family {fam!r} coverage shrank: "
+                f"{old_fams[fam]} -> {new_fams[fam]} row(s)"
+            )
     for key in sorted(set(old_rows) | set(new_rows)):
         old = old_rows.get(key)
         new = new_rows.get(key)

@@ -98,10 +98,7 @@ def scaling_rows(
     """
     import jax
 
-    from multigpu_advectiondiffusion_tpu.models.burgers import BurgersSolver
-    from multigpu_advectiondiffusion_tpu.models.diffusion import (
-        DiffusionSolver,
-    )
+    from multigpu_advectiondiffusion_tpu.models import registry
     from multigpu_advectiondiffusion_tpu.parallel.mesh import (
         Decomposition,
         make_mesh,
@@ -119,9 +116,8 @@ def scaling_rows(
     configs = _configs(on_tpu)
     for model in models:
         cfg, iters, baseline = configs[model]
-        solver_cls = (
-            DiffusionSolver if model.startswith("diffusion") else BurgersSolver
-        )
+        # run names resolve to solver families through the registry
+        solver_cls = registry.solver_for_run_name(model)
         nz = cfg.grid.shape[0]
         for d in candidate_counts(len(devices), nz):
             mesh = make_mesh({"dz": d}, devices=devices[:d])
@@ -190,10 +186,7 @@ def exchange_head_to_head_rows(
 
     import jax
 
-    from multigpu_advectiondiffusion_tpu.models.burgers import BurgersSolver
-    from multigpu_advectiondiffusion_tpu.models.diffusion import (
-        DiffusionSolver,
-    )
+    from multigpu_advectiondiffusion_tpu.models import registry
     from multigpu_advectiondiffusion_tpu.parallel.mesh import (
         Decomposition,
         make_mesh,
@@ -213,10 +206,7 @@ def exchange_head_to_head_rows(
         cfg, iters, baseline = configs[model]
         if cfg.grid.shape[0] % 2:
             continue
-        solver_cls = (
-            DiffusionSolver if model.startswith("diffusion")
-            else BurgersSolver
-        )
+        solver_cls = registry.solver_for_run_name(model)
         pair = (
             ("split", dataclasses.replace(
                 cfg, impl="pallas_slab", overlap="split",

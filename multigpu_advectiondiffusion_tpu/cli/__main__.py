@@ -11,6 +11,15 @@ harness (``./Diffusion3d.run K L W H Nx Ny Nz iters bX bY bZ``,
     python -m multigpu_advectiondiffusion_tpu.cli convergence --ndim 3
     python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
         --n 256 256 256 --iters 100 --mesh dz=4,dy=2
+    python -m multigpu_advectiondiffusion_tpu.cli --model adr \
+        --n 128 128 128 --velocity 0.5 --kappa-variation 0.2 \
+        --reaction 0.3 --iters 200 --mesh dz=4
+
+Model subcommands (``diffusion3d``, ``burgers2d``, ``adr3d``, ...) are
+GENERATED from the solver-plugin registry (``models/registry.py``);
+``--model NAME`` resolves through the same registry (dimensionality
+from ``--ndim`` or the ``--n`` arity), so a newly registered family is
+immediately runnable with no CLI edits.
 
 Block sizes (bX/bY/bZ) have no TPU meaning and are not taken; XLA/Pallas
 choose tiling.
@@ -317,113 +326,43 @@ def _grid(args, ndim):
     return Grid.make(*args.n, lengths=lengths)
 
 
-def _bc(args, default):
-    if not args.bc:
-        return default
-    return args.bc[0] if len(args.bc) == 1 else tuple(reversed(args.bc))
-
-
 def _mesh_decomp(args, grid):
     mesh, sizes = parse_mesh_spec(args.mesh)
     return mesh, decomposition_for(grid, sizes)
 
 
-def _run_diffusion(args, ndim, geometry="cartesian"):
-    from multigpu_advectiondiffusion_tpu.models.diffusion import (
-        DiffusionConfig,
-        DiffusionSolver,
-    )
-
+def _run_model(spec, args, ndim, name=None, **build_extra):
+    """ONE runner for every registered solver family: build the config
+    through the spec's ``cli_build`` hook, then drive the shared
+    single-run / batched-ensemble machinery. Adding a model touches the
+    registry, never this function (ISSUE 15)."""
     grid = _grid(args, ndim)
-    cfg = DiffusionConfig(
-        grid=grid,
-        diffusivity=args.K,
-        order=args.order,
-        integrator=args.integrator,
-        dtype=args.dtype,
-        ic=args.ic or "heat_kernel",
-        bc=_bc(args, "dirichlet" if geometry == "cartesian"
-               else ("edge", "dirichlet")),
-        t0=args.t0,
-        geometry=geometry,
-        impl=args.impl,
-        overlap=args.overlap,
-        steps_per_exchange=args.steps_per_exchange,
-        exchange=args.exchange,
-    )
-    name = f"diffusion{ndim}d" if geometry == "cartesian" else "diffusion_axisym"
+    cfg = spec.cli_build(args, grid, ndim, **build_extra)
+    name = name or f"{spec.name}{ndim}d"
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    # registry-resolution provenance: which family/spec served this
+    # run (lands in the --metrics stream for --model AND subcommand
+    # invocations alike)
+    telemetry.event("model", "resolve", model=spec.name, ndim=ndim,
+                    command=name)
     if args.ensemble and args.ensemble > 1:
         # batched ensemble engine: one vmapped dispatch advances every
-        # member; sweeps map K -> diffusivity, ic.* -> ic_params
+        # member; sweep aliases (e.g. K -> diffusivity) come from the
+        # family's registration spec
         return run_ensemble_solver(
-            DiffusionSolver, cfg, name, args,
-            aliases={"K": "diffusivity"},
+            spec.solver_cls, cfg, name, args,
+            aliases=dict(spec.sweep_aliases),
         )
     mesh, decomp = _mesh_decomp(args, grid)
-    solver = DiffusionSolver(cfg, mesh=mesh, decomp=decomp)
+    solver = spec.solver_cls(cfg, mesh=mesh, decomp=decomp)
     iters = args.iters if args.t_end is None else None
     if iters is None and args.t_end is None:
         iters = 100
     return run_solver(solver, name, iters=iters, t_end=args.t_end,
                       save_dir=args.save, plot=args.plot,
-                      check_error=args.check_error, repeats=args.repeats,
-                      snapshot_every=args.snapshot_every,
-                      checkpoint_every=args.checkpoint_every,
-                      checkpoint_keep=args.checkpoint_keep,
-                      checkpoint_sharded=args.checkpoint_sharded,
-                      resume=args.resume, profile_dir=args.profile,
-                      sentinel_every=args.sentinel_every,
-                      sentinel_growth=args.sentinel_growth,
-                      max_retries=args.max_retries,
-                      dt_backoff=args.dt_backoff,
-                      watchdog_timeout=args.watchdog_timeout,
-                      sdc_every=args.sdc_every,
-                      progress=args.progress,
-                      diag_every=args.diag_every,
-                      diag_strict=args.diag_strict,
-                      snapshots=args.snapshots,
-                      snapshot_stride=args.snapshot_stride,
-                      snapshot_max_bytes=args.snapshot_max_bytes,
-                      dt_scale=args.dt_scale,
-                      metrics_path=getattr(args, "metrics", None),
-                      metrics_max_bytes=args.metrics_max_bytes)
-
-
-def _run_burgers(args, ndim):
-    from multigpu_advectiondiffusion_tpu.models.burgers import (
-        BurgersConfig,
-        BurgersSolver,
-    )
-
-    grid = _grid(args, ndim)
-    cfg = BurgersConfig(
-        grid=grid,
-        flux=args.flux,
-        weno_order=args.weno_order,
-        weno_variant=args.weno_variant,
-        cfl=args.cfl,
-        nu=args.nu,
-        adaptive_dt=not args.fixed_dt,
-        integrator=args.integrator,
-        dtype=args.dtype,
-        ic=args.ic or "gaussian",
-        bc=_bc(args, "edge"),
-        impl=args.impl,
-        overlap=args.overlap,
-        steps_per_exchange=args.steps_per_exchange,
-        exchange=args.exchange,
-    )
-    if args.ensemble and args.ensemble > 1:
-        return run_ensemble_solver(BurgersSolver, cfg, f"burgers{ndim}d",
-                                   args)
-    mesh, decomp = _mesh_decomp(args, grid)
-    solver = BurgersSolver(cfg, mesh=mesh, decomp=decomp)
-    iters = args.iters if args.t_end is None else None
-    if iters is None and args.t_end is None:
-        iters = 100
-    return run_solver(solver, f"burgers{ndim}d", iters=iters, t_end=args.t_end,
-                      save_dir=args.save, plot=args.plot,
-                      check_error=False, repeats=args.repeats,
+                      check_error=spec.check_error and args.check_error,
+                      repeats=args.repeats,
                       snapshot_every=args.snapshot_every,
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_keep=args.checkpoint_keep,
@@ -526,42 +465,37 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="multigpu_advectiondiffusion_tpu")
     sub = ap.add_subparsers(dest="command", required=True)
 
-    for ndim in (1, 2, 3):
-        p = sub.add_parser(f"diffusion{ndim}d",
-                           help=f"{ndim}-D heat equation (heat{ndim}d.m, "
-                                f"Diffusion{ndim}d drivers)")
-        _add_common(p, ndim)
-        p.add_argument("--K", type=float, default=1.0,
-                       help="diffusivity (main.c arg 1)")
-        p.add_argument("--order", type=int, default=4, choices=[2, 4])
-        p.add_argument("--t0", type=float, default=0.1)
-        p.set_defaults(fn=lambda a, d=ndim: _run_diffusion(a, d))
+    # model subcommands are GENERATED from the solver-plugin registry:
+    # every registered family gets <name>{1,2,3}d commands with its
+    # spec's flags — a new model registers itself (models/registry.py)
+    # and appears here with zero CLI edits (ISSUE 15)
+    from multigpu_advectiondiffusion_tpu.models import (
+        registry as model_registry,
+    )
 
+    for spec in model_registry.specs():
+        for ndim in spec.cli_dims:
+            p = sub.add_parser(
+                f"{spec.name}{ndim}d",
+                help=f"{ndim}-D {spec.description}",
+            )
+            _add_common(p, ndim)
+            spec.cli_configure(p, ndim)
+            p.set_defaults(
+                fn=lambda a, s=spec, d=ndim: _run_model(s, a, d)
+            )
+
+    # the axisymmetric r-y geometry stays a dedicated command (its
+    # defaults differ), but runs through the SAME registry spec
     p = sub.add_parser("diffusion-axisym",
                        help="axisymmetric r-y diffusion "
                             "(heat2d_axisymmetric.m)")
     _add_common(p, 2)
-    p.add_argument("--K", type=float, default=0.27)
-    p.add_argument("--order", type=int, default=4, choices=[2, 4])
-    p.add_argument("--t0", type=float, default=1.0)
-    p.set_defaults(fn=lambda a: _run_diffusion(a, 2, geometry="axisymmetric"))
-
-    for ndim in (1, 2, 3):
-        p = sub.add_parser(f"burgers{ndim}d",
-                           help=f"{ndim}-D scalar conservation law, WENO "
-                                f"(LFWENO5FDM{ndim}d.m, Burgers drivers)")
-        _add_common(p, ndim)
-        p.add_argument("--flux", default="burgers",
-                       choices=["burgers", "linear", "buckley"])
-        p.add_argument("--weno-order", type=int, default=5, choices=[5, 7])
-        p.add_argument("--weno-variant", default="js", choices=["js", "z"])
-        p.add_argument("--cfl", type=float, default=0.4)
-        p.add_argument("--nu", type=float, default=0.0,
-                       help="viscosity (1e-5 in SingleGPU Burgers)")
-        p.add_argument("--fixed-dt", action="store_true",
-                       help="reference-parity dt = CFL*dx (hard-coded "
-                            "max|u|=1, Burgers3d_Baseline/main.c:193)")
-        p.set_defaults(fn=lambda a, d=ndim: _run_burgers(a, d))
+    model_registry.get("diffusion").cli_configure(p, 2, axisym=True)
+    p.set_defaults(fn=lambda a: _run_model(
+        model_registry.get("diffusion"), a, 2,
+        name="diffusion_axisym", geometry="axisymmetric",
+    ))
 
     p = sub.add_parser("convergence",
                        help="grid-refinement accuracy study "
@@ -629,12 +563,64 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _resolve_model_argv(argv):
+    """``--model NAME [--ndim N] ...`` -> the registry-resolved
+    ``<NAME><N>d`` subcommand (``tpucfd --model adr --n 64 64 64 ...``).
+    ``N`` comes from an explicit ``--ndim`` or the arity of ``--n``;
+    unknown model names fail listing the registered families. Leaves
+    every other argv untouched."""
+    if not argv or argv[0] != "--model":
+        return argv
+    if len(argv) < 2:
+        raise SystemExit("--model needs a model name")
+    from multigpu_advectiondiffusion_tpu.models import (
+        registry as model_registry,
+    )
+
+    name = argv[1]
+    rest = list(argv[2:])
+    try:
+        spec = model_registry.get(name)
+    except KeyError as err:
+        raise SystemExit(str(err))
+    ndim = None
+    if "--ndim" in rest:
+        i = rest.index("--ndim")
+        try:
+            ndim = int(rest[i + 1])
+        except (IndexError, ValueError):
+            raise SystemExit("--ndim wants an integer")
+        del rest[i:i + 2]
+    elif "--n" in rest:
+        j = rest.index("--n") + 1
+        ndim = 0
+        while j + ndim < len(rest):
+            tok = rest[j + ndim]
+            try:
+                int(tok)
+            except ValueError:
+                break
+            ndim += 1
+    if not ndim:
+        raise SystemExit(
+            "--model needs --ndim N or --n (to infer dimensionality)"
+        )
+    if ndim not in spec.cli_dims:
+        raise SystemExit(
+            f"model {name!r} serves {spec.cli_dims}-D grids, not {ndim}-D"
+        )
+    return [f"{name}{ndim}d"] + rest
+
+
 def main(argv=None):
     from multigpu_advectiondiffusion_tpu.utils.platform_env import (
         honor_platform_env,
     )
 
     honor_platform_env()
+    if argv is None:
+        argv = sys.argv[1:]
+    argv = _resolve_model_argv(list(argv))
     args = build_parser().parse_args(argv)
     # telemetry sink BEFORE any distributed/backend work, so the
     # multihost join's retry loop and every later subsystem stream into

@@ -220,6 +220,27 @@ def max_principle_rule(tolerance: float = 1e-3) -> ViolationRule:
     return ViolationRule("max_principle", tolerance, check)
 
 
+def positivity_rule(tolerance: float = 1e-3) -> ViolationRule:
+    """Nonnegative initial data stays nonnegative under
+    advection–diffusion with a monotone advective flux and K(x) > 0
+    (linear decay only shrinks it) — up to the O4 diffusive stencil's
+    non-monotone wiggle, hence the tolerance band. Vacuous for signed
+    initial data (the max-principle rule covers it there)."""
+
+    def check(stats, baseline, tol):
+        if baseline.get("min", 0.0) < 0.0:
+            return None  # signed data: positivity is not a property
+        scale = max(1.0, abs(baseline.get("max", 0.0)))
+        if stats["min"] < -tol * scale:
+            return (
+                f"positivity: min {stats['min']:.6g} fell below "
+                f"-{tol * scale:.3g} from nonnegative initial data"
+            )
+        return None
+
+    return ViolationRule("positivity", tolerance, check)
+
+
 def tv_monotone_rule(tolerance: float = 0.05) -> ViolationRule:
     """WENO on a scalar conservation law is essentially non-oscillatory:
     total variation stays bounded by the initial data's (the 'E' in

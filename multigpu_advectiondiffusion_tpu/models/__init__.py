@@ -1,20 +1,22 @@
+"""Solver families, resolved through the plugin registry.
+
+The config/solver classes exported here are DERIVED from
+``models/registry.py`` — adding a family means registering a
+:class:`~.registry.ModelSpec` in its module, never editing this file
+(ISSUE 15 satellite: no more hard-coded model import lists).
+"""
+
+from multigpu_advectiondiffusion_tpu.models import registry
+from multigpu_advectiondiffusion_tpu.models.ensemble import EnsembleSolver
 from multigpu_advectiondiffusion_tpu.models.state import (
     EnsembleState,
     SolverState,
 )
-from multigpu_advectiondiffusion_tpu.models.diffusion import (
-    DiffusionConfig,
-    DiffusionSolver,
-)
-from multigpu_advectiondiffusion_tpu.models.burgers import BurgersConfig, BurgersSolver
-from multigpu_advectiondiffusion_tpu.models.ensemble import EnsembleSolver
 
-__all__ = [
-    "SolverState",
-    "EnsembleState",
-    "EnsembleSolver",
-    "DiffusionConfig",
-    "DiffusionSolver",
-    "BurgersConfig",
-    "BurgersSolver",
-]
+__all__ = ["SolverState", "EnsembleState", "EnsembleSolver", "registry"]
+
+for _spec in registry.specs():
+    for _cls in (_spec.config_cls, _spec.solver_cls):
+        globals()[_cls.__name__] = _cls
+        __all__.append(_cls.__name__)
+del _spec, _cls

@@ -1,0 +1,658 @@
+"""Advection–diffusion–reaction solver — the repo's title workload.
+
+``u_t + div(a u) = div(K(x) grad u) + R(u)`` with
+
+* constant advection velocity ``a`` (one value per physical axis),
+  discretized either by the monotone first-order **upwind** flux (the
+  fused Pallas rung's scheme, matched term-for-term on the generic
+  rung) or by **WENO5** linear-advection via the existing
+  Lax–Friedrichs flux machinery (``ops/weno.flux_divergence`` with
+  ``ops/flux.linear`` — generic rung only);
+* spatially varying diffusivity ``K(x) = K0 (1 + eps * prod_i
+  cos(pi x̂_i))`` applied in the non-conservative form ``K(x) lap(u)``
+  over the existing O4/O2 Laplacian taps (``eps = kappa_variation``,
+  ``|eps| < 1`` keeps K positive; ``x̂ = g/(n-1) - 1/2`` in global cell
+  indices — :func:`kappa_profile` is the ONE definition the fused
+  kernel's in-kernel evaluation mirrors);
+* linear-decay reaction ``R(u) = -lambda u`` (``reaction_rate``).
+
+The family is a *plugin*: it implements the registration contract
+(``stencil_spec`` / ``diagnostics_spec`` / ``ensemble_operands`` /
+``cfl_rule``) and registers a :class:`~.registry.ModelSpec` at module
+bottom — every generic subsystem (sharded dispatch, sentinel/rollback,
+ensemble vmap, measured tuner, science gates, static verifiers, CLI,
+bench) serves it with zero family-specific wiring. Reference-parity
+walls follow the diffusion family's discipline (RHS zeroed on the
+global boundary band, Dirichlet faces re-clamped) with *global*
+indices, so sharded runs reproduce single-device runs to roundoff
+(the advective fusion re-associates across program shapes, so the
+match is ulp-level rather than bit-exact; tests pin the bound).
+
+Analytic solution (constant coefficients, ``eps = 0``): the advecting,
+decaying heat kernel ``u(x, t) = (t0/t)^{d/2} exp(-|x - a (t-t0)|^2 /
+(4 K t)) exp(-lambda (t-t0))`` — translation by ``a t``, diffusive
+spreading, exponential decay; the accuracy tests (tests/test_adr.py)
+hold both rungs to it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from multigpu_advectiondiffusion_tpu.core.grid import Grid
+from multigpu_advectiondiffusion_tpu.models.base import (
+    LocalPhysics,
+    SolverBase,
+    StepContext,
+)
+from multigpu_advectiondiffusion_tpu.models.registry import (
+    ModelSpec,
+    register_model,
+    resolve_bc,
+)
+from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu.ops import flux as flux_lib
+from multigpu_advectiondiffusion_tpu.ops.laplacian import (
+    D2_STENCILS,
+    laplacian,
+)
+from multigpu_advectiondiffusion_tpu.ops.stencils import (
+    boundary_band_mask,
+    face_mask,
+    shifted,
+)
+from multigpu_advectiondiffusion_tpu.ops.weno import HALO, flux_divergence
+from multigpu_advectiondiffusion_tpu.timestepping.cfl import (
+    advection_diffusion_dt,
+)
+from multigpu_advectiondiffusion_tpu.utils import metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class ADRConfig:
+    grid: Grid
+    diffusivity: float = 1.0  # K0, the base (mean) diffusivity
+    # advection velocity: a scalar (broadcast to every axis) or one
+    # value per PHYSICAL axis in x [y [z]] order (the --n convention)
+    velocity: object = 0.5
+    # spatial variation amplitude eps of K(x) = K0 (1 + eps prod cos);
+    # |eps| < 1 keeps the coefficient positive; 0 = constant K (the
+    # analytic-solution case)
+    kappa_variation: float = 0.0
+    reaction_rate: float = 0.0  # lambda >= 0; R(u) = -lambda u
+    # advective discretization: "upwind" (monotone first-order; the
+    # fused rung's scheme) or "weno5" (LF-split linear advection via
+    # the existing WENO machinery; generic rung only)
+    advect: str = "upwind"
+    order: int = 4  # diffusive Laplacian order (2 | 4)
+    cfl: float = 0.4  # advective share of the combined dt bound
+    safety: float = 0.8  # diffusive/reaction share of the dt bound
+    integrator: str = "ssp_rk3"
+    dtype: str = "float32"
+    ic: object = "heat_kernel"
+    ic_params: Tuple = ()
+    bc: object = "dirichlet"
+    t0: float = 0.1  # initial time of the analytic kernel
+    reference_parity: bool = True
+    boundary_band: int = 2  # frozen global band (diffusion discipline)
+    impl: str = "xla"
+    overlap: str = "padded"
+    # accepted for config uniformity (the auto-tuner's decision replace
+    # writes them); ADR serves the per-step collective cadence only —
+    # the k-step/dma schedules live on the slab rung this family does
+    # not ship
+    steps_per_exchange: int = 1
+    exchange: str = "collective"
+
+    def __post_init__(self):
+        from multigpu_advectiondiffusion_tpu.ops import IMPLS
+
+        if self.impl not in IMPLS:
+            raise ValueError(
+                f"unknown impl {self.impl!r}; ladder rungs: {IMPLS}"
+            )
+        if self.overlap not in ("padded", "split"):
+            raise ValueError(f"unknown overlap {self.overlap!r}")
+        if self.advect not in ("upwind", "weno5"):
+            raise ValueError(
+                f"unknown advect {self.advect!r}; 'upwind' or 'weno5'"
+            )
+        if self.order not in D2_STENCILS:
+            raise ValueError(
+                f"unknown diffusive order {self.order}; use "
+                f"{sorted(D2_STENCILS)}"
+            )
+        if not -1.0 < float(self.kappa_variation) < 1.0:
+            raise ValueError(
+                "kappa_variation must satisfy |eps| < 1 (K(x) must "
+                f"stay positive), got {self.kappa_variation!r}"
+            )
+        if float(self.reaction_rate) < 0.0:
+            raise ValueError(
+                "reaction_rate is a linear DECAY rate (lambda >= 0); "
+                f"got {self.reaction_rate!r}"
+            )
+        if int(self.steps_per_exchange or 1) != 1:
+            raise ValueError(
+                "ADR serves the per-step exchange cadence only "
+                "(steps_per_exchange=1): the k-step deep-halo schedule "
+                "rides the slab rung, which this family does not ship"
+            )
+        if self.exchange != "collective":
+            raise ValueError(
+                "ADR serves the XLA collective halo exchange only: "
+                "the in-kernel remote-DMA transport rides the slab "
+                "rung, which this family does not ship"
+            )
+        if not isinstance(self.velocity, (int, float)):
+            vel = tuple(self.velocity)
+            if len(vel) != self.grid.ndim:
+                raise ValueError(
+                    f"velocity has {len(vel)} components for a "
+                    f"{self.grid.ndim}-D grid (x [y [z]] order, or one "
+                    "scalar broadcast to every axis)"
+                )
+
+
+def kappa_profile(shape_global, local_shape, offsets, eps: float, dtype):
+    """The dimensionless K-variation profile ``1 + eps prod_i
+    cos(pi x̂_i)`` on a (possibly shard-local) window, ``x̂ = g/(n-1) -
+    1/2`` in GLOBAL cell indices — the single source the fused kernel's
+    in-kernel evaluation (``ops/pallas/fused_adr._stage_kernel``)
+    mirrors; tests hold the two together. ``None`` when ``eps == 0``
+    (constant coefficient: scalar multiply, no field)."""
+    if not eps:
+        return None
+    prof = None
+    ndim = len(shape_global)
+    for ax in range(ndim):
+        g = jnp.arange(local_shape[ax], dtype=dtype) + offsets[ax]
+        c = jnp.cos(math.pi * (g / (shape_global[ax] - 1) - 0.5))
+        shp = [1] * ndim
+        shp[ax] = -1
+        c = jnp.reshape(c, shp)
+        prof = c if prof is None else prof * c
+    return (1.0 + eps * prof).astype(dtype)
+
+
+class ADRSolver(SolverBase):
+    cfg: ADRConfig
+
+    def __init__(self, cfg: ADRConfig, mesh=None, decomp=None):
+        super().__init__(cfg, mesh=mesh, decomp=decomp)
+        cfg = self.cfg  # impl="auto" may have replaced it
+        kmax = float(cfg.diffusivity) * (
+            1.0 + abs(float(cfg.kappa_variation))
+        )
+        self.dt = float(
+            advection_diffusion_dt(
+                self._velocity_zyx(), kmax, cfg.grid.spacing,
+                cfl=cfg.cfl, safety=cfg.safety,
+                reaction=float(cfg.reaction_rate),
+            )
+        )
+
+    # ------------------------------------------------------------------ #
+    # Registration contract (models/registry.REQUIRED_SOLVER_CONTRACT)
+    # ------------------------------------------------------------------ #
+    def stencil_spec(self) -> dict:
+        """Family stencil metadata: the per-stage radius is the MAX of
+        the advective and diffusive tap reaches (upwind 1 / WENO5 3 vs
+        O2 1 / O4 2) — what the tuner's fused ghost depth and the
+        static halo verifier's ADR combos derive from."""
+        cfg = self.cfg
+        adv_r = 1 if cfg.advect == "upwind" else HALO[5]
+        diff_r = D2_STENCILS[cfg.order][1]
+        return {
+            "family": "adr",
+            "advective_radius": adv_r,
+            "diffusive_radius": diff_r,
+            "stage_radius": max(adv_r, diff_r),
+        }
+
+    def diagnostics_spec(self) -> dict:
+        """Reaction-free ADR transports and spreads but creates no new
+        extremum (monotone upwind flux; K(x) > 0), and nonnegative data
+        stays nonnegative — register the max-principle AND positivity
+        tolerance rules so a broken coefficient/flux surfaces as a
+        ``phys:violation`` before the norm sentinel trips. With decay
+        (lambda > 0) extrema shrink, so the rules stay valid; the
+        analytic amplitude-decay meta is registered only for the
+        constant-coefficient reaction-free heat-kernel workload whose
+        log-log slope is exactly ``-d/2``."""
+        from multigpu_advectiondiffusion_tpu.diagnostics import physics
+
+        cfg = self.cfg
+        spec = {"rules": [], "meta": {}}
+        spec["rules"].append(physics.max_principle_rule())
+        spec["rules"].append(physics.positivity_rule())
+        if (
+            cfg.ic == "heat_kernel"
+            and not cfg.kappa_variation
+            and not cfg.reaction_rate
+        ):
+            spec["meta"]["decay_rate_analytic"] = -self.grid.ndim / 2.0
+        return spec
+
+    def ensemble_operands(self) -> dict:
+        """Member-varying scalars of the batched ensemble engine: the
+        base diffusivity K0 and the decay rate lambda (both move the
+        stability dt, recomputed in-trace per member)."""
+        return {
+            "diffusivity": float(self.cfg.diffusivity),
+            "reaction_rate": float(self.cfg.reaction_rate),
+        }
+
+    def cfl_rule(self) -> dict:
+        """Queryable time-step contract: the combined harmonic
+        advective/diffusive/reaction bound
+        (``timestepping.cfl.advection_diffusion_dt``)."""
+        cfg = self.cfg
+        return {
+            "kind": "advection-diffusion-reaction",
+            "dt": float(self.dt),
+            "cfl": float(cfg.cfl),
+            "safety": float(cfg.safety),
+            "terms": {
+                "advective": any(self._velocity_zyx()),
+                "diffusive": True,
+                "reaction": bool(cfg.reaction_rate),
+            },
+        }
+
+    # ------------------------------------------------------------------ #
+    # Config plumbing
+    # ------------------------------------------------------------------ #
+    def _velocity_zyx(self) -> Tuple[float, ...]:
+        """Velocity per ARRAY axis (z, y, x order): config scalars
+        broadcast, tuples arrive in physical x [y [z]] order and flip."""
+        v = self.cfg.velocity
+        if isinstance(v, (int, float)):
+            return (float(v),) * self.grid.ndim
+        return tuple(float(c) for c in reversed(tuple(v)))
+
+    def _op_impl(self) -> str:
+        """Per-op kernel strategy: Pallas flavors route the Laplacian
+        through the per-axis kernels for f32
+        (``SolverBase._pallas_f32_gate``); the advective sweep always
+        runs XLA (the per-axis WENO kernels are Burgers-calibrated)."""
+        from multigpu_advectiondiffusion_tpu.ops import op_impl as _norm
+
+        self._op_fallback = None
+        return self._pallas_f32_gate(_norm(self.cfg.impl))
+
+    def ic_spec(self):
+        """Thread t0/K0 into the heat-kernel IC so the initial state
+        matches :meth:`exact_solution` at ``t = t0`` (the diffusion
+        family's coupling, applied to the advecting kernel — at t0 the
+        translation is zero, so the centered kernel is exact)."""
+        name = self.cfg.ic
+        if name == "heat_kernel":
+            return name, {
+                "t0": self.cfg.t0,
+                "diffusivity": self.cfg.diffusivity,
+            }
+        return name, {}
+
+    # ------------------------------------------------------------------ #
+    # Shard-local physics
+    # ------------------------------------------------------------------ #
+    def build_local(self, ctx: StepContext, overrides=None) -> LocalPhysics:
+        cfg = self.cfg
+        grid = cfg.grid
+        bcs = self.bcs
+        spacing = grid.spacing
+        vel = self._velocity_zyx()
+        eps = float(cfg.kappa_variation)
+        # ensemble mode: traced per-member K0/lambda enter as operands
+        # (never closure constants); the stability dt re-derives from
+        # them in-trace
+        K0 = cfg.diffusivity
+        lam = cfg.reaction_rate
+        has_react = bool(cfg.reaction_rate)
+        dt = self.dt
+        if overrides and (
+            "diffusivity" in overrides or "reaction_rate" in overrides
+        ):
+            if "diffusivity" in overrides:
+                K0 = overrides["diffusivity"]
+            if "reaction_rate" in overrides:
+                lam = overrides["reaction_rate"]
+                has_react = True
+            dt = advection_diffusion_dt(
+                vel, K0 * (1.0 + abs(eps)), spacing,
+                cfl=cfg.cfl, safety=cfg.safety, reaction=lam,
+            )
+
+        ghost_fn = ctx.ghost_fn if cfg.overlap == "split" else None
+        impl = self._op_impl()
+        # the K-variation profile on this shard's window (global
+        # indices via ctx.offsets; None = constant coefficient)
+        prof = kappa_profile(
+            ctx.global_shape, ctx.local_shape, ctx.offsets, eps,
+            self.dtype,
+        )
+
+        def diffusive(u):
+            lap = laplacian(
+                u, spacing, diffusivity=1.0, order=cfg.order,
+                padder=ctx.padder, impl=impl, ghost_fn=ghost_fn,
+            )
+            return K0 * lap if prof is None else (K0 * prof) * lap
+
+        if cfg.advect == "weno5":
+            fluxes = [
+                flux_lib.linear(c=a) if a else None for a in vel
+            ]
+
+            def advective(u):
+                acc = None
+                for axis in range(u.ndim):
+                    if fluxes[axis] is None:
+                        continue
+                    div = flux_divergence(
+                        u, axis, spacing[axis], fluxes[axis],
+                        order=5, variant="js",
+                        padder=ctx.padder, ghost_fn=ghost_fn,
+                    )
+                    acc = div if acc is None else acc + div
+                return acc
+
+        else:
+
+            def advective(u):
+                acc = None
+                for axis, a in enumerate(vel):
+                    if a == 0.0:
+                        continue
+                    up = ctx.padder(u, axis, 1)
+                    n = u.shape[axis]
+                    lo = shifted(up, axis, 0, n)   # u_{i-1}
+                    mid = shifted(up, axis, 1, n)  # u_i
+                    hi = shifted(up, axis, 2, n)   # u_{i+1}
+                    cp = max(a, 0.0) / spacing[axis]
+                    cm = min(a, 0.0) / spacing[axis]
+                    term = cp * (mid - lo) + cm * (hi - mid)
+                    acc = term if acc is None else acc + term
+                return acc
+
+        walled_axes = [
+            a for a, b in enumerate(bcs) if b.kind != "periodic"
+        ]
+        band = boundary_band_mask(
+            ctx.local_shape, cfg.boundary_band, ctx.global_shape,
+            ctx.offsets, axes=walled_axes,
+        ) if cfg.reference_parity and walled_axes else None
+
+        def rhs(u):
+            out = diffusive(u)
+            adv = advective(u)
+            if adv is not None:
+                out = out - adv
+            if has_react:
+                out = out - lam * u
+            if band is not None:
+                out = jnp.where(band, out, jnp.zeros_like(out))
+            return out
+
+        post = None
+        if cfg.reference_parity and walled_axes:
+            dir_axes = [
+                a for a in walled_axes if bcs[a].kind == "dirichlet"
+            ]
+            clamps = [
+                (
+                    face_mask(ctx.local_shape, [a], ctx.global_shape,
+                              ctx.offsets),
+                    bcs[a].value,
+                )
+                for a in dir_axes
+            ]
+            if clamps:
+
+                def post(u):
+                    # Dirichlet walls re-imposed each step (the
+                    # diffusion family's heat3d.m:65-67 discipline)
+                    for faces, value in clamps:
+                        u = jnp.where(
+                            faces, jnp.asarray(value, u.dtype), u
+                        )
+                    return u
+
+        return LocalPhysics(rhs=rhs, static_dt=dt, post=post)
+
+    # ------------------------------------------------------------------ #
+    # Fused per-stage Pallas fast path
+    # ------------------------------------------------------------------ #
+    def _fused_stepper(self, mode: str = "iters"):
+        """The fused ADR SSP-RK3 per-stage stepper when eligible, else
+        ``None`` (generic path). Eligibility mirrors the kernel's baked
+        assumptions: 3-D cartesian, upwind advection, O4 diffusion,
+        SSP-RK3, f32, uniform frozen Dirichlet walls. Under a mesh the
+        stages run shard-local with the per-stage ppermute ghost
+        refresh; ADR ships no whole-step/slab/split-overlap variants —
+        those pins decline loudly here and the generic rung serves
+        them."""
+        cfg = self.cfg
+        from multigpu_advectiondiffusion_tpu.ops import is_fused_impl
+        from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R
+
+        self._fused_fallback = None
+        if not is_fused_impl(cfg.impl):
+            return self._decline(
+                f"impl={cfg.impl!r} does not request fusion"
+            )
+        if cfg.impl in ("pallas_step", "pallas_slab"):
+            return self._decline(
+                "ADR ships a per-stage fused rung only (no whole-step/"
+                "slab variant)"
+            )
+        if self.grid.ndim != 3:
+            return self._decline("fused ADR kernel is 3-D only")
+        if cfg.advect != "upwind":
+            return self._decline(
+                "fused ADR bakes the monotone upwind advective flux; "
+                "WENO5 advection rides the generic rung"
+            )
+        if cfg.order != 4:
+            return self._decline("fused ADR bakes the O4 diffusive taps")
+        if cfg.integrator != "ssp_rk3":
+            return self._decline("fused kernels bake in SSP-RK3")
+        if self.dtype != jnp.float32:
+            return self._decline("fused ADR kernel is float32-only")
+        if not cfg.reference_parity or cfg.boundary_band < 1:
+            return self._decline(
+                "fused walls need reference_parity with "
+                "boundary_band >= 1"
+            )
+        bcs = self.bcs
+        if not all(b.kind == "dirichlet" for b in bcs) or not all(
+            b.value == bcs[0].value for b in bcs
+        ):
+            return self._decline(
+                "fused walls need uniform Dirichlet BCs on every axis"
+            )
+        lshape = (
+            self.grid.shape
+            if self.mesh is None
+            else self.decomp.local_shape(self.mesh, self.grid.shape)
+        )
+        if self.mesh is not None:
+            if self._split_overlap_requested():
+                return self._decline(
+                    "fused ADR runs the serialized per-stage ghost "
+                    "refresh; overlap='split' rides the generic rung"
+                )
+            if any(lshape[ax] < R for ax, _ in self.decomp.axes):
+                return self._decline(
+                    f"a sharded axis is thinner than the O4 halo ({R})"
+                )
+        if "fused" not in self._cache:
+            from multigpu_advectiondiffusion_tpu.ops.pallas.fused_adr import (  # noqa: E501
+                FusedADRStepper,
+            )
+
+            kwargs = {}
+            if self.mesh is not None:
+                kwargs["global_shape"] = self.grid.shape
+            self._cache["fused"] = FusedADRStepper(
+                lshape,
+                self.dtype,
+                self.grid.spacing,
+                cfg.diffusivity,
+                self._velocity_zyx(),
+                cfg.reaction_rate,
+                self.dt,
+                cfg.boundary_band,
+                bcs[0].value,
+                kappa_variation=cfg.kappa_variation,
+                **kwargs,
+            )
+        return self._cache["fused"]
+
+    # ------------------------------------------------------------------ #
+    # Analytic solution (constant coefficients)
+    # ------------------------------------------------------------------ #
+    def exact_solution(self, t: float) -> jnp.ndarray:
+        """The advecting, decaying heat kernel (module docstring).
+        Defined only for constant coefficients (``kappa_variation ==
+        0``) — the variable-K workload is validated by the max-
+        principle/positivity diagnostics and rung cross-checks
+        instead."""
+        cfg = self.cfg
+        if cfg.kappa_variation:
+            raise ValueError(
+                "no closed-form solution with spatially varying K"
+            )
+        d = cfg.diffusivity
+        vel = self._velocity_zyx()
+        tau = t - cfg.t0
+        ndim = cfg.grid.ndim
+        r2 = None
+        for ax in range(ndim):
+            c = cfg.grid.coords(ax, self.dtype) - vel[ax] * tau
+            shp = [1] * ndim
+            shp[ax] = -1
+            term = jnp.reshape(c * c, shp)
+            r2 = term if r2 is None else r2 + term
+        amp = (cfg.t0 / t) ** (ndim / 2.0) * math.exp(
+            -float(cfg.reaction_rate) * tau
+        )
+        return (amp * jnp.exp(-r2 / (4.0 * d * t))).astype(self.dtype)
+
+    def error_norms(self, state: SolverState, t: float | None = None):
+        t_val = float(state.t) if t is None else t
+        return metrics.error_norms(
+            state.u, self.exact_solution(t_val), self.cfg.grid.spacing
+        )
+
+
+# --------------------------------------------------------------------- #
+# Registration: the family as a declarative plugin descriptor
+# --------------------------------------------------------------------- #
+def _cli_configure(p, ndim):
+    p.add_argument("--K", type=float, default=1.0,
+                   help="base diffusivity K0 of K(x)")
+    p.add_argument("--velocity", type=float, nargs="+", default=[0.5],
+                   help="advection velocity: one value (broadcast) or "
+                        "one per physical axis (x [y [z]])")
+    p.add_argument("--kappa-variation", type=float, default=0.0,
+                   metavar="EPS",
+                   help="spatial variation amplitude of K(x) = K0 (1 + "
+                        "EPS prod cos(pi x̂)); |EPS| < 1 (0 = constant)")
+    p.add_argument("--reaction", type=float, default=0.0,
+                   metavar="LAMBDA",
+                   help="linear decay rate; R(u) = -LAMBDA u")
+    p.add_argument("--advect", default="upwind",
+                   choices=["upwind", "weno5"],
+                   help="advective flux: monotone upwind (fused-rung "
+                        "eligible) or WENO5 linear advection (generic)")
+    p.add_argument("--order", type=int, default=4, choices=[2, 4],
+                   help="diffusive Laplacian order")
+    p.add_argument("--cfl", type=float, default=0.4)
+    p.add_argument("--t0", type=float, default=0.1)
+
+
+def _cli_build(args, grid, ndim):
+    vel = list(args.velocity)
+    if len(vel) not in (1, ndim):
+        raise ValueError(
+            f"--velocity wants 1 or {ndim} values for a {ndim}-D grid, "
+            f"got {len(vel)}"
+        )
+    velocity = vel[0] if len(vel) == 1 else tuple(vel)
+    return ADRConfig(
+        grid=grid,
+        diffusivity=args.K,
+        velocity=velocity,
+        kappa_variation=args.kappa_variation,
+        reaction_rate=args.reaction,
+        advect=args.advect,
+        order=args.order,
+        cfl=args.cfl,
+        integrator=args.integrator,
+        dtype=args.dtype,
+        ic=args.ic or "heat_kernel",
+        bc=resolve_bc(args, "dirichlet"),
+        t0=args.t0,
+        impl=args.impl,
+        overlap=args.overlap,
+        steps_per_exchange=args.steps_per_exchange,
+        exchange=args.exchange,
+    )
+
+
+def _stage_radius(cfg) -> int:
+    """Fused per-stage stencil radius (the tuner's ghost depth is 3h):
+    the fused ADR kernel shares the Pallas O4 layout (R = 2)."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R
+
+    return R
+
+
+def _key_extras(cfg):
+    return [
+        f"advect={cfg.advect}",
+        f"order={cfg.order}",
+        f"kvar={bool(cfg.kappa_variation)}",
+        f"react={bool(cfg.reaction_rate)}",
+    ]
+
+
+def _cost_kwargs(cfg):
+    return {
+        "order": cfg.order,
+        "advect": cfg.advect,
+        "reaction": bool(cfg.reaction_rate),
+        "variable_k": bool(cfg.kappa_variation),
+    }
+
+
+def _bench_build(grid, dtype, impl, case):
+    # the bench rows exercise the full family: variable K, advection
+    # on every axis, decay — the title workload, not a diffusion alias
+    return ADRConfig(
+        grid=grid, dtype=dtype, impl=impl, velocity=0.5,
+        kappa_variation=0.2, reaction_rate=0.25, ic="heat_kernel",
+    )
+
+
+register_model(ModelSpec(
+    name="adr",
+    config_cls=ADRConfig,
+    solver_cls=ADRSolver,
+    description="advection–diffusion–reaction with spatially varying "
+                "K(x) — the title workload",
+    check_error=True,
+    sweep_aliases={"K": "diffusivity", "lambda": "reaction_rate"},
+    cli_configure=_cli_configure,
+    cli_build=_cli_build,
+    stage_radius=_stage_radius,
+    key_extras=_key_extras,
+    cost_kwargs=_cost_kwargs,
+    bench_build=_bench_build,
+))

@@ -269,6 +269,26 @@ class SolverBase:
         standard suite; overrides add what their physics guarantees."""
         return {}
 
+    def stencil_spec(self) -> dict:
+        """Family-level stencil metadata — part of the solver-plugin
+        registration contract (``models/registry.
+        REQUIRED_SOLVER_CONTRACT``; the steppers' per-instance
+        ``stencil_spec`` remains the halo verifier's per-rung source).
+        Expected keys: ``stage_radius`` (the max of the advective and
+        diffusive tap reaches) plus per-term radii. The base class
+        declares nothing — REGISTERED solvers must override (enforced
+        at ``register_model`` and by the ``registry-completeness``
+        lint rule); ad-hoc unregistered subclasses may ignore it."""
+        return {}
+
+    def cfl_rule(self) -> dict:
+        """Queryable time-step contract — part of the registration
+        contract: what rule produced this solver's dt (``kind`` plus
+        ``dt``/``cfl``/``safety`` as applicable). Base declares
+        nothing; registered solvers must override (same enforcement as
+        :meth:`stencil_spec`)."""
+        return {}
+
     # ------------------------------------------------------------------ #
     # Config plumbing
     # ------------------------------------------------------------------ #

@@ -126,6 +126,31 @@ class BurgersSolver(SolverBase):
             return "xla"
         return impl
 
+    def stencil_spec(self) -> dict:
+        """Family stencil metadata (registration contract): the WENO
+        reconstruction radius of the configured order (the viscous O4
+        Laplacian's radius 2 never exceeds it)."""
+        from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+        r = HALO[self.cfg.weno_order]
+        return {
+            "family": "burgers",
+            "advective_radius": r,
+            "diffusive_radius": 2 if self.cfg.nu else 0,
+            "stage_radius": r,
+        }
+
+    def cfl_rule(self) -> dict:
+        """Queryable time-step contract (registration contract): the
+        advective CFL bound ``cfl dx / max|f'(u)|`` — adaptive (global
+        wave-speed reduction per step) or the CUDA-parity fixed step."""
+        return {
+            "kind": "advective",
+            "cfl": float(self.cfg.cfl),
+            "adaptive": bool(self.cfg.adaptive_dt),
+            "dt": None if self.dt is None else float(self.dt),
+        }
+
     def diagnostics_spec(self) -> dict:
         """In-situ diagnostics contract: WENO on the convex Burgers flux
         is essentially non-oscillatory — total variation is bounded by
@@ -465,3 +490,100 @@ class BurgersSolver(SolverBase):
                 cfg.weno_variant, cfg.nu, dt=self.dt, **kwargs,
             )
         return self._cache["fused_slab"]
+
+
+# --------------------------------------------------------------------- #
+# Registration: the family as a declarative plugin descriptor
+# (models/registry.py; the CLI, tuner, cost model, bench matrix and
+# static verifiers resolve the family through this spec)
+# --------------------------------------------------------------------- #
+def _cli_configure(p, ndim):
+    p.add_argument("--flux", default="burgers",
+                   choices=["burgers", "linear", "buckley"])
+    p.add_argument("--weno-order", type=int, default=5, choices=[5, 7])
+    p.add_argument("--weno-variant", default="js", choices=["js", "z"])
+    p.add_argument("--cfl", type=float, default=0.4)
+    p.add_argument("--nu", type=float, default=0.0,
+                   help="viscosity (1e-5 in SingleGPU Burgers)")
+    p.add_argument("--fixed-dt", action="store_true",
+                   help="reference-parity dt = CFL*dx (hard-coded "
+                        "max|u|=1, Burgers3d_Baseline/main.c:193)")
+
+
+def _cli_build(args, grid, ndim):
+    from multigpu_advectiondiffusion_tpu.models.registry import resolve_bc
+
+    return BurgersConfig(
+        grid=grid,
+        flux=args.flux,
+        weno_order=args.weno_order,
+        weno_variant=args.weno_variant,
+        cfl=args.cfl,
+        nu=args.nu,
+        adaptive_dt=not args.fixed_dt,
+        integrator=args.integrator,
+        dtype=args.dtype,
+        ic=args.ic or "gaussian",
+        bc=resolve_bc(args, "edge"),
+        impl=args.impl,
+        overlap=args.overlap,
+        steps_per_exchange=args.steps_per_exchange,
+        exchange=args.exchange,
+    )
+
+
+def _stage_radius(cfg) -> int:
+    """Fused per-stage stencil radius (tuner ghost depth = 3h): the
+    WENO reconstruction halo of the configured order."""
+    from multigpu_advectiondiffusion_tpu.ops.weno import HALO
+
+    return HALO[getattr(cfg, "weno_order", 5)]
+
+
+def _key_extras(cfg):
+    return [
+        f"weno={cfg.weno_order}-{cfg.weno_variant}",
+        f"adaptive={bool(cfg.adaptive_dt)}",
+        f"viscous={bool(getattr(cfg, 'nu', 0.0))}",
+    ]
+
+
+def _cost_kwargs(cfg):
+    return {
+        "weno_order": getattr(cfg, "weno_order", 5),
+        "viscous": bool(getattr(cfg, "nu", 0.0)),
+    }
+
+
+def _bench_build(grid, dtype, impl, case):
+    return BurgersConfig(
+        grid=grid,
+        weno_order=getattr(case, "weno_order", 5),
+        cfl=0.4,
+        adaptive_dt=not getattr(case, "fixed_dt", True),
+        nu=getattr(case, "nu", 0.0),
+        dtype=dtype,
+        ic="gaussian",
+        impl=impl,
+    )
+
+
+from multigpu_advectiondiffusion_tpu.models.registry import (  # noqa: E402
+    ModelSpec,
+    register_model,
+)
+
+register_model(ModelSpec(
+    name="burgers",
+    config_cls=BurgersConfig,
+    solver_cls=BurgersSolver,
+    description="scalar conservation law u_t + div f(u) = nu lap(u), "
+                "WENO5/7 + Lax–Friedrichs",
+    check_error=False,
+    cli_configure=_cli_configure,
+    cli_build=_cli_build,
+    stage_radius=_stage_radius,
+    key_extras=_key_extras,
+    cost_kwargs=_cost_kwargs,
+    bench_build=_bench_build,
+))

@@ -137,6 +137,32 @@ class DiffusionSolver(SolverBase):
             return name, {"t0": self.cfg.t0, "diffusivity": self.cfg.diffusivity}
         return name, {}
 
+    def stencil_spec(self) -> dict:
+        """Family stencil metadata (registration contract): the
+        diffusive tap radius of the configured Laplacian order — what
+        the tuner's fused ghost depth and the halo verifier's family
+        combos derive from."""
+        from multigpu_advectiondiffusion_tpu.ops.laplacian import (
+            D2_STENCILS,
+        )
+
+        r = D2_STENCILS[self.cfg.order][1]
+        return {
+            "family": "diffusion",
+            "diffusive_radius": r,
+            "stage_radius": r,
+        }
+
+    def cfl_rule(self) -> dict:
+        """Queryable time-step contract (registration contract): the
+        diffusive stability bound ``safety / (2 K sum 1/dx^2)``
+        computed at construction."""
+        return {
+            "kind": "diffusive",
+            "dt": float(self.dt),
+            "safety": float(self.cfg.safety),
+        }
+
     def diagnostics_spec(self) -> dict:
         """In-situ diagnostics contract (``diagnostics/physics.py``):
 
@@ -583,3 +609,82 @@ class DiffusionSolver(SolverBase):
         f = self._compiled("advref", lambda: self._wrap(block, 1, 2))
         u, t = f(state.u, state.t, jnp.asarray(t_end, state.t.dtype))
         return SolverState(u=u, t=t, it=state.it)
+
+
+# --------------------------------------------------------------------- #
+# Registration: the family as a declarative plugin descriptor
+# (models/registry.py; the CLI, tuner, cost model, bench matrix and
+# static verifiers resolve the family through this spec)
+# --------------------------------------------------------------------- #
+def _cli_configure(p, ndim, axisym: bool = False):
+    p.add_argument("--K", type=float, default=0.27 if axisym else 1.0,
+                   help="diffusivity (main.c arg 1)")
+    p.add_argument("--order", type=int, default=4, choices=[2, 4])
+    p.add_argument("--t0", type=float, default=1.0 if axisym else 0.1)
+
+
+def _cli_build(args, grid, ndim, geometry: str = "cartesian"):
+    from multigpu_advectiondiffusion_tpu.models.registry import resolve_bc
+
+    return DiffusionConfig(
+        grid=grid,
+        diffusivity=args.K,
+        order=args.order,
+        integrator=args.integrator,
+        dtype=args.dtype,
+        ic=args.ic or "heat_kernel",
+        bc=resolve_bc(args, "dirichlet" if geometry == "cartesian"
+                      else ("edge", "dirichlet")),
+        t0=args.t0,
+        geometry=geometry,
+        impl=args.impl,
+        overlap=args.overlap,
+        steps_per_exchange=args.steps_per_exchange,
+        exchange=args.exchange,
+    )
+
+
+def _stage_radius(cfg) -> int:
+    """Fused per-stage stencil radius (tuner ghost depth = 3h): the
+    Pallas O4 layout radius, regardless of the generic path's order."""
+    from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R
+
+    return R
+
+
+def _key_extras(cfg):
+    return [
+        f"order={getattr(cfg, 'order', 4)}",
+        f"geom={getattr(cfg, 'geometry', 'cartesian')}",
+    ]
+
+
+def _cost_kwargs(cfg):
+    return {"order": getattr(cfg, "order", 4)}
+
+
+def _bench_build(grid, dtype, impl, case):
+    return DiffusionConfig(
+        grid=grid, diffusivity=1.0, dtype=dtype, impl=impl
+    )
+
+
+from multigpu_advectiondiffusion_tpu.models.registry import (  # noqa: E402
+    ModelSpec,
+    register_model,
+)
+
+register_model(ModelSpec(
+    name="diffusion",
+    config_cls=DiffusionConfig,
+    solver_cls=DiffusionSolver,
+    description="heat/diffusion equation u_t = K lap(u) + S(u)",
+    check_error=True,
+    sweep_aliases={"K": "diffusivity"},
+    cli_configure=_cli_configure,
+    cli_build=_cli_build,
+    stage_radius=_stage_radius,
+    key_extras=_key_extras,
+    cost_kwargs=_cost_kwargs,
+    bench_build=_bench_build,
+))

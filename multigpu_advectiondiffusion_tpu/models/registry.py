@@ -1,0 +1,236 @@
+"""Solver-plugin registry: a PDE family is a declarative descriptor.
+
+After fourteen rounds every subsystem — the dispatch ladder, the
+measured tuner, the supervisor, telemetry, diagnostics, the static
+halo/collective verifiers, the ensemble engine, the scheduler — was
+wired through exactly two hard-coded models. The PALABOS multi-GPU port
+(PAPERS.md, arXiv 2506.09242) and the TPU CFD framework paper (arXiv
+2108.11076) both land on the same architecture: once halo exchange,
+stepping and sharding are a reusable skeleton, new physics is a
+kernel-sized plugin. This module is that step: a solver family
+registers ONE :class:`ModelSpec` naming its config/solver classes plus
+the hooks every generic subsystem needs —
+
+* the CLI (``cli/__main__.py``) builds its ``<name>{1,2,3}d``
+  subcommands and resolves ``--model NAME`` from the registry;
+* the measured tuner (``tuning/autotuner.py``) derives its cache-key
+  extras and fused ghost depth from ``key_extras``/``stage_radius``;
+* the cost model (``telemetry/costmodel.py``) resolves the family kind
+  and per-step FLOP kwargs through ``spec_for_config``/``cost_kwargs``;
+* the bench matrix (``bench/matrix.py``) constructs case configs via
+  ``bench_build``; ``bench/scaling.py`` resolves run names via
+  :func:`solver_for_run_name`;
+* the static halo verifier (``analysis/halo_verify.py``) iterates
+  registered family names — a registered family with no combo battery
+  is a coverage FAILURE, not a silent gap.
+
+The *registration contract* finishes what PR 8–11 started: the
+queryable per-solver methods those rounds introduced ad hoc are now
+REQUIRED of every registered solver class — declared in the class's own
+body, enforced twice:
+
+=====================  ==================================================
+``stencil_spec()``     family stencil metadata: per-stage radius = the
+                       max of the advective and diffusive tap reaches
+                       (feeds the tuner's fused ghost depth and the
+                       static halo verifier)
+``diagnostics_spec()`` in-situ physics observables/rules/meta fused
+                       into the sentinel's jitted probe (PR 8)
+``ensemble_operands()`` member-varying traced scalars of the batched
+                       ensemble engine (PR 9)
+``cfl_rule()``         the family's time-step rule, queryable (kind,
+                       dt/cfl/safety) — what a checkpoint resumes under
+=====================  ==================================================
+
+once at :func:`register_model` (runtime — a half-wired plugin fails at
+import, before any dispatch), and once statically by the
+``registry-completeness`` lint rule (``analysis/rules.py``,
+``tpucfd-check``/``out/lint_gate.sh``), which proves the declaration in
+the registering module's AST without executing it.
+
+Built-in families self-register at the bottom of their modules
+(``models/diffusion.py``, ``models/burgers.py``, ``models/adr.py`` —
+the title workload); :func:`_ensure_builtins` imports them lazily so
+``import registry`` alone never drags jax in a direction the caller
+didn't ask for, and so registration order cannot depend on which model
+a user imported first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+#: contract methods every registered solver class must DECLARE in its
+#: own body (not merely inherit): the ad-hoc queryable methods of
+#: PR 8–11 promoted to the registration contract. Checked at
+#: register_model() time AND statically by the registry-completeness
+#: lint rule (analysis/rules.py).
+REQUIRED_SOLVER_CONTRACT = (
+    "stencil_spec",
+    "diagnostics_spec",
+    "ensemble_operands",
+    "cfl_rule",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """One solver family's declarative descriptor.
+
+    ``cli_configure(parser, ndim, **extra)`` adds the family's flags to
+    a generated ``<name><ndim>d`` subcommand; ``cli_build(args, grid,
+    ndim, **extra)`` turns parsed args into the family config (the ONE
+    place CLI flags meet the config dataclass, so ``--model`` and the
+    subcommands cannot diverge). ``stage_radius(cfg)`` is the fused
+    per-stage stencil radius h (the tuner's ghost depth is ``3h``);
+    ``key_extras(cfg)`` the family-specific tuning-cache key parts;
+    ``cost_kwargs(cfg)`` the kwargs ``telemetry.costmodel.step_cost``
+    prices the family with; ``bench_build(grid, dtype, impl, case)``
+    the bench-matrix config constructor."""
+
+    name: str
+    config_cls: type
+    solver_cls: type
+    description: str
+    kind: Optional[str] = None  # cost-model family key; defaults to name
+    cli_dims: Tuple[int, ...] = (1, 2, 3)
+    check_error: bool = False  # solver has an analytic error_norms
+    sweep_aliases: Mapping[str, str] = dataclasses.field(
+        default_factory=dict
+    )
+    cli_configure: Optional[Callable] = None
+    cli_build: Optional[Callable] = None
+    stage_radius: Optional[Callable] = None
+    key_extras: Optional[Callable] = None
+    cost_kwargs: Optional[Callable] = None
+    bench_build: Optional[Callable] = None
+
+    @property
+    def family_kind(self) -> str:
+        return self.kind or self.name
+
+
+_REGISTRY: Dict[str, ModelSpec] = {}
+_BUILTINS_LOADED = False
+
+
+def register_model(spec: ModelSpec) -> ModelSpec:
+    """Register one family. The registration contract is enforced HERE
+    (the runtime half; the ``registry-completeness`` lint rule is the
+    static half): a solver class missing any required contract method
+    in its own body fails at import, not at dispatch."""
+    missing = [
+        m for m in REQUIRED_SOLVER_CONTRACT
+        if m not in vars(spec.solver_cls)
+    ]
+    if missing:
+        raise ValueError(
+            f"solver {spec.solver_cls.__name__} cannot register as "
+            f"{spec.name!r}: contract method(s) {missing} are not "
+            "declared in the class body (REQUIRED_SOLVER_CONTRACT — "
+            "a half-wired plugin must fail at registration, not at "
+            "dispatch)"
+        )
+    if spec.name in _REGISTRY and _REGISTRY[spec.name] is not spec:
+        existing = _REGISTRY[spec.name]
+        if (
+            existing.solver_cls.__name__ != spec.solver_cls.__name__
+            or existing.config_cls.__name__ != spec.config_cls.__name__
+        ):
+            raise ValueError(
+                f"model name {spec.name!r} already registered for "
+                f"{existing.solver_cls.__name__}"
+            )
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def _ensure_builtins() -> None:
+    """Import the built-in family modules (idempotent): each registers
+    itself at its module bottom, so lookups see the same registry no
+    matter which model was imported first."""
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    _BUILTINS_LOADED = True
+    from multigpu_advectiondiffusion_tpu.models import (  # noqa: F401
+        adr,
+        burgers,
+        diffusion,
+    )
+
+
+def names() -> Tuple[str, ...]:
+    """Registered family names, registration order."""
+    _ensure_builtins()
+    return tuple(_REGISTRY)
+
+
+def specs() -> Tuple[ModelSpec, ...]:
+    _ensure_builtins()
+    return tuple(_REGISTRY.values())
+
+
+def get(name: str) -> ModelSpec:
+    _ensure_builtins()
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(
+            f"unknown model {name!r}; registered models: {list(_REGISTRY)}"
+        )
+    return spec
+
+
+def spec_for_config(cfg) -> Optional[ModelSpec]:
+    """The spec whose config class ``cfg`` is an instance of (exact
+    class first, then subclasses); ``None`` for unregistered configs —
+    callers keep their duck-typed fallbacks for ad-hoc test doubles."""
+    _ensure_builtins()
+    cls = type(cfg)
+    for spec in _REGISTRY.values():
+        if spec.config_cls is cls:
+            return spec
+    for spec in _REGISTRY.values():
+        try:
+            if isinstance(cfg, spec.config_cls):
+                return spec
+        except TypeError:
+            continue
+    return None
+
+
+def family_of_run_name(run_name: str) -> Optional[str]:
+    """Longest registered family name prefixing ``run_name`` (bench
+    metrics and CLI run names follow the ``<family><ndim>d...``
+    convention) — the replacement for the scattered
+    ``name.startswith("diffusion")`` literals."""
+    _ensure_builtins()
+    best = None
+    for name in _REGISTRY:
+        if run_name.startswith(name) and (
+            best is None or len(name) > len(best)
+        ):
+            best = name
+    return best
+
+
+def solver_for_run_name(run_name: str) -> type:
+    fam = family_of_run_name(run_name)
+    if fam is None:
+        raise KeyError(
+            f"run name {run_name!r} matches no registered model family "
+            f"({list(_REGISTRY)})"
+        )
+    return _REGISTRY[fam].solver_cls
+
+
+def resolve_bc(args, default):
+    """Shared CLI ``--bc`` resolution (one value or one per axis,
+    reversed to array order) — lives registry-side so model modules'
+    ``cli_build`` hooks can use it without importing the CLI package
+    (which imports them)."""
+    bc = getattr(args, "bc", None)
+    if not bc:
+        return default
+    return bc[0] if len(bc) == 1 else tuple(reversed(bc))

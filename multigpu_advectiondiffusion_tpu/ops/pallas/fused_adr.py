@@ -1,0 +1,427 @@
+"""Fully-fused SSP-RK3 advection–diffusion–reaction stepping (3-D).
+
+The per-stage rung of the title family (``models/adr.py``): each RK
+stage is ONE Pallas kernel over the persistent padded state, the same
+minimum-HBM-traffic choreography as :mod:`fused_diffusion` (slab DMA +
+2-slot double buffering; ``T1 = stage1(S)``, ``T2 = stage2(T1, S)``,
+``S' = stage3(T2, S) -> S`` in place), with the ADR right-hand side
+evaluated in VMEM per slab:
+
+* 13-point O4 Laplacian taps (z via slab rows, y/x via masked circular
+  shifts) — the *un-scaled* tap sum, so the spatially varying
+  coefficient can multiply it;
+* **K(x)** computed IN-KERNEL from global cell indices:
+  ``K(x) = K0 * (1 + eps * cos(pi ẑ) cos(pi ŷ) cos(pi x̂))`` with
+  ``x̂ = g/(n-1) - 1/2`` — no second HBM operand, and under a mesh the
+  same global-offsets SMEM operand that feeds the wall masks feeds the
+  coefficient, so a shard computes exactly its window of the global
+  field (``models/adr.py kappa_profile`` is the ONE other definition of
+  this formula; tests hold the two together);
+* first-order **upwind** advective divergence at constant velocity
+  (radius 1, inside the existing R=2 ghost ring):
+  ``a⁺(u_i - u_{i-1})/dx + a⁻(u_{i+1} - u_i)/dx`` per axis — the
+  monotone flux the generic rung's ``advect="upwind"`` mode matches
+  term-for-term (WENO5 advection rides the generic rung);
+* linear-decay reaction ``-lambda * u`` folded into the stage.
+
+Reference-parity walls are the diffusion kernel's discipline verbatim:
+RHS zeroed on the global boundary band, Dirichlet faces re-imposed,
+masks in *global* indices so a sharded run reproduces the single-device
+solution. Sharded mode runs the stages shard-local under ``shard_map``
+with the per-stage ``ppermute`` ghost refresh
+(``parallel.halo.make_ghost_refresh``) — the ADR family inherits the
+mesh skeleton, it does not reimplement it.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+    _STAGES,
+    _shift,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import (
+    LANE,
+    O4_COEFFS,
+    R,
+    SUBLANE,
+    VMEM_LIMIT,
+    _aligned_row_bytes_3d,
+    compiler_params,
+    interpret_mode,
+    pick_block,
+    round_up,
+)
+from multigpu_advectiondiffusion_tpu.ops.pallas.stepper_base import (
+    FusedStepperBase,
+)
+
+
+def _stage_kernel(
+    dt_ref,
+    v_hbm,
+    u_hbm,
+    out_hbm,
+    vs,
+    us,
+    res,
+    sem_v,
+    sem_u,
+    sem_w,
+    *,
+    bz: int,
+    n_blocks: int,
+    global_shape: Sequence[int],
+    offs_ref=None,
+    lap_scales: Sequence[float],
+    adv_p: Sequence[float],
+    adv_m: Sequence[float],
+    lam: float,
+    k0: float,
+    k_eps: float,
+    a: float,
+    b: float,
+    band: int,
+    bc_value: float,
+):
+    """One z-block of one ADR RK stage, 2-slot double-buffered (the
+    :mod:`fused_diffusion` prefetch/defer choreography: block ``k``
+    prefetches ``k+1`` while computing, drains its output DMA at
+    ``k+2``)."""
+    nz, ny, nx = global_shape
+    k = pl.program_id(0)
+    slot = lax.rem(k, jnp.asarray(2, k.dtype))
+    nslot = lax.rem(k + 1, jnp.asarray(2, k.dtype))
+
+    def copy_v(j, s):
+        return pltpu.make_async_copy(
+            v_hbm.at[pl.ds(j * bz, bz + 2 * R)], vs.at[s], sem_v.at[s]
+        )
+
+    def copy_u(j, s):
+        # the in-place final stage reads its u rows from the aliased
+        # output buffer, strictly before the overwriting DMA
+        src = u_hbm if u_hbm is not None else out_hbm
+        return pltpu.make_async_copy(
+            src.at[pl.ds(R + j * bz, bz)], us.at[s], sem_u.at[s]
+        )
+
+    def copy_w(j, s):
+        return pltpu.make_async_copy(
+            res.at[s], out_hbm.at[pl.ds(R + j * bz, bz)], sem_w.at[s]
+        )
+
+    @pl.when(k == 0)
+    def _():
+        copy_v(0, 0).start()
+        if us is not None:
+            copy_u(0, 0).start()
+
+    @pl.when(k + 1 < n_blocks)
+    def _():
+        copy_v(k + 1, nslot).start()
+        if us is not None:
+            copy_u(k + 1, nslot).start()
+
+    if us is not None:
+        copy_u(k, slot).wait()
+    copy_v(k, slot).wait()
+
+    v = vs[slot]
+    vc = v[R : R + bz]  # stage input, core z-rows, full y/x width
+    dtype = v.dtype
+    dt = dt_ref[0].astype(dtype)
+
+    # un-scaled O4 Laplacian tap sum per axis (1/(12 dx^2) folded into
+    # the tap coefficient; K(x) multiplies the summed result below)
+    lap = None
+    for axis in range(3):
+        for j, c in enumerate(O4_COEFFS):
+            coef = jnp.asarray(c * lap_scales[axis], dtype)
+            term = (
+                v[j : j + bz] if axis == 0 else _shift(vc, j - R, axis)
+            ) * coef
+            lap = term if lap is None else lap + term
+
+    # first-order upwind advective divergence (radius 1 < R: the ±1
+    # neighbors are always inside the refreshed ghost ring; y/x
+    # wraparound lands in masked ghost columns like the Laplacian's)
+    adv = None
+    for axis in range(3):
+        cp, cm = adv_p[axis], adv_m[axis]
+        if cp == 0.0 and cm == 0.0:
+            continue
+        lo = v[R - 1 : R - 1 + bz] if axis == 0 else _shift(vc, -1, axis)
+        hi = v[R + 1 : R + 1 + bz] if axis == 0 else _shift(vc, 1, axis)
+        term = jnp.asarray(cp, dtype) * (vc - lo) + jnp.asarray(
+            cm, dtype
+        ) * (hi - vc)
+        adv = term if adv is None else adv + term
+
+    # global interior-cell indices (sharded: offsets from SMEM — the
+    # same operand serves the wall masks AND the K(x) coefficient)
+    shp = vc.shape
+    oz, oy, ox = (
+        (offs_ref[0], offs_ref[1], offs_ref[2])
+        if offs_ref is not None
+        else (0, 0, 0)
+    )
+    gz = lax.broadcasted_iota(jnp.int32, shp, 0) + k * bz + oz
+    gy = lax.broadcasted_iota(jnp.int32, shp, 1) - R + oy
+    gx = lax.broadcasted_iota(jnp.int32, shp, 2) - R + ox
+
+    if k_eps:
+        pi = jnp.asarray(math.pi, dtype)
+
+        def chat(g, n):
+            return jnp.cos(pi * (g.astype(dtype) / (n - 1) - 0.5))
+
+        kf = jnp.asarray(k0, dtype) * (
+            1.0
+            + jnp.asarray(k_eps, dtype)
+            * chat(gz, nz) * chat(gy, ny) * chat(gx, nx)
+        )
+        rhs = kf * lap
+    else:
+        rhs = jnp.asarray(k0, dtype) * lap
+    if adv is not None:
+        rhs = rhs - adv
+    if lam:
+        rhs = rhs - jnp.asarray(lam, dtype) * vc
+
+    u_in = None if us is None else us[slot]
+    rk = (
+        b * (vc + dt * rhs)
+        if a == 0.0
+        else a * u_in + b * (vc + dt * rhs)
+    )
+
+    def between(g, n):
+        return (g >= band) & (g < n - band)
+
+    interior = between(gz, nz) & between(gy, ny) & between(gx, nx)
+    face = (
+        (gz == 0) | (gz == nz - 1)
+        | (gy == 0) | (gy == ny - 1)
+        | (gx == 0) | (gx == nx - 1)
+    )
+    frozen = jnp.where(face, jnp.asarray(bc_value, dtype), vc)
+
+    @pl.when(k >= 2)
+    def _():
+        copy_w(k - 2, slot).wait()
+
+    res[slot] = jnp.where(interior, rk, frozen)
+    copy_w(k, slot).start()
+
+    @pl.when(k == n_blocks - 1)
+    def _():
+        copy_w(k, slot).wait()
+        if n_blocks >= 2:
+            copy_w(k - 1, nslot).wait()
+
+
+def _make_stage(padded_shape, interior_shape, dtype, *, bz, a, b,
+                u_source, sharded=False, global_shape=None, **phys):
+    """Build one fused ADR RK-stage call; output aliased onto the last
+    operand (``u_source`` as in :mod:`fused_diffusion`: "none" /
+    "operand" / "target")."""
+    trailing = padded_shape[1:]
+    use_u = u_source != "none"
+    n_blocks = (padded_shape[0] - 2 * R) // bz
+
+    kern = functools.partial(
+        _stage_kernel,
+        bz=bz,
+        n_blocks=n_blocks,
+        global_shape=tuple(global_shape or interior_shape),
+        a=a,
+        b=b,
+        **phys,
+    )
+
+    def kernel(*refs):
+        dt_ref, *refs = refs
+        offs_ref = None
+        if sharded:
+            offs_ref, *refs = refs
+        if u_source == "operand":
+            v_hbm, u_hbm, *refs = refs
+        else:
+            v_hbm, *refs = refs
+            u_hbm = None  # "target": read from out_hbm
+        _tgt, out_hbm, vs, *refs = refs
+        if use_u:
+            us, *refs = refs
+        else:
+            us = None
+        res, sem_v, *refs = refs
+        if use_u:
+            sem_u, *refs = refs
+        else:
+            sem_u = None
+        (sem_w,) = refs
+        kern(dt_ref, v_hbm, u_hbm, out_hbm, vs, us, res,
+             sem_v, sem_u, sem_w, offs_ref=offs_ref)
+
+    n_in = (
+        1  # dt
+        + (1 if sharded else 0)
+        + (2 if u_source == "operand" else 1)
+        + 1  # aliased target
+    )
+    scratch = [pltpu.VMEM((2, bz + 2 * R) + trailing, dtype)]
+    if use_u:
+        scratch.append(pltpu.VMEM((2, bz) + trailing, dtype))
+    scratch.append(pltpu.VMEM((2, bz) + trailing, dtype))
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
+    if use_u:
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
+
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]  # dt
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_in - 1)
+    if sharded:
+        in_specs[1] = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(tuple(padded_shape), dtype),
+        scratch_shapes=scratch,
+        input_output_aliases={n_in - 1: 0},  # last operand -> out
+        compiler_params=None if interpret_mode() else compiler_params(),
+        interpret=interpret_mode(),
+    )
+
+
+class FusedADRStepper(FusedStepperBase):
+    """Jit-cached fused per-stage runner for one ADR configuration.
+
+    ``global_shape`` != ``interior_shape`` switches to shard-local mode
+    (global wall masks and the in-kernel K(x) coefficient take this
+    shard's offsets from a runtime SMEM operand; :meth:`run` accepts
+    the per-stage ghost ``refresh``) — the tuned kernel under the mesh,
+    exactly the :class:`~.fused_diffusion.FusedDiffusionStepper`
+    contract, so the ADR family rides the existing sharded dispatch
+    unmodified. No split-overlap / whole-step / slab variants: ADR
+    ships the per-stage rung only (``models/adr.py`` declines the
+    others loudly)."""
+
+    halo = R
+    stencil_radius = R  # max(advective upwind 1, diffusive O4 2)
+    needs_offsets = True
+    overlap_split = False
+
+    def __init__(self, interior_shape, dtype, spacing, diffusivity,
+                 velocity, reaction, dt, band, bc_value,
+                 kappa_variation: float = 0.0, block_z=None,
+                 global_shape=None):
+        nz, ny, nx = interior_shape
+        self.interior_shape = tuple(interior_shape)
+        self.global_shape = tuple(global_shape or interior_shape)
+        self.sharded = self.global_shape != self.interior_shape
+        self.dtype = jnp.dtype(dtype)
+        self.bc_value = float(bc_value)
+        if len(tuple(velocity)) != 3:
+            raise ValueError(
+                f"fused ADR wants a 3-vector velocity, got {velocity!r}"
+            )
+        row_bytes = _aligned_row_bytes_3d((nz, ny, nx),
+                                          self.dtype.itemsize)
+        # same VMEM budget model as the fused diffusion stepper (the
+        # slab buffers are identical; the extra ADR arithmetic is
+        # register-resident)
+        budget_rows = max(
+            1, min(20, int((VMEM_LIMIT // row_bytes - 56) // 9))
+        )
+        if block_z is None:
+            if self.sharded:
+                block_z = pick_block(nz, budget_rows)
+            else:
+                def score(bz):
+                    blocks = -(-nz // bz)
+                    return (bz / (bz + 2 * R)) * (nz / (blocks * bz))
+
+                block_z = max(range(1, budget_rows + 1), key=score)
+        elif self.sharded and nz % block_z != 0:
+            raise ValueError(
+                f"block_z={block_z} must divide local nz={nz} when "
+                "sharded (dead rows inside the exchanged core would "
+                "corrupt neighbor ghosts)"
+            )
+        bz = block_z
+        nz_eff = -(-nz // bz) * bz
+        sub = SUBLANE * max(1, 4 // self.dtype.itemsize)
+        self.padded_shape = (
+            nz_eff + 2 * R,
+            round_up(ny + 2 * R, sub),
+            round_up(nx + 2 * R, LANE),
+        )
+        self.core_offsets = (R, R, R)
+        self.dt = float(dt)
+
+        phys = {
+            "lap_scales": tuple(
+                1.0 / (12.0 * dx * dx) for dx in spacing
+            ),
+            "adv_p": tuple(
+                max(float(v), 0.0) / dx
+                for v, dx in zip(velocity, spacing)
+            ),
+            "adv_m": tuple(
+                min(float(v), 0.0) / dx
+                for v, dx in zip(velocity, spacing)
+            ),
+            "lam": float(reaction),
+            "k0": float(diffusivity),
+            "k_eps": float(kappa_variation),
+            "band": int(band),
+            "bc_value": float(bc_value),
+        }
+        sources = ("none", "operand", "target")
+        s1, s2, s3 = (
+            _make_stage(
+                self.padded_shape, self.interior_shape, self.dtype,
+                bz=bz, a=a, b=b, u_source=src,
+                sharded=self.sharded, global_shape=self.global_shape,
+                **phys,
+            )
+            for (a, b), src in zip(_STAGES, sources)
+        )
+
+        def step(S, T1, T2, dt_arr, offsets=None, refresh=None,
+                 exch=None):
+            del exch  # no split-overlap schedule on this rung
+            pre = (dt_arr,) if offsets is None else (dt_arr, offsets)
+            fix = refresh if refresh is not None else (lambda P: P)
+            T1 = fix(s1(*pre, S, T1))      # u1 = u + dt RHS(u)
+            T2 = fix(s2(*pre, T1, S, T2))  # 3/4 u + 1/4 (u1 + dt RHS)
+            S = fix(s3(*pre, T2, S))       # 1/3 u + 2/3 (u2 + dt RHS)
+            return S, T1, T2               # in place
+
+        self._step = step
+
+    def embed(self, u):
+        full = jnp.full(self.padded_shape, self.bc_value, self.dtype)
+        return lax.dynamic_update_slice(
+            full, u.astype(self.dtype), (R, R, R)
+        )
+
+    def extract(self, S):
+        nz, ny, nx = self.interior_shape
+        return lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
+
+    def _dt_value(self, S):
+        return jnp.asarray(self.dt, jnp.float32)

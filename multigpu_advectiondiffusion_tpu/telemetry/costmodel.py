@@ -72,6 +72,17 @@ DIFFUSION_AXIS_FLOPS = {2: 4, 4: 7}
 # per-axis FLOPs of the WENO flux-divergence sweep by order
 WENO_AXIS_FLOPS = {5: 151, 7: 232}
 RK_COMBINE_FLOPS = 5
+# ADR family (models/adr.py) conventions:
+# * first-order upwind advective term per axis, folded coefficients
+#   ``cp*(u - u_lo) + cm*(u_hi - u)``: 2 sub + 2 mul + 1 add = **5**;
+#   WENO5 linear advection reuses the Burgers sweep count (151/axis).
+# * variable-K coefficient ``K0 (1 + eps prod cos(pi x̂))``: ndim
+#   cos + (ndim-1) muls + axpy + the K*lap multiply, counted as
+#   **3*ndim + 2** (cos = 1 at these conventions — VPU-transcendental,
+#   roofline-irrelevant next to the HBM bound); constant K is the one
+#   K*lap multiply = **1**.
+# * linear-decay reaction ``- lambda u``: mul + sub = **2**.
+ADR_UPWIND_AXIS_FLOPS = 5
 
 # (peak HBM bytes/s, peak FLOP/s) by backend family
 PEAKS = {
@@ -103,6 +114,9 @@ def rhs_flops_per_cell(
     order: int = 4,
     weno_order: int = 5,
     viscous: bool = False,
+    advect: str = "upwind",
+    reaction: bool = False,
+    variable_k: bool = False,
 ) -> float:
     """FLOPs of one RHS evaluation per cell (no RK combine)."""
     if kind == "diffusion":
@@ -112,6 +126,21 @@ def rhs_flops_per_cell(
         if viscous:
             # nu*lap(u) rides the O2 Laplacian plus one axpy per cell
             f += DIFFUSION_AXIS_FLOPS[2] * ndim + (ndim - 1) + 2
+        return float(f)
+    if kind == "adr":
+        # diffusive taps + K multiply (+ the variable-K profile)
+        f = DIFFUSION_AXIS_FLOPS[order] * ndim + (ndim - 1)
+        f += (3 * ndim + 2) if variable_k else 1
+        # advective divergence + cross-axis accumulation + the
+        # RHS-level subtraction
+        adv = (
+            ADR_UPWIND_AXIS_FLOPS
+            if advect == "upwind"
+            else WENO_AXIS_FLOPS[5]
+        )
+        f += adv * ndim + (ndim - 1) + 1
+        if reaction:
+            f += 2  # -lambda u: mul + sub
         return float(f)
     raise ValueError(f"unknown solver kind {kind!r}")
 
@@ -143,12 +172,16 @@ def step_cost(
     order: int = 4,
     weno_order: int = 5,
     viscous: bool = False,
+    advect: str = "upwind",
+    reaction: bool = False,
+    variable_k: bool = False,
 ) -> StepCost:
     cells = math.prod(shape)
     ndim = len(shape)
     per_cell_stage = (
         rhs_flops_per_cell(kind, ndim, order=order, weno_order=weno_order,
-                           viscous=viscous)
+                           viscous=viscous, advect=advect,
+                           reaction=reaction, variable_k=variable_k)
         + RK_COMBINE_FLOPS
     )
     passes = hbm_passes_per_step(stepper, ndim, stages)
@@ -298,12 +331,55 @@ def roofline(
 # Solver-facing conveniences
 # --------------------------------------------------------------------- #
 def solver_kind(cfg) -> Optional[str]:
-    """Duck-typed solver family from its config (no model imports)."""
+    """Solver family from its config: the plugin registry first
+    (``models/registry.spec_for_config`` — the single source for
+    registered families, so a third model never edits this), then the
+    legacy duck-typed fallback for ad-hoc config doubles in tests."""
+    try:
+        from multigpu_advectiondiffusion_tpu.models import registry
+
+        spec = registry.spec_for_config(cfg)
+        if spec is not None:
+            return spec.family_kind
+    except Exception:
+        pass
     if hasattr(cfg, "weno_order"):
         return "burgers"
+    if hasattr(cfg, "velocity"):
+        return "adr"
     if hasattr(cfg, "diffusivity"):
         return "diffusion"
     return None
+
+
+def solver_cost_kwargs(cfg) -> dict:
+    """Per-family ``step_cost`` kwargs, resolved through the registry's
+    ``cost_kwargs`` hook (legacy literal fallback for unregistered
+    configs)."""
+    try:
+        from multigpu_advectiondiffusion_tpu.models import registry
+
+        spec = registry.spec_for_config(cfg)
+        if spec is not None and spec.cost_kwargs is not None:
+            return dict(spec.cost_kwargs(cfg))
+    except Exception:
+        pass
+    kind = solver_kind(cfg)
+    if kind == "diffusion":
+        return {"order": getattr(cfg, "order", 4)}
+    if kind == "burgers":
+        return {
+            "weno_order": getattr(cfg, "weno_order", 5),
+            "viscous": bool(getattr(cfg, "nu", 0.0)),
+        }
+    if kind == "adr":
+        return {
+            "order": getattr(cfg, "order", 4),
+            "advect": getattr(cfg, "advect", "upwind"),
+            "reaction": bool(getattr(cfg, "reaction_rate", 0.0)),
+            "variable_k": bool(getattr(cfg, "kappa_variation", 0.0)),
+        }
+    return {}
 
 
 def solver_step_cost(solver, stepper: str) -> Optional[StepCost]:
@@ -321,20 +397,22 @@ def solver_step_cost(solver, stepper: str) -> Optional[StepCost]:
     kind = solver_kind(cfg)
     if kind is None:
         return None
-    kwargs = {}
-    if kind == "diffusion":
-        kwargs["order"] = getattr(cfg, "order", 4)
-    else:
-        kwargs["weno_order"] = getattr(cfg, "weno_order", 5)
-        kwargs["viscous"] = bool(getattr(cfg, "nu", 0.0))
-    return step_cost(
-        kind,
-        cfg.grid.shape,
-        np.dtype(solver.dtype).itemsize,
-        stepper,
-        stages=STAGES[cfg.integrator],
-        **kwargs,
-    )
+    kwargs = solver_cost_kwargs(cfg)
+    try:
+        return step_cost(
+            kind,
+            cfg.grid.shape,
+            np.dtype(solver.dtype).itemsize,
+            stepper,
+            stages=STAGES[cfg.integrator],
+            **kwargs,
+        )
+    except (KeyError, ValueError):
+        # a registered family without a documented FLOP convention:
+        # runs fine, just publishes no roofline (the model is static
+        # and documented per family — new families opt in by adding
+        # their counts here)
+        return None
 
 
 def summarize_run(

@@ -33,6 +33,10 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     "span": {None: {"phase", "id", "depth"}},
     "counter": {None: {"inc", "total"}},
     "dispatch": {"build": {"key", "impl"}},
+    # solver-plugin registry (models/registry.py, ISSUE 15): CLI
+    # --model resolution through the registry — one event per resolved
+    # run naming the family and the generated subcommand
+    "model": {"resolve": {"model", "ndim", "command"}},
     "ladder": {"degrade": {"from", "to", "reason"}},
     "physics": {"probe": {"step", "time"}},
     # in-situ physics diagnostics (diagnostics/physics.py via the

@@ -50,7 +50,14 @@ def _emit(name: str, **fields) -> None:
 
 
 def _fused_halo(kind: str, cfg) -> int:
-    """Per-step fused ghost depth G = 3h of the config's stencil."""
+    """Per-step fused ghost depth G = 3h of the config's stencil,
+    resolved through the registry's ``stage_radius`` hook (legacy
+    literal fallback for unregistered config doubles)."""
+    from multigpu_advectiondiffusion_tpu.models import registry
+
+    spec = registry.spec_for_config(cfg)
+    if spec is not None and spec.stage_radius is not None:
+        return 3 * int(spec.stage_radius(cfg))
     if kind == "diffusion":
         from multigpu_advectiondiffusion_tpu.ops.pallas.laplacian import R
 
@@ -96,7 +103,14 @@ def make_key(solver_cls, cfg, mesh, decomp, backend: str,
         f"backend={backend}",
         f"ens={max(1, int(ensemble))}",
     ]
-    if kind == "burgers":
+    from multigpu_advectiondiffusion_tpu.models import registry
+
+    spec = registry.spec_for_config(cfg)
+    if spec is not None and spec.key_extras is not None:
+        # family-specific key parts come from the registration spec —
+        # a third model brings its own, never edits this function
+        parts += [str(p) for p in spec.key_extras(cfg)]
+    elif kind == "burgers":
         parts += [
             f"weno={cfg.weno_order}-{cfg.weno_variant}",
             f"adaptive={bool(cfg.adaptive_dt)}",
@@ -221,12 +235,7 @@ def modeled_step_seconds(cfg, lshape, cand, devices: int,
     }.get(cand["impl"])
     if stepper is None:
         return None
-    kwargs = {}
-    if kind == "diffusion":
-        kwargs["order"] = getattr(cfg, "order", 4)
-    else:
-        kwargs["weno_order"] = getattr(cfg, "weno_order", 5)
-        kwargs["viscous"] = bool(getattr(cfg, "nu", 0.0))
+    kwargs = costmodel.solver_cost_kwargs(cfg)
     itemsize = np.dtype(cfg.dtype).itemsize
     cost = costmodel.step_cost(kind, lshape, itemsize, stepper, **kwargs)
     peak_b, peak_f = costmodel.peak_rates(backend)

@@ -5,12 +5,20 @@
 #   1. clean-tree pass — tpucfd-check must exit 0 on the shipped
 #      package: every AST lint rule silent (closure constants, host
 #      syncs in traced code, non-atomic artifact writes, unregistered
-#      telemetry emissions, rank-divergent collectives/effects), the
-#      stencil/halo verifier proving every admitted (rung, order, k)
-#      combination, and the collective-schedule verifier proving the
-#      distributed layer rank-uniform (unique rendezvous tags, no
-#      divergent joins, declared-tag drift, sharding-case registry);
-#   2. --selftest — every rule must TRIP on its seeded violation
+#      telemetry emissions, rank-divergent collectives/effects, and
+#      registry completeness — every register_model()'d solver class
+#      declares the full stencil_spec/diagnostics_spec/
+#      ensemble_operands/cfl_rule plugin contract), the stencil/halo
+#      verifier proving every admitted (rung, order, k) combination
+#      for every REGISTERED family (a registered family with no combo
+#      battery, or a battery whose size drifts from the expected
+#      matrix count, is a coverage violation), and the
+#      collective-schedule verifier proving the distributed layer
+#      rank-uniform (unique rendezvous tags, no divergent joins,
+#      declared-tag drift, sharding-case registry);
+#   2. --selftest — every rule (incl. registry-completeness, whose
+#      seeded bad fixture registers a half-wired ToySolver) must TRIP
+#      on its seeded violation
 #      fixture (and pass the clean twin), the halo verifier must fail
 #      an injected off-by-one ghost depth naming kernel/axis/depth
 #      AND an injected overlapping remote-DMA recv window (a neighbor

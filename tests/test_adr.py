@@ -1,0 +1,497 @@
+"""ISSUE 15: solver-plugin registry + the ADR title workload.
+
+Holds the tentpole and its satellites together:
+
+* the registry's names/contract enforcement and the derived exports;
+* the analytic advecting–decaying Gaussian on BOTH rungs (generic f64
+  WENO5, fused-stage f32 upwind) within tolerance;
+* fused-vs-generic and sharded-vs-single rung equivalence;
+* ensemble B>1 bit-equality of the batched dispatch vs looped singles;
+* the max-principle/positivity diagnostics contract;
+* the registry-resolved halo combo matrix (ADR rungs + expected
+  per-family counts; a missing family is a coverage violation);
+* CLI ``--model adr`` resolution;
+* cost-model/tuner-key coverage for the new family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multigpu_advectiondiffusion_tpu import (
+    ADRConfig,
+    ADRSolver,
+    Grid,
+)
+from multigpu_advectiondiffusion_tpu.models import registry
+from multigpu_advectiondiffusion_tpu.models.adr import kappa_profile
+from multigpu_advectiondiffusion_tpu.models.state import SolverState
+from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+    Decomposition,
+    make_mesh,
+)
+
+
+def _cfg(**kw):
+    grid = kw.pop("grid", None) or Grid.make(
+        *kw.pop("n", (12, 10, 8)), lengths=10.0
+    )
+    base = dict(velocity=(0.5, 0.25, 0.125)[: grid.ndim]
+                if grid.ndim > 1 else 0.5,
+                reaction_rate=0.3, dtype="float32")
+    base.update(kw)
+    return ADRConfig(grid=grid, **base)
+
+
+# --------------------------------------------------------------------- #
+# Registry (tentpole)
+# --------------------------------------------------------------------- #
+def test_registry_names_and_specs():
+    names = registry.names()
+    assert {"diffusion", "burgers", "adr"} <= set(names)
+    spec = registry.get("adr")
+    assert spec.solver_cls is ADRSolver
+    assert spec.config_cls is ADRConfig
+    cfg = _cfg()
+    assert registry.spec_for_config(cfg).name == "adr"
+    assert registry.family_of_run_name("adr3d_mlups") == "adr"
+    assert registry.solver_for_run_name("diffusion3d") is registry.get(
+        "diffusion"
+    ).solver_cls
+    with pytest.raises(KeyError):
+        registry.get("lattice_boltzmann")
+
+
+def test_register_model_rejects_half_wired_plugin():
+    class ToyConfig:
+        pass
+
+    class ToySolver:
+        def stencil_spec(self):
+            return {}
+
+        def diagnostics_spec(self):
+            return {}
+
+        # ensemble_operands and cfl_rule missing
+
+    with pytest.raises(ValueError, match="cfl_rule"):
+        registry.register_model(registry.ModelSpec(
+            name="toy-halfwired", config_cls=ToyConfig,
+            solver_cls=ToySolver, description="incomplete",
+        ))
+    assert "toy-halfwired" not in registry.names()
+
+
+def test_registry_completeness_lint_rule_registered():
+    from multigpu_advectiondiffusion_tpu.analysis import all_rules
+    from multigpu_advectiondiffusion_tpu.analysis.fixtures import (
+        RULE_FIXTURES,
+    )
+
+    assert "registry-completeness" in all_rules()
+    assert "registry-completeness" in RULE_FIXTURES
+
+
+def test_exports_derive_from_registry():
+    import multigpu_advectiondiffusion_tpu as pkg
+    from multigpu_advectiondiffusion_tpu import models
+
+    for name in ("ADRConfig", "ADRSolver", "DiffusionSolver",
+                 "BurgersSolver"):
+        assert name in pkg.__all__
+        assert name in models.__all__
+        assert getattr(models, name) is getattr(pkg, name)
+
+
+def test_contract_methods_answer_on_every_family():
+    diff = registry.get("diffusion")
+    burg = registry.get("burgers")
+    g3 = Grid.make(10, 8, 6, lengths=2.0)
+    solvers = [
+        diff.solver_cls(diff.config_cls(grid=g3)),
+        burg.solver_cls(burg.config_cls(grid=g3)),
+        ADRSolver(_cfg(grid=g3)),
+    ]
+    for s in solvers:
+        spec = s.stencil_spec()
+        assert spec["stage_radius"] >= 1
+        rule = s.cfl_rule()
+        assert rule["kind"]
+        assert isinstance(s.ensemble_operands(), dict)
+        assert isinstance(s.diagnostics_spec(), dict)
+
+
+# --------------------------------------------------------------------- #
+# Physics: analytic accuracy on both rungs (satellite 3)
+# --------------------------------------------------------------------- #
+def test_adr_ic_matches_exact_at_t0():
+    s = ADRSolver(_cfg(n=(16, 12, 12), reaction_rate=0.5))
+    st = s.initial_state()
+    exact = s.exact_solution(s.cfg.t0)
+    np.testing.assert_allclose(
+        np.asarray(st.u), np.asarray(exact), atol=1e-6
+    )
+
+
+def test_adr_analytic_gaussian_generic_weno5_f64():
+    g = Grid.make(48, 32, 32, lengths=10.0)
+    cfg = ADRConfig(grid=g, velocity=(0.6, 0.3, 0.15),
+                    reaction_rate=0.5, advect="weno5", dtype="float64")
+    s = ADRSolver(cfg)
+    out = s.advance_to(s.initial_state(), 0.18)
+    n = s.error_norms(out)
+    # measured linf ~1.6e-3 on this grid (peak amplitude ~0.38)
+    assert n.linf < 5e-3, n
+    assert n.l2 < 4e-3, n
+
+
+def test_adr_analytic_gaussian_fused_stage_f32():
+    g = Grid.make(48, 32, 32, lengths=10.0)
+    cfg = ADRConfig(grid=g, velocity=(0.6, 0.3, 0.15),
+                    reaction_rate=0.5, advect="upwind",
+                    dtype="float32", impl="pallas")
+    s = ADRSolver(cfg)
+    assert s.engaged_path()["stepper"] == "fused-stage"
+    out = s.advance_to(s.initial_state(), 0.18)
+    n = s.error_norms(out)
+    # first-order upwind smears: measured linf ~9.3e-3 on this grid
+    assert n.linf < 2.5e-2, n
+
+
+def test_adr_fused_matches_generic_upwind():
+    cfg = _cfg(n=(16, 12, 12), kappa_variation=0.2)
+    sx = ADRSolver(dataclasses.replace(cfg, impl="xla"))
+    sp = ADRSolver(dataclasses.replace(cfg, impl="pallas_stage"))
+    assert sp.engaged_path()["stepper"] == "fused-stage"
+    ox = sx.run(sx.initial_state(), 4)
+    op = sp.run(sp.initial_state(), 4)
+    np.testing.assert_allclose(
+        np.asarray(ox.u), np.asarray(op.u), atol=5e-7
+    )
+
+
+def test_adr_weno5_declines_fusion_loudly():
+    s = ADRSolver(_cfg(advect="weno5", impl="pallas"))
+    eng = s.engaged_path()
+    # fusion declined (the Laplacian still rides the per-axis rung);
+    # the reason names the baked upwind flux
+    assert eng["stepper"] in ("per-axis-pallas", "generic-xla")
+    assert "upwind" in eng["fallback"]
+
+
+def test_adr_kappa_profile_positive_and_matches_kernel_formula():
+    import math
+
+    shape = (8, 6, 6)
+    prof = kappa_profile(shape, shape, (0, 0, 0), 0.3, jnp.float32)
+    p = np.asarray(prof)
+    assert p.shape == shape
+    assert (p > 0).all()
+    # center cell of an odd-n axis sits at x̂=0 -> cos=1 on that axis
+    want = 1.0 + 0.3 * math.cos(
+        math.pi * (0 / (shape[0] - 1) - 0.5)
+    ) * math.cos(math.pi * (0 / (shape[1] - 1) - 0.5)) * math.cos(
+        math.pi * (0 / (shape[2] - 1) - 0.5)
+    )
+    np.testing.assert_allclose(p[0, 0, 0], want, rtol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# Sharded on a dz mesh (acceptance)
+# --------------------------------------------------------------------- #
+def test_adr_sharded_generic_matches_single_device():
+    cfg = _cfg(n=(16, 12, 12), kappa_variation=0.2)
+    single = ADRSolver(cfg)
+    o1 = single.run(single.initial_state(), 4)
+    mesh = make_mesh({"dz": 2})
+    shard = ADRSolver(cfg, mesh=mesh, decomp=Decomposition.slab("dz"))
+    o2 = shard.run(shard.initial_state(), 4)
+    # roundoff-level: the advective fusion re-associates across
+    # program shapes (models/adr.py docstring)
+    np.testing.assert_allclose(
+        np.asarray(o1.u), np.asarray(o2.u), atol=1e-6, rtol=1e-5
+    )
+
+
+def test_adr_sharded_fused_stage_matches_single_device():
+    cfg = _cfg(n=(16, 12, 12), kappa_variation=0.2, impl="pallas_stage")
+    single = ADRSolver(cfg)
+    o1 = single.run(single.initial_state(), 4)
+    mesh = make_mesh({"dz": 2})
+    shard = ADRSolver(cfg, mesh=mesh, decomp=Decomposition.slab("dz"))
+    assert shard.engaged_path()["stepper"] == "fused-stage"
+    o2 = shard.run(shard.initial_state(), 4)
+    np.testing.assert_allclose(
+        np.asarray(o1.u), np.asarray(o2.u), atol=1e-6
+    )
+
+
+# --------------------------------------------------------------------- #
+# Ensemble (acceptance: B>1 equality grade)
+# --------------------------------------------------------------------- #
+def test_adr_ensemble_batched_matches_looped_bit_exact():
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+
+    cfg = _cfg(n=(10, 8, 8), ic="gaussian")
+    es = EnsembleSolver(
+        ADRSolver, cfg,
+        [{"ic_params": (("width", 0.1 + 0.02 * i),)} for i in range(3)],
+    )
+    est = es.initial_state()
+    out = es.run(est, 3)
+    for i in range(3):
+        single = es.member_solver(i)
+        o = single.run(
+            SolverState(u=est.u[i], t=est.t[i], it=est.it[i]), 3
+        )
+        assert np.array_equal(np.asarray(out.u[i]), np.asarray(o.u)), (
+            f"member {i} diverged from its looped single run"
+        )
+
+
+def test_adr_ensemble_fused_stage_vmap_bit_exact():
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+
+    cfg = _cfg(n=(10, 8, 8), kappa_variation=0.2, ic="gaussian",
+               impl="pallas_stage")
+    es = EnsembleSolver(
+        ADRSolver, cfg,
+        [{"ic_params": (("width", 0.1 + 0.02 * i),)} for i in range(2)],
+    )
+    est = es.initial_state()
+    out = es.run(est, 2)
+    assert es.engaged_path()["stepper"] == "ensemble-vmap[fused-stage]"
+    for i in range(2):
+        single = es.member_solver(i)
+        o = single.run(
+            SolverState(u=est.u[i], t=est.t[i], it=est.it[i]), 2
+        )
+        assert np.array_equal(np.asarray(out.u[i]), np.asarray(o.u))
+
+
+def test_adr_ensemble_member_varying_operands():
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+
+    cfg = _cfg(n=(10, 8, 8))
+    es = EnsembleSolver(
+        ADRSolver, cfg,
+        [{"diffusivity": 0.5}, {"diffusivity": 1.5},
+         {"reaction_rate": 1.0}],
+    )
+    est = es.initial_state()
+    out = es.run(est, 3)
+    u = np.asarray(out.u)
+    assert np.isfinite(u).all()
+    # different K/lambda must produce different trajectories
+    assert not np.array_equal(u[0], u[1])
+    assert not np.array_equal(u[0], u[2])
+
+
+# --------------------------------------------------------------------- #
+# Diagnostics contract (satellite 3)
+# --------------------------------------------------------------------- #
+def test_adr_diagnostics_rules_reaction_free():
+    s = ADRSolver(_cfg(reaction_rate=0.0))
+    rules = {r.name for r in s.diagnostics_spec()["rules"]}
+    assert {"max_principle", "positivity"} <= rules
+    meta = ADRSolver(_cfg(reaction_rate=0.0, velocity=0.25)
+                     ).diagnostics_spec()["meta"]
+    assert meta["decay_rate_analytic"] == -1.5
+
+
+def test_positivity_rule_trips_on_negative_dip():
+    from multigpu_advectiondiffusion_tpu.diagnostics.physics import (
+        positivity_rule,
+    )
+
+    rule = positivity_rule()
+    baseline = {"min": 0.0, "max": 1.0}
+    assert rule.check({"min": -0.1, "max": 1.0}, baseline,
+                      rule.tolerance)
+    assert rule.check({"min": -1e-6, "max": 1.0}, baseline,
+                      rule.tolerance) is None
+    # signed initial data: vacuous
+    assert rule.check({"min": -5.0, "max": 1.0},
+                      {"min": -1.0, "max": 1.0}, rule.tolerance) is None
+
+
+def test_adr_max_principle_holds_over_run():
+    s = ADRSolver(_cfg(n=(16, 12, 12), reaction_rate=0.0,
+                       kappa_variation=0.2))
+    out = s.run(s.initial_state(), 10)
+    u = np.asarray(out.u)
+    assert u.max() <= 1.0 + 1e-3
+    assert u.min() >= -1e-3
+
+
+# --------------------------------------------------------------------- #
+# Static halo matrix (satellite 2)
+# --------------------------------------------------------------------- #
+def test_halo_matrix_covers_adr_and_expected_counts():
+    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+
+    by_family, missing = halo_verify.family_combos()
+    assert not missing
+    for fam, combos in by_family.items():
+        assert len(combos) == halo_verify.EXPECTED_FAMILY_COMBOS[fam], fam
+    report = halo_verify.verify_all()
+    assert report.ok, "\n".join(str(v) for v in report.violations)
+    names = {c.name for c in report.combos if c.admitted}
+    assert {"adr3d-stage", "adr3d-stage[varK]",
+            "adr3d-stage[sharded]"} <= names
+    assert report.checked >= 52
+
+
+def test_halo_matrix_flags_missing_family_and_count_drift(monkeypatch):
+    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+
+    # a registered family with no combo battery is a coverage failure
+    trimmed = dict(halo_verify.FAMILY_COMBOS)
+    del trimmed["adr"]
+    monkeypatch.setattr(halo_verify, "FAMILY_COMBOS", trimmed)
+    report = halo_verify.verify_all()
+    assert any(
+        "no halo-verifier combo battery" in v.what
+        and "adr" in v.kernel
+        for v in report.violations
+    )
+    # a shrunken battery (dropped combo) is a counted coverage failure
+    monkeypatch.setattr(halo_verify, "FAMILY_COMBOS", {
+        **halo_verify.FAMILY_COMBOS,
+        "adr": lambda: halo_verify._adr_combos()[:-1],
+    })
+    report = halo_verify.verify_all()
+    assert any(
+        "combo-matrix size drifted" in v.what for v in report.violations
+    )
+
+
+def test_adr_fused_stepper_stencil_spec_is_consistent():
+    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_adr import (
+        FusedADRStepper,
+    )
+
+    stepper = FusedADRStepper(
+        (24, 10, 12), jnp.float32, (0.1, 0.1, 0.1), 1.0,
+        (0.5, 0.25, 0.0), 0.3, 1e-4, 2, 0.0, kappa_variation=0.2,
+        global_shape=(48, 10, 12),
+    )
+    assert halo_verify.verify_stepper(stepper) == []
+    spec = stepper.stencil_spec()
+    assert spec["stage_radius"] == 2  # max(upwind 1, O4 2)
+    assert spec["steps_per_exchange"] == 1
+
+
+# --------------------------------------------------------------------- #
+# CLI --model resolution (tentpole) + config validation
+# --------------------------------------------------------------------- #
+def test_cli_model_flag_resolves_and_runs(tmp_path):
+    from multigpu_advectiondiffusion_tpu.cli.__main__ import main
+
+    summary = main([
+        "--model", "adr", "--n", "10", "8", "6", "--iters", "2",
+        "--velocity", "0.5", "--kappa-variation", "0.2",
+        "--reaction", "0.3", "--save", str(tmp_path),
+    ])
+    assert summary.iters == 2
+    assert (tmp_path / "summary.json").exists()
+
+
+def test_cli_model_flag_unknown_model_fails_listing_registry():
+    from multigpu_advectiondiffusion_tpu.cli.__main__ import (
+        _resolve_model_argv,
+    )
+
+    with pytest.raises(SystemExit, match="registered models"):
+        _resolve_model_argv(["--model", "nope", "--n", "8", "8"])
+    argv = _resolve_model_argv(
+        ["--model", "adr", "--ndim", "2", "--n", "8", "8"]
+    )
+    assert argv[0] == "adr2d"
+    assert "--ndim" not in argv
+
+
+def test_adr_config_rejects_slab_only_knobs():
+    g = Grid.make(8, 8, 8, lengths=2.0)
+    with pytest.raises(ValueError, match="per-step exchange"):
+        ADRConfig(grid=g, steps_per_exchange=2)
+    with pytest.raises(ValueError, match="collective"):
+        ADRConfig(grid=g, exchange="dma")
+    with pytest.raises(ValueError, match="eps"):
+        ADRConfig(grid=g, kappa_variation=1.5)
+    with pytest.raises(ValueError, match="DECAY"):
+        ADRConfig(grid=g, reaction_rate=-1.0)
+
+
+# --------------------------------------------------------------------- #
+# Cost model + tuner keys + bench tables (satellites 4/6)
+# --------------------------------------------------------------------- #
+def test_costmodel_prices_adr():
+    from multigpu_advectiondiffusion_tpu.telemetry import costmodel
+
+    cfg = _cfg(kappa_variation=0.2)
+    assert costmodel.solver_kind(cfg) == "adr"
+    kw = costmodel.solver_cost_kwargs(cfg)
+    assert kw["variable_k"] and kw["reaction"]
+    cost = costmodel.step_cost("adr", (16, 12, 12), 4, "fused-stage",
+                               **kw)
+    assert cost.flops > 0 and cost.hbm_bytes > 0
+    # WENO5 advection prices well above upwind
+    up = costmodel.rhs_flops_per_cell("adr", 3, advect="upwind")
+    we = costmodel.rhs_flops_per_cell("adr", 3, advect="weno5")
+    assert we > up > 0
+    s = ADRSolver(cfg)
+    out = costmodel.summarize_run(s, "generic-xla", 4, 0.1)
+    assert out is not None and out["flops_per_step"] > 0
+
+
+def test_tuner_key_carries_adr_extras():
+    from multigpu_advectiondiffusion_tpu.tuning.autotuner import make_key
+
+    cfg = _cfg(advect="weno5")
+    key = make_key(ADRSolver, cfg, None, None, "cpu")
+    assert "adr" in key
+    assert "advect=weno5" in key
+
+
+def test_bench_matrix_builds_adr_cases():
+    from multigpu_advectiondiffusion_tpu.bench import matrix
+
+    cases = {c.name: c for c in matrix.CASES}
+    assert "adr3d" in cases and "adr2d" in cases
+    assert "adr3d" in matrix.BASELINES_MLUPS
+    solver = matrix.build_solver(
+        cases["adr3d"], "float32", (10, 8, 8), None
+    )
+    assert type(solver).__name__ == "ADRSolver"
+    assert solver.cfg.kappa_variation
+
+
+def test_bench_compare_family_coverage_notes():
+    from multigpu_advectiondiffusion_tpu.bench import compare as cmp
+
+    old = {
+        "adr3d_mlups": {"metric": "adr3d_mlups", "value": 10.0},
+        "diffusion3d_mlups": {"metric": "diffusion3d_mlups",
+                              "value": 5.0},
+    }
+    new = {
+        "diffusion3d_mlups": {"metric": "diffusion3d_mlups",
+                              "value": 5.0},
+    }
+    res = cmp.compare(new, old)
+    assert any("adr" in n and "NONE" in n for n in res.notes)
+    assert not res.ok  # the dropped metric also gates as missing
+    assert cmp.family_coverage(old) == {"adr": 1, "diffusion": 1}
