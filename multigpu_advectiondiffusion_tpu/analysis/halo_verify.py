@@ -117,6 +117,34 @@ def verify_stepper(stepper, kernel: Optional[str] = None
         bad(0, "exchange depth violates the k-step contract (k * G)",
             k * G, depth)
 
+    # storage declaration (ISSUE 16): the HBM-buffer/wire dtype every
+    # declared byte count derives from. Required of every rung, and
+    # proven twice over: the declared bytes-per-cell must equal the
+    # declared dtype's itemsize, and the declaration must match the
+    # instance's actual buffer dtype — a drift in either direction
+    # means the halo/DMA byte accounting no longer describes the wire
+    # (a bf16 rung billed at 4 B/cell, or worse, the reverse).
+    sdecl = spec.get("storage_dtype")
+    bpc = spec.get("bytes_per_cell")
+    if sdecl is None or bpc is None:
+        bad(None, "stencil spec must declare storage_dtype and "
+                  "bytes_per_cell (every halo/DMA byte count derives "
+                  "from the storage declaration)",
+            "storage_dtype + bytes_per_cell",
+            {"storage_dtype": sdecl, "bytes_per_cell": bpc})
+    else:
+        import jax.numpy as jnp
+
+        item = int(jnp.dtype(sdecl).itemsize)
+        if item != int(bpc):
+            bad(None, "declared bytes_per_cell disagrees with the "
+                      "storage dtype's itemsize", item, bpc)
+        buf = getattr(stepper, "dtype", None)
+        if buf is not None and jnp.dtype(buf) != jnp.dtype(sdecl):
+            bad(None, "declared storage dtype disagrees with the "
+                      "instance's buffer dtype",
+                str(jnp.dtype(buf)), str(sdecl))
+
     interior = tuple(getattr(stepper, "interior_shape", ()))
     padded = tuple(getattr(stepper, "padded_shape", ()))
     offs = getattr(stepper, "core_offsets", None)
@@ -512,6 +540,16 @@ def _diffusion_combos() -> List[Combo]:
         "diffusion3d-stage[sharded]",
         lambda: diff3d(global_shape=(48, 10, 12)),
     ))
+    # bf16-storage / f32-compute rung (ISSUE 16): the buffer (and every
+    # wire byte) is bf16, the facing state f32 — the verifier proves
+    # the 2 B/cell declaration against the instance's buffer dtype
+    combos.append(Combo(
+        "diffusion3d-stage[bf16]",
+        lambda: FusedDiffusionStepper(
+            (24, 10, 12), jnp.bfloat16, _spacing(3), [1.0] * 3, 1e-4,
+            2, 0.0, storage_dtype=f32,
+        ),
+    ))
     combos.append(Combo(
         "diffusion3d-step",
         lambda: StepFusedDiffusionStepper(
@@ -533,12 +571,14 @@ def _diffusion_combos() -> List[Combo]:
     ))
 
     def slab_diff(k=1, split=False, shape=(24, 10, 12), sharded=True,
-                  members=1, dma=False):
+                  members=1, dma=False, dtype=f32, storage=None):
         kw = {}
         if dma:
             kw = {"exchange": "dma", "mesh_axis": "dz", "num_shards": 2}
+        if storage is not None:
+            kw["storage_dtype"] = storage
         return SlabRunDiffusionStepper(
-            shape, f32, _spacing(3), [1.0] * 3, 1e-4, 2, 0.0,
+            shape, dtype, _spacing(3), [1.0] * 3, 1e-4, 2, 0.0,
             global_shape=(shape[0] * 2,) + shape[1:] if sharded else None,
             overlap_split=split, steps_per_exchange=k, members=members,
             **kw,
@@ -574,6 +614,17 @@ def _diffusion_combos() -> List[Combo]:
             f"slab-diffusion[k={k},dma]",
             lambda k=k: slab_diff(k=k, dma=True),
         ))
+    # bf16 storage on the whole-run slab rung (ISSUE 16): the collective
+    # and remote-DMA transports both push bf16 slabs, so the window /
+    # disjointness contracts re-prove with 2 B/cell storage declared
+    combos.append(Combo(
+        "slab-diffusion[bf16]",
+        lambda: slab_diff(dtype=jnp.bfloat16, storage=f32),
+    ))
+    combos.append(Combo(
+        "slab-diffusion[bf16,dma]",
+        lambda: slab_diff(dma=True, dtype=jnp.bfloat16, storage=f32),
+    ))
     return combos
 
 
@@ -626,14 +677,17 @@ def _burgers_combos() -> List[Combo]:
             ),
         ))
 
-        def slab_burg(k=1, split=False, order=order, dma=False):
+        def slab_burg(k=1, split=False, order=order, dma=False,
+                      dtype=f32, storage=None):
             shape = (36, 16, 64)
             kw = {}
             if dma:
                 kw = {"exchange": "dma", "mesh_axis": "dz",
                       "num_shards": 2}
+            if storage is not None:
+                kw["storage_dtype"] = storage
             return SlabRunBurgersStepper(
-                shape, f32, _spacing(3), _burg(), "js", 0.0, 1e-3,
+                shape, dtype, _spacing(3), _burg(), "js", 0.0, 1e-3,
                 global_shape=(72,) + shape[1:], order=order,
                 overlap_split=split, steps_per_exchange=k, **kw,
             )
@@ -669,6 +723,14 @@ def _burgers_combos() -> List[Combo]:
                     k=k, dma=True, order=order
                 ),
             ))
+        # bf16 storage (ISSUE 16): Burgers' only fused bf16 rung is the
+        # whole-run slab — proven per WENO order with 2 B/cell declared
+        combos.append(Combo(
+            f"slab-burgers[o{order},bf16]",
+            lambda order=order: slab_burg(
+                order=order, dtype=jnp.bfloat16, storage=f32
+            ),
+        ))
     return combos
 
 
@@ -684,9 +746,9 @@ def _adr_combos() -> List[Combo]:
 
     f32 = jnp.float32
 
-    def adr3d(**kw):
+    def adr3d(dtype=f32, **kw):
         return FusedADRStepper(
-            (24, 10, 12), f32, _spacing(3), 1.0, (0.5, 0.25, 0.0),
+            (24, 10, 12), dtype, _spacing(3), 1.0, (0.5, 0.25, 0.0),
             0.3, 1e-4, 2, 0.0, **kw,
         )
 
@@ -697,6 +759,9 @@ def _adr_combos() -> List[Combo]:
         Combo("adr3d-stage[sharded]",
               lambda: adr3d(kappa_variation=0.2,
                             global_shape=(48, 10, 12))),
+        # bf16 storage / f32 compute (ISSUE 16)
+        Combo("adr3d-stage[bf16]",
+              lambda: adr3d(dtype=jnp.bfloat16, storage_dtype=f32)),
     ]
 
 
@@ -714,10 +779,12 @@ FAMILY_COMBOS = {
 #: coefficient mode) is a counted coverage failure, not a quiet shrink
 #: (ISSUE 15 satellite).
 EXPECTED_FAMILY_COMBOS = {
-    "diffusion": 18,  # 5 stage/step/2d + 1 unsharded slab + 3 B-fold
-    #                 + 3k x {plain, split, dma}
-    "burgers": 30,    # 2 orders x (4 stage/2d + 2 slab + 3k x 3 modes)
-    "adr": 3,         # per-stage: const-K, var-K, sharded
+    "diffusion": 21,  # 6 stage/step/2d (incl bf16) + 1 unsharded slab
+    #                 + 3 B-fold + 3k x {plain, split, dma}
+    #                 + 2 bf16 slab (collective, dma)
+    "burgers": 32,    # 2 orders x (4 stage/2d + 2 slab + 3k x 3 modes
+    #                 + 1 bf16 slab)
+    "adr": 4,         # per-stage: const-K, var-K, sharded, bf16
 }
 
 

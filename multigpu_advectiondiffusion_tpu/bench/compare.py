@@ -15,9 +15,12 @@ JSON-lines artifacts (one row per line, ``metric``+``value`` or
 object whose ``tail`` embeds the JSONL (the BENCH_r0*.json layout; a
 truncated first line is skipped, not fatal).
 
-Threshold per row: ``max(rel_tol, spread_factor * max(spread_old,
-spread_new))`` — a noisy row must move by more than its own observed
-dispersion before the gate calls it a regression. The measured
+Threshold per row: ``max(rel_tol, min(spread_factor * max(spread_old,
+spread_new), spread_cap))`` — a noisy row must move by more than its
+own observed dispersion before the gate calls it a regression, but the
+spread-derived slack is capped (:data:`DEFAULT_SPREAD_CAP`) so a round
+with pathological measured spread cannot widen its own gate past the
+point where a real 20% regression reads as noise. The measured
 introspection columns (:data:`MEASURED_FIELDS` — ``xla_flops``/
 ``xla_bytes``/``peak_bytes``) are coverage-checked (a dropped column
 prints a note) but never gate. Usage::
@@ -39,6 +42,14 @@ from typing import Dict, List, Optional
 
 DEFAULT_REL_TOL = 0.05
 DEFAULT_SPREAD_FACTOR = 2.0
+# Ceiling on the spread-derived part of the threshold: a round whose
+# measured spread is huge (a CPU-round artifact, a noisy shared host)
+# must not widen its own gate past this — otherwise an injected 20%
+# regression can hide inside 2 x spread and the bench gate's selftest
+# stops being deterministic. 15% keeps the documented noisy-row
+# semantics (a -12% move on a 15%-spread row is noise) while any drop
+# beyond 15% always gates.
+DEFAULT_SPREAD_CAP = 0.15
 
 # Measured-introspection columns (telemetry/xprof via bench rows):
 # coverage-checked — a row that HAD them and silently lost them gets a
@@ -56,6 +67,15 @@ MEASURED_FIELDS = ("xla_flops", "xla_bytes", "peak_bytes")
 # :func:`row_members`/:func:`row_member_sharding` read them as 1 and
 # their absence is never a coverage regression.
 ENSEMBLE_FIELDS = ("ensemble", "vs_looped", "member_sharding", "devices")
+
+# Low-precision-storage columns (ISSUE 16): ``storage_dtype`` (the
+# dtype the state occupies in HBM and on the halo wire) and
+# ``precision`` (the dispatch knob that selected it) ride the
+# ``precision_*`` bench rows. Same coverage-note discipline: a row
+# that HAD them and silently lost them prints a note, never gates.
+# Rows from rounds before this family (r01-r07) carry neither field
+# and read as the compute dtype via :func:`row_storage_dtype`.
+PRECISION_FIELDS = ("storage_dtype", "precision")
 
 # Halo-transport column (ISSUE 13): ``exchange`` records which halo
 # transport a sharded slab row ran — "collective" (XLA ppermute
@@ -109,6 +129,17 @@ def row_exchange(row: Optional[dict]) -> str:
         return "collective"
     v = row.get("exchange")
     return str(v) if v else "collective"
+
+
+def row_storage_dtype(row: Optional[dict]) -> str:
+    """A row's HBM/wire storage dtype; rounds before ISSUE 16 carry no
+    field and read as the row's compute dtype (``dtype`` when recorded,
+    else the repo-wide float32 default) — never a parse error, never a
+    coverage regression."""
+    if not row:
+        return "float32"
+    v = row.get("storage_dtype") or row.get("dtype")
+    return str(v) if v else "float32"
 
 
 def parse_rows(text: str) -> List[dict]:
@@ -258,6 +289,7 @@ def compare(
     old_rows: Dict[str, dict],
     rel_tol: float = DEFAULT_REL_TOL,
     spread_factor: float = DEFAULT_SPREAD_FACTOR,
+    spread_cap: float = DEFAULT_SPREAD_CAP,
 ) -> CompareResult:
     """Per-metric diff of two rounds. A metric present in the old round
     but absent from the new one is a ``missing`` failure (a silently
@@ -295,7 +327,8 @@ def compare(
             results.append(RowResult(key, "missing",
                                      old=row_value(old)))
             continue
-        for field in MEASURED_FIELDS + ENSEMBLE_FIELDS + SCHEDULE_FIELDS:
+        for field in (MEASURED_FIELDS + ENSEMBLE_FIELDS
+                      + SCHEDULE_FIELDS + PRECISION_FIELDS):
             if old.get(field) is not None and new.get(field) is None:
                 notes.append(
                     f"{key}: measured column {field!r} dropped "
@@ -308,6 +341,16 @@ def compare(
             notes.append(
                 f"{key}: halo transport changed "
                 f"{row_exchange(old)} -> {row_exchange(new)} "
+                "(coverage note, non-gating)"
+            )
+        if row_storage_dtype(old) != row_storage_dtype(new):
+            # the same metric measured at a different storage dtype is
+            # a different bandwidth workload: surfaced, non-gating (the
+            # precision_* row NAMES carry the dtype by convention, so
+            # this only fires on drift)
+            notes.append(
+                f"{key}: storage dtype changed "
+                f"{row_storage_dtype(old)} -> {row_storage_dtype(new)} "
                 "(coverage note, non-gating)"
             )
         if row_members(old) != row_members(new):
@@ -333,7 +376,10 @@ def compare(
         ov, nv = row_value(old), row_value(new)
         threshold = max(
             rel_tol,
-            spread_factor * max(row_spread(old), row_spread(new)),
+            min(
+                spread_factor * max(row_spread(old), row_spread(new)),
+                spread_cap,
+            ),
         )
         ratio = nv / ov if ov else float("inf")
         if ratio < 1.0 - threshold:
@@ -393,6 +439,11 @@ def main(argv=None) -> None:
                     help="multiple of a row's own measured spread the "
                          "threshold grows to on noisy rows "
                          f"(default {DEFAULT_SPREAD_FACTOR})")
+    ap.add_argument("--spread-cap", type=float,
+                    default=DEFAULT_SPREAD_CAP,
+                    help="ceiling on the spread-derived threshold "
+                         "slack, so a noisy round cannot widen its own "
+                         f"gate (default {DEFAULT_SPREAD_CAP})")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable result on stdout")
     args = ap.parse_args(argv)
@@ -409,7 +460,8 @@ def main(argv=None) -> None:
         if not old_rows:
             raise SystemExit(f"no bench rows found in {args.old}")
         result = compare(new_rows, old_rows, rel_tol=args.rel_tol,
-                         spread_factor=args.spread_factor)
+                         spread_factor=args.spread_factor,
+                         spread_cap=args.spread_cap)
     if args.json:
         print(json.dumps(result.to_dict(), indent=2))
     else:

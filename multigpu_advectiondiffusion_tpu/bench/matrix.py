@@ -146,6 +146,136 @@ ENSEMBLE_CASES = [
 ]
 
 
+@dataclasses.dataclass(frozen=True)
+class PrecisionBenchCase:
+    """One error-vs-speed precision row (ISSUE 16): the SAME workload
+    timed at native f32 and at ``precision='bf16'`` (bf16 storage /
+    f32 compute), recording the bf16 rung's MLUPS next to the native
+    rate AND both runs' solution error — a speedup the science cannot
+    cash is a regression, so the row carries the evidence for the
+    per-dtype gate (``out/precision_gate.sh`` /
+    ``diagnostics/compare``) right next to the rate."""
+
+    name: str
+    kind: str  # diffusion | burgers | adr
+    grid_xyz: Tuple[int, ...]
+    iters: int
+    quick_scale: int = 4
+    impl: str = "pallas"
+    weno_order: int = 5
+    fixed_dt: bool = True  # bf16 Burgers requires fixed dt
+    nu: float = 0.0
+
+
+PRECISION_CASES = [
+    PrecisionBenchCase("precision_diffusion3d", "diffusion",
+                       (208, 200, 200), 151),
+    # Burgers' bf16 rung is the whole-run slab (per-stage WENO has no
+    # split-dtype machinery) — pinned so a silent per-stage fallback
+    # cannot masquerade as the bf16 measurement
+    PrecisionBenchCase("precision_burgers3d", "burgers",
+                       (256, 256, 256), 40, impl="pallas_slab",
+                       nu=1e-5),
+    PrecisionBenchCase("precision_adr3d", "adr", (208, 200, 200), 100),
+]
+
+
+def run_precision_case(case: PrecisionBenchCase, quick: bool = False,
+                       repeats: int = 3) -> dict:
+    """Time one workload at native f32 AND at ``precision='bf16'``,
+    and record both runs' error: the analytic L1/L2/Linf norms where
+    the family has them (diffusion/ADR heat-kernel workloads), plus
+    the bf16 trajectory's relative L2 distance from the native one
+    (always available — Burgers has no analytic 3-D solution). The
+    row's gated value is the bf16 ``mlups``; the error fields are the
+    science evidence the precision gate reads."""
+    import jax.numpy as jnp
+
+    from multigpu_advectiondiffusion_tpu.core.grid import Grid
+    from multigpu_advectiondiffusion_tpu.models import registry
+    from multigpu_advectiondiffusion_tpu.timestepping.integrators import (
+        STAGES,
+    )
+    from multigpu_advectiondiffusion_tpu.utils.metrics import mlups
+
+    grid_xyz = case.grid_xyz
+    iters = case.iters
+    if quick:
+        grid_xyz = tuple(max(16, g // case.quick_scale) for g in grid_xyz)
+        iters = max(3, iters // case.quick_scale)
+    grid = Grid.make(*grid_xyz, lengths=[10.0] * len(grid_xyz))
+    spec = registry.get(case.kind)
+    cfg32 = spec.bench_build(grid, "float32", case.impl, case)
+    cfg16 = dataclasses.replace(cfg32, precision="bf16")
+
+    rows = {}
+    outs = {}
+    for label, cfg in (("native", cfg32), ("bf16", cfg16)):
+        solver = spec.solver_cls(cfg)
+        state = solver.initial_state()
+        timed = timed_run(solver, state, iters, reps=repeats)
+        outs[label] = (solver, solver.run(state, iters))
+        cells = 1
+        for g in grid_xyz:
+            cells *= g
+        rows[label] = {
+            "engaged": solver.engaged_path()["stepper"],
+            "storage_dtype": str(solver.storage_dtype),
+            "seconds": round(timed.seconds, 4),
+            "spread": round(timed.spread, 4),
+            "mlups": round(
+                mlups(cells, iters, STAGES[cfg.integrator], timed.seconds),
+                1,
+            ),
+        }
+
+    s16, out16 = outs["bf16"]
+    s32, out32 = outs["native"]
+    ref = float(jnp.linalg.norm(out32.u.astype(jnp.float32).ravel()))
+    dist = float(jnp.linalg.norm(
+        (out16.u.astype(jnp.float32) - out32.u.astype(jnp.float32)).ravel()
+    ))
+    result = {
+        "name": case.name,
+        "grid": "x".join(map(str, grid_xyz)),
+        "iters": iters,
+        "dtype": "float32",
+        "precision": "bf16",
+        "storage_dtype": rows["bf16"]["storage_dtype"],
+        "impl": case.impl,
+        "engaged": rows["bf16"]["engaged"],
+        "seconds": rows["bf16"]["seconds"],
+        "spread": rows["bf16"]["spread"],
+        "mlups": rows["bf16"]["mlups"],
+        "native_engaged": rows["native"]["engaged"],
+        "native_mlups": rows["native"]["mlups"],
+        "native_seconds": rows["native"]["seconds"],
+        "speedup_vs_native": (
+            round(rows["native"]["seconds"] / rows["bf16"]["seconds"], 3)
+            if rows["bf16"]["seconds"]
+            else None
+        ),
+        # bf16 trajectory vs the native one, relative L2 — nonzero by
+        # construction (storage rounding), gated by the precision
+        # gate's per-dtype band, never by the MLUPS thresholds
+        "vs_native_rel_l2": round(dist / ref, 8) if ref else None,
+        "ensemble": 1,
+        "quick": quick,
+    }
+    for label, (solver, out) in outs.items():
+        if hasattr(solver, "error_norms"):
+            try:
+                norms = solver.error_norms(out)
+            except ValueError:
+                # workloads without a closed form (variable-K ADR,
+                # Burgers) gate on vs_native_rel_l2 instead
+                continue
+            key = "error" if label == "bf16" else "native_error"
+            result[f"{key}_l2"] = round(float(norms.l2), 10)
+            result[f"{key}_linf"] = round(float(norms.linf), 10)
+    return result
+
+
 def run_ensemble_case(case: EnsembleBenchCase, quick: bool = False,
                       repeats: int = 3) -> dict:
     """Time one batched-ensemble case: B members in ONE vmapped
@@ -340,6 +470,10 @@ def run_case(
         # halo transport actually engaged: collective ppermute or the
         # in-kernel remote-DMA ring (ISSUE 13)
         "exchange": engaged.get("exchange", "collective"),
+        # storage-precision provenance (ISSUE 16): rows predating the
+        # fields read as native/compute-dtype in bench/compare.py
+        "precision": engaged.get("precision", "native"),
+        "storage_dtype": engaged.get("storage_dtype", dtype),
         "tuned": engaged.get("tuned"),
         "seconds": round(best, 4),
         "compile_seconds": round(compile_s, 3),
@@ -411,10 +545,14 @@ def main(argv=None):
         c for c in ENSEMBLE_CASES
         if args.name is None or c.name == args.name
     ]
-    if not cases and not ens_cases:
+    prec_cases = [
+        c for c in PRECISION_CASES
+        if args.name is None or c.name == args.name
+    ]
+    if not cases and not ens_cases and not prec_cases:
         raise SystemExit(
             f"no case {args.name!r}; have "
-            f"{[c.name for c in CASES + ENSEMBLE_CASES]}"
+            f"{[c.name for c in CASES + ENSEMBLE_CASES + PRECISION_CASES]}"
         )
     from jax.experimental import enable_x64
 
@@ -437,6 +575,14 @@ def main(argv=None):
         # meshes, so these never take --mesh; f32 only
         res = run_ensemble_case(case, quick=args.quick,
                                 repeats=args.repeats)
+        line = json.dumps(res)
+        print(line, flush=True)
+        lines.append(line)
+    for case in prec_cases:
+        # error-vs-speed precision rows (ISSUE 16): f32-facing configs
+        # (no x64 scoping), single-run only — never take --mesh
+        res = run_precision_case(case, quick=args.quick,
+                                 repeats=args.repeats)
         line = json.dumps(res)
         print(line, flush=True)
         lines.append(line)

@@ -59,6 +59,18 @@ def _add_common(p: argparse.ArgumentParser, ndim: int):
                    help="march to this simulated time instead of --iters")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "float64", "bfloat16"])
+    p.add_argument("--precision", default="native",
+                   choices=["native", "bf16"],
+                   help="storage precision rung: bf16 = store the "
+                        "run-resident state (HBM buffers, every halo/"
+                        "remote-DMA wire byte) in bfloat16 while all "
+                        "stencil taps and RK stages compute in float32, "
+                        "with compensated (Kahan hi/lo) accumulation on "
+                        "the generic path — half the memory traffic at "
+                        "float32 arithmetic; requires --dtype float32 "
+                        "and validates loudly per rung (single-run "
+                        "only; per-stage Burgers needs --fixed-dt and "
+                        "engages the slab rung)")
     p.add_argument("--ic", default=None, help="initial-condition name")
     p.add_argument("--bc", default=None, nargs="*",
                    help="boundary kind(s): one value or one per axis "

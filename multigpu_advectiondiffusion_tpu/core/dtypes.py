@@ -24,6 +24,23 @@ _ALIASES = {
 }
 
 
+def bf16_carry_enabled() -> bool:
+    """Whether the bf16-storage rung carries the Kahan compensation term.
+
+    On by default: the generic XLA path of ``precision='bf16'`` keeps a
+    bf16 ``lo`` carry next to the bf16 ``hi`` state so small per-step
+    increments that round away at bf16 still accumulate (ISSUE 16).
+    ``TPUCFD_BF16_NO_CARRY=1`` disables it — the knob exists for the
+    science-gate selftest (``out/precision_gate.sh --selftest``), which
+    proves the uncompensated rung FAILS the per-dtype tolerance bands.
+    """
+    import os
+
+    return os.environ.get("TPUCFD_BF16_NO_CARRY", "").lower() not in (
+        "1", "true", "yes",
+    )
+
+
 def canonicalize(dtype) -> jnp.dtype:
     """Resolve a user-facing dtype spec to a concrete jnp dtype."""
     if isinstance(dtype, str):

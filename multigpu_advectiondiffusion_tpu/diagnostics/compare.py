@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 ARTIFACT_SCHEMA = 1
 
@@ -62,6 +62,32 @@ TOLERANCE_BANDS: Dict[str, float] = {
     "spectral_tail": 5e-3,
 }
 DEFAULT_BAND = 1e-3
+
+#: Per-STORAGE-dtype tolerance bands (ISSUE 16): a run whose state was
+#: stored in bfloat16 (``precision='bf16'`` — the run meta carries
+#: ``storage_dtype``) truncates every field to an 8-bit mantissa per
+#: step, so cross-round deviations sit at a few bf16 round-offs
+#: (~4e-3), not f32's 1e-7 — judging such a round against the f32
+#: bands would fail every healthy run, and judging f32 rounds against
+#: bf16 bands would wave real drift through. ``time`` keeps its tight
+#: band on purpose: dt arithmetic stays f32 under bf16 storage, so a
+#: drifting time trajectory is a schedule bug at ANY storage
+#: precision. Explicit ``--band`` overrides still win.
+STORAGE_TOLERANCE_BANDS: Dict[str, Dict[str, float]] = {
+    "bfloat16": {
+        "mass": 5e-3,
+        "time": 1e-6,
+        "l1": 2e-2,
+        "l2": 2e-2,
+        "energy": 2e-2,
+        "max_abs": 2e-2,
+        "max": 2e-2,
+        "min": 5e-2,
+        "tv": 5e-2,
+        "spectral_tail": 1e-1,
+    },
+}
+STORAGE_DEFAULT_BAND: Dict[str, float] = {"bfloat16": 5e-2}
 
 #: Observables excluded from gating: ``mass_drift`` is the difference
 #: of two near-equal numbers (its relative scale is meaningless — the
@@ -185,6 +211,33 @@ def _band_for(observable: str, bands: Dict[str, float],
     return bands.get(observable, default_band)
 
 
+def _storage_dtype(*entries: Optional[dict]) -> Optional[str]:
+    """The storage dtype a run's state lived in, from the diagnostics
+    meta either round recorded (new wins — it reflects the config
+    under test). ``None`` = native storage (compute dtype)."""
+    for entry in entries:
+        dtype = ((entry or {}).get("meta") or {}).get("storage_dtype")
+        if dtype is not None:
+            return str(dtype)
+    return None
+
+
+def _bands_for_run(
+    storage: Optional[str],
+    overrides: Optional[Dict[str, float]],
+    default_band: float,
+) -> Tuple[Dict[str, float], float]:
+    """Resolve the (band table, default) for one run. Precedence per
+    observable: explicit ``--band`` override > the storage dtype's
+    table (:data:`STORAGE_TOLERANCE_BANDS`) > the base f32 bands."""
+    bands = dict(TOLERANCE_BANDS)
+    if storage in STORAGE_TOLERANCE_BANDS:
+        bands.update(STORAGE_TOLERANCE_BANDS[storage])
+        default_band = STORAGE_DEFAULT_BAND.get(storage, default_band)
+    bands.update(overrides or {})
+    return bands, default_band
+
+
 def compare(
     new_round: dict,
     old_round: dict,
@@ -192,7 +245,7 @@ def compare(
     default_band: float = DEFAULT_BAND,
 ) -> GateResult:
     """Per-(run, observable) trajectory diff of two rounds."""
-    bands = dict(TOLERANCE_BANDS, **(bands or {}))
+    overrides = dict(bands or {})
     rows: List[GateRow] = []
     notes: List[str] = []
     old_runs = old_round.get("runs", {})
@@ -206,6 +259,15 @@ def compare(
         if new is None:
             rows.append(GateRow(run, "*", "missing"))
             continue
+        storage = _storage_dtype(new, old)
+        run_bands, run_default = _bands_for_run(
+            storage, overrides, default_band
+        )
+        if storage in STORAGE_TOLERANCE_BANDS:
+            notes.append(
+                f"{run}: {storage} storage — per-dtype tolerance "
+                "bands in effect"
+            )
         old_obs = old.get("observables", {})
         new_obs = new.get("observables", {})
         for obs in sorted(set(old_obs) | set(new_obs)):
@@ -227,7 +289,7 @@ def compare(
             dev = max(abs(new_t[s] - old_t[s]) for s in common) / max(
                 scale, 1e-30
             )
-            band = _band_for(obs, bands, default_band)
+            band = _band_for(obs, run_bands, run_default)
             rows.append(
                 GateRow(
                     run, obs,

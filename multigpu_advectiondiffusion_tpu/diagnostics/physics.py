@@ -185,8 +185,15 @@ def rules_for(solver) -> List[ViolationRule]:
 def meta_for(solver) -> dict:
     """Per-solver fields riding every ``phys:diag`` event (solver class,
     ndim, the analytic decay rate where one exists) — what the trace
-    analyzer's physics section keys its fits on."""
+    analyzer's physics section keys its fits on. ``storage_dtype``
+    records the precision the state was STORED at (ISSUE 16): the
+    science gate (``diagnostics/compare``) widens its tolerance bands
+    per storage dtype, so a bf16-storage round is judged against bf16
+    truncation, never against f32 round-off."""
     meta = {"solver": type(solver).__name__, "ndim": solver.grid.ndim}
+    storage = getattr(solver, "storage_dtype", None)
+    if storage is not None:
+        meta["storage_dtype"] = str(storage)
     meta.update(diagnostics_spec(solver).get("meta", {}))
     return meta
 

@@ -107,10 +107,19 @@ class ADRConfig:
     # not ship
     steps_per_exchange: int = 1
     exchange: str = "collective"
+    # storage precision rung (see DiffusionConfig): "native" or "bf16"
+    # (f32 compute state stored/exchanged as bfloat16; ADR engages it
+    # on the 3-D per-stage fused rung and the generic XLA path)
+    precision: str = "native"
 
     def __post_init__(self):
         from multigpu_advectiondiffusion_tpu.ops import IMPLS
 
+        if self.precision not in ("native", "bf16"):
+            raise ValueError(
+                f"unknown precision {self.precision!r}; "
+                "'native' or 'bf16'"
+            )
         if self.impl not in IMPLS:
             raise ValueError(
                 f"unknown impl {self.impl!r}; ladder rungs: {IMPLS}"
@@ -496,12 +505,22 @@ class ADRSolver(SolverBase):
                 FusedADRStepper,
             )
 
+            # precision='bf16' (ISSUE 16): kernel/HBM buffers at bf16,
+            # taps/RK in f32 via the kernel's compute_dtype upcast,
+            # f32 facing state restored at extract
+            kernel_dtype = (
+                jnp.dtype(jnp.bfloat16)
+                if self._precision_mode() == "bf16"
+                else self.dtype
+            )
             kwargs = {}
             if self.mesh is not None:
                 kwargs["global_shape"] = self.grid.shape
+            if jnp.dtype(kernel_dtype) != jnp.dtype(self.dtype):
+                kwargs["storage_dtype"] = self.dtype
             self._cache["fused"] = FusedADRStepper(
                 lshape,
-                self.dtype,
+                kernel_dtype,
                 self.grid.spacing,
                 cfg.diffusivity,
                 self._velocity_zyx(),
@@ -603,6 +622,7 @@ def _cli_build(args, grid, ndim):
         overlap=args.overlap,
         steps_per_exchange=args.steps_per_exchange,
         exchange=args.exchange,
+        precision=getattr(args, "precision", "native"),
     )
 
 

@@ -118,6 +118,7 @@ class SolverBase:
             )
         self._validate_steps_per_exchange()
         self._validate_exchange()
+        self._validate_precision()
 
     def _validate_steps_per_exchange(self) -> None:
         """Gate the communication-avoiding chunk knob the way impl
@@ -207,6 +208,89 @@ class SolverBase:
                 "exchange='dma' is single-process (ICI) only — "
                 "multihost z layouts keep the collective exchange"
             )
+
+    def _precision_mode(self) -> str:
+        return str(getattr(self.cfg, "precision", "native") or "native")
+
+    def _validate_precision(self) -> None:
+        """Gate the low-precision-storage knob the way impl strings and
+        ``exchange`` are gated: a config that cannot honor
+        ``precision='bf16'`` (the bf16-storage / f32-compute bandwidth
+        rung, ISSUE 16) fails at construction instead of silently
+        running native storage. The rung stores the run-resident state
+        (HBM buffers, halo/remote-DMA wires) in bfloat16 while every
+        stencil tap and RK stage computes in float32; the generic-XLA
+        loop additionally carries a bf16 compensation term (hi/lo
+        split) so long-horizon error stays bounded
+        (``core.dtypes.bf16_carry_enabled``)."""
+        from multigpu_advectiondiffusion_tpu.core.dtypes import (
+            bf16_carry_enabled,
+        )
+
+        mode = self._precision_mode()
+        if mode == "native":
+            self._bf16_carry = False
+            return
+        if mode != "bf16":
+            raise ValueError(
+                f"unknown precision {mode!r}; use 'native' or 'bf16'"
+            )
+        if self.dtype == jnp.bfloat16:
+            raise ValueError(
+                "precision='bf16' with dtype='bfloat16' is redundant — "
+                "the knob downcasts a float32 compute state to bf16 "
+                "storage; the all-bf16 compute experiment remains the "
+                "separate dtype='bfloat16' opt-in"
+            )
+        if self.dtype != jnp.float32:
+            raise ValueError(
+                "precision='bf16' stores a float32 compute state in "
+                f"bfloat16; cfg.dtype must be float32, got {self.dtype}"
+            )
+        self._bf16_carry = bf16_carry_enabled()
+        from multigpu_advectiondiffusion_tpu import telemetry
+
+        telemetry.event(
+            "precision", "engage",
+            storage_dtype="bfloat16", compute_dtype="float32",
+            carry=bool(self._bf16_carry),
+        )
+
+    @property
+    def storage_dtype(self):
+        """The dtype the run-resident state occupies in HBM and on
+        every halo/remote-DMA wire under the engaged configuration —
+        the itemsize the cost model prices HBM passes with and the
+        tuner/AOT keys fingerprint. Equals :attr:`dtype` except under
+        ``precision='bf16'``."""
+        if self._precision_mode() == "bf16":
+            return jnp.dtype(jnp.bfloat16)
+        return self.dtype
+
+    # -- bf16-storage generic-loop plumbing (precision='bf16') -------- #
+    def _bf16_pack(self, u):
+        """Facing f32 state -> the loop-resident bf16 representation:
+        ``(hi,)`` (plain downcast) or ``(hi, lo)`` with the Kahan-style
+        compensation term ``lo = bf16(u - f32(hi))`` when the carry is
+        armed. ``bf16(u) == hi`` exactly, so a wire transfer of the
+        reconstructed state truncated to bf16 transmits precisely
+        ``hi`` — the carry never doubles halo bytes."""
+        hi = u.astype(jnp.bfloat16)
+        if not self._bf16_carry:
+            return (hi,)
+        lo = (u - hi.astype(u.dtype)).astype(jnp.bfloat16)
+        return (hi, lo)
+
+    def _bf16_unpack(self, packed):
+        """Inverse of :meth:`_bf16_pack`: reconstruct the f32 compute
+        state from the stored representation (``f32(hi) [+ f32(lo)]``).
+        Without the carry, small-dt increments round away entirely at
+        the bf16 ulp — the stall the compensation exists to prevent
+        (tests/test_precision.py proves both directions)."""
+        u = packed[0].astype(self.dtype)
+        if len(packed) > 1:
+            u = u + packed[1].astype(self.dtype)
+        return u
 
     @staticmethod
     def _dma_backend_ok() -> bool:
@@ -386,13 +470,20 @@ class SolverBase:
         sizes = dict(self.mesh.shape)
         reduce = self.mesh_reduce_max()
         lshape = self.decomp.local_shape(self.mesh, gshape)
+        # precision='bf16': ghost slabs cross the wire at the declared
+        # storage dtype (half the bytes); the interior stays f32
+        wire = (
+            jnp.bfloat16 if self._precision_mode() == "bf16" else None
+        )
         return StepContext(
-            padder=make_padder(self.decomp, sizes, self.bcs),
+            padder=make_padder(self.decomp, sizes, self.bcs,
+                               wire_dtype=wire),
             offsets=axis_offsets(self.decomp, lshape),
             local_shape=lshape,
             global_shape=gshape,
             reduce_max=reduce if reduce is not None else (lambda x: x),
-            ghost_fn=make_ghost_fn(self.decomp, sizes, self.bcs),
+            ghost_fn=make_ghost_fn(self.decomp, sizes, self.bcs,
+                                   wire_dtype=wire),
         )
 
     def _local_step(self, u, t, t_end=None, overrides=None):
@@ -736,6 +827,13 @@ class SolverBase:
                 ),
                 # halo-exchange transport actually engaged
                 "exchange": exchange,
+                # HBM-resident dtype of the engaged stepper's buffers
+                # (f64 facing states live as f32 in-kernel; bf16 under
+                # precision='bf16')
+                "storage_dtype": str(
+                    jnp.dtype(getattr(fused, "dtype", self.dtype))
+                ),
+                "precision": self._precision_mode(),
                 "fallback": None,
             }
             if self._tuned is not None:
@@ -775,6 +873,8 @@ class SolverBase:
                 getattr(self.cfg, "steps_per_exchange", 1) or 1
             ),
             "exchange": self._exchange_mode(),
+            "storage_dtype": str(jnp.dtype(self.storage_dtype)),
+            "precision": self._precision_mode(),
             "fallback": fallback,
         }
         if self._tuned is not None:
@@ -943,10 +1043,30 @@ class SolverBase:
             u, t = f(state.u, state.t)
             return SolverState(u=u, t=t, it=state.it + num_iters)
 
-        def block(u, t):
-            return lax.fori_loop(
-                0, num_iters, lambda i, c: self._local_step(*c), (u, t)
-            )
+        if self._precision_mode() == "bf16":
+            # bf16-storage generic rung: the loop-resident state is the
+            # packed bf16 representation (hi, or hi+compensation lo) —
+            # the facing/public state stays f32; every step
+            # reconstructs f32, marches, re-splits. With the carry the
+            # loop carries 2+2 bytes/cell (f32 traffic parity — the win
+            # is the halo wire and the carry-free fused rungs); without
+            # it, 2 bytes/cell at bf16 rounding error.
+            def block(u, t):
+                def body(i, c):
+                    u2, t2 = self._local_step(
+                        self._bf16_unpack(c[:-1]), c[-1]
+                    )
+                    return self._bf16_pack(u2) + (t2,)
+
+                out = lax.fori_loop(
+                    0, num_iters, body, self._bf16_pack(u) + (t,)
+                )
+                return self._bf16_unpack(out[:-1]), out[-1]
+        else:
+            def block(u, t):
+                return lax.fori_loop(
+                    0, num_iters, lambda i, c: self._local_step(*c), (u, t)
+                )
 
         f = self._compiled(("run", num_iters), lambda: self._wrap(block),
                            steps=int(num_iters))
@@ -988,18 +1108,42 @@ class SolverBase:
             )
             return SolverState(u=u, t=t, it=state.it + steps)
 
-        def block(u, t, te):
-            eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+        if self._precision_mode() == "bf16":
+            # bf16-storage generic rung, t_end mode: same packed loop
+            # state as _run_impl's fori body (the arity n is static —
+            # 1 without the compensation carry, 2 with it)
+            def block(u, t, te):
+                eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+                n = len(self._bf16_pack(u))
 
-            def cond(c):
-                return c[1] < te - eps
+                def cond(c):
+                    return c[n] < te - eps
 
-            def body(c):
-                u, t, it = c
-                u, t = self._local_step(u, t, t_end=te)
-                return (u, t, it + 1)
+                def body(c):
+                    u2, t2 = self._local_step(
+                        self._bf16_unpack(c[:n]), c[n], t_end=te
+                    )
+                    return self._bf16_pack(u2) + (t2, c[n + 1] + 1)
 
-            return lax.while_loop(cond, body, (u, t, jnp.zeros((), jnp.int32)))
+                out = lax.while_loop(
+                    cond, body,
+                    self._bf16_pack(u) + (t, jnp.zeros((), jnp.int32)),
+                )
+                return self._bf16_unpack(out[:n]), out[n], out[n + 1]
+        else:
+            def block(u, t, te):
+                eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+
+                def cond(c):
+                    return c[1] < te - eps
+
+                def body(c):
+                    u, t, it = c
+                    u, t = self._local_step(u, t, t_end=te)
+                    return (u, t, it + 1)
+
+                return lax.while_loop(cond, body,
+                                      (u, t, jnp.zeros((), jnp.int32)))
 
         # check=False: no vma/replication rule exists for while_loop
         f = self._compiled("adv", lambda: self._wrap(block, 2, 2,
@@ -1046,6 +1190,14 @@ class SolverBase:
                 "rung, whose in-kernel remote-DMA ring does not fold "
                 "a member axis — the batched ensemble engine keeps "
                 "the collective exchange"
+            )
+        if self._precision_mode() == "bf16":
+            raise ValueError(
+                "precision='bf16' is a single-run rung: neither the "
+                "vmapped fused stepper nor the B-folded slab grid "
+                "threads the bf16 storage split (and its compensation "
+                "carry) through the member axis — run ensembles at "
+                "native precision"
             )
         if getattr(self.cfg, "impl", "xla") == "pallas_slab":
             if self.mesh is not None:

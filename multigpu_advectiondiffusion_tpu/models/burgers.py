@@ -67,10 +67,19 @@ class BurgersConfig:
     # ppermute between compiled calls) or "dma" (in-kernel remote-DMA
     # pushes on the sharded whole-run slab rung)
     exchange: str = "collective"
+    # storage precision rung (see DiffusionConfig): "native" or "bf16"
+    # (f32 compute state stored/exchanged as bfloat16; Burgers engages
+    # it on the fixed-dt 3-D slab rung and the generic XLA path)
+    precision: str = "native"
 
     def __post_init__(self):
         from multigpu_advectiondiffusion_tpu.ops import IMPLS
 
+        if self.precision not in ("native", "bf16"):
+            raise ValueError(
+                f"unknown precision {self.precision!r}; "
+                "'native' or 'bf16'"
+            )
         if self.overlap not in ("padded", "split"):
             raise ValueError(f"unknown overlap {self.overlap!r}")
         if self.impl not in IMPLS:
@@ -273,6 +282,24 @@ class BurgersSolver(SolverBase):
             return self._decline("fused viscous term is the O4 Laplacian")
         if self.dtype != jnp.float32:
             return self._decline("fused kernels are float32-only")
+        # precision='bf16' (ISSUE 16): Burgers' only fused bf16 rung is
+        # the whole-run slab stepper (its step_fn wraps the f32 WENO
+        # stages around a bf16-resident grid). The per-stage kernel
+        # computes in the buffer dtype with adaptive-dt SMEM machinery —
+        # no split-dtype path — so anything that can't ride the slab
+        # declines loudly to the compensated generic XLA rung.
+        bf16_store = self._precision_mode() == "bf16"
+        if bf16_store and self.grid.ndim != 3:
+            return self._decline(
+                "precision='bf16' Burgers rides the 3-D slab stepper "
+                "(or the generic path); 2-D has no split-dtype rung"
+            )
+        if bf16_store and cfg.adaptive_dt:
+            return self._decline(
+                "precision='bf16' Burgers needs --fixed-dt: the "
+                "adaptive-dt per-stage stepper has no split-dtype "
+                "machinery"
+            )
         if not all(b.kind == "edge" for b in self.bcs):
             return self._decline("fused ghost discipline needs edge BCs")
         lshape = (
@@ -345,6 +372,12 @@ class BurgersSolver(SolverBase):
         slab = self._select_slab(mode, lshape)
         if slab is not None:
             return slab
+        if bf16_store:
+            return self._decline(
+                "precision='bf16' Burgers engages only the slab "
+                "whole-run rung (per-stage WENO has no split-dtype "
+                "machinery); the slab declined for this config"
+            )
         if "fused" not in self._cache:
             spacing = self.grid.spacing
             kwargs = {}
@@ -417,10 +450,19 @@ class BurgersSolver(SolverBase):
         pins the slab rung (the k-step communication-avoiding schedule
         lives nowhere else) and turns every decline below into a hard
         error instead of a silent per-stage fallback."""
+        import jax.numpy as jnp
+
         cfg = self.cfg
         k = int(getattr(cfg, "steps_per_exchange", 1) or 1)
         dma = self._exchange_mode() == "dma"
-        pinned = cfg.impl == "pallas_slab" or k > 1 or dma
+        # precision='bf16': the slab rung is Burgers' only fused bf16
+        # path, so bf16 skips the profitability model (engage where
+        # supported; declines stay soft and fall to the generic rung)
+        bf16_store = self._precision_mode() == "bf16"
+        kernel_dtype = (
+            jnp.dtype(jnp.bfloat16) if bf16_store else self.dtype
+        )
+        pinned = cfg.impl == "pallas_slab" or k > 1 or dma or bf16_store
 
         def decline(reason):
             if dma:
@@ -462,10 +504,11 @@ class BurgersSolver(SolverBase):
                     "the CPU interpret simulator); backend="
                     f"{_jax.default_backend()!r}"
                 )
-        if not slab_cls.supported(lshape, self.dtype, order=cfg.weno_order):
+        if not slab_cls.supported(lshape, kernel_dtype,
+                                  order=cfg.weno_order):
             return decline("local shape exceeds the slab VMEM budget")
         if not pinned and not slab_cls.profitable(
-            lshape, self.dtype, order=cfg.weno_order
+            lshape, kernel_dtype, order=cfg.weno_order
         ):
             return None
         if self.mesh is not None and lshape[0] < k * G:
@@ -485,8 +528,10 @@ class BurgersSolver(SolverBase):
                     kwargs["steps_per_exchange"] = k
                 if dma:
                     kwargs.update(self._dma_stepper_kwargs())
+            if jnp.dtype(kernel_dtype) != jnp.dtype(self.dtype):
+                kwargs["storage_dtype"] = self.dtype
             self._cache["fused_slab"] = slab_cls(
-                lshape, self.dtype, self.grid.spacing, self.flux,
+                lshape, kernel_dtype, self.grid.spacing, self.flux,
                 cfg.weno_variant, cfg.nu, dt=self.dt, **kwargs,
             )
         return self._cache["fused_slab"]
@@ -529,6 +574,7 @@ def _cli_build(args, grid, ndim):
         overlap=args.overlap,
         steps_per_exchange=args.steps_per_exchange,
         exchange=args.exchange,
+        precision=getattr(args, "precision", "native"),
     )
 
 

@@ -83,10 +83,20 @@ class DiffusionConfig:
     # interpret simulator). Validated like the impl ladder; "auto"
     # impl lets the measured tuner pick it.
     exchange: str = "collective"
+    # storage precision rung: "native" (state stored at dtype) or
+    # "bf16" (f32 compute state stored/exchanged as bfloat16 — half the
+    # HBM and halo bytes, Kahan-compensated generic loop; requires
+    # dtype='float32'; validated in SolverBase._validate_precision)
+    precision: str = "native"
 
     def __post_init__(self):
         from multigpu_advectiondiffusion_tpu.ops import IMPLS
 
+        if self.precision not in ("native", "bf16"):
+            raise ValueError(
+                f"unknown precision {self.precision!r}; "
+                "'native' or 'bf16'"
+            )
         if self.geometry not in ("cartesian", "axisymmetric"):
             raise ValueError(f"unknown geometry {self.geometry!r}")
         if self.overlap not in ("padded", "split"):
@@ -361,6 +371,23 @@ class DiffusionSolver(SolverBase):
         # boundary (Mosaic has no f64 vector path; accuracy is f32 —
         # PARITY.md). Kernel buffers are f32 either way.
         f64_storage = self.dtype == jnp.dtype("float64")
+        # precision='bf16' is the same convention pointed the other way:
+        # facing/extract dtype stays f32, kernel/HBM buffers (and every
+        # ghost-refresh wire) are bf16 — taps still evaluate in f32 via
+        # the kernels' compute_dtype upcast (ISSUE 16)
+        bf16_store = self._precision_mode() == "bf16"
+        if bf16_store:
+            if self.grid.ndim != 3:
+                return self._decline(
+                    "precision='bf16' fused kernels are 3-D only "
+                    "(2-D whole-run/whole-shard variants lack the "
+                    "split-dtype machinery)"
+                )
+            if cfg.impl == "pallas_step":
+                return self._decline(
+                    "precision='bf16' has no whole-step rung; use the "
+                    "per-stage or slab stepper"
+                )
         if self.dtype == jnp.bfloat16:
             # bf16-storage/f32-compute rung: HBM bytes halved (the
             # ref-grid row is HBM-roof-bound) — 3-D per-stage only.
@@ -400,7 +427,12 @@ class DiffusionSolver(SolverBase):
                 return self._decline(
                     f"a sharded axis is thinner than the O4 halo ({R})"
                 )
-        kernel_dtype = jnp.float32 if f64_storage else self.dtype
+        if f64_storage:
+            kernel_dtype = jnp.float32
+        elif bf16_store:
+            kernel_dtype = jnp.dtype(jnp.bfloat16)
+        else:
+            kernel_dtype = self.dtype
         slab = self._select_slab(mode, lshape, kernel_dtype, f64_storage)
         if slab is not None:
             return slab
@@ -441,7 +473,9 @@ class DiffusionSolver(SolverBase):
                 # schedule (they decline it themselves off-design)
                 kwargs["global_shape"] = self.grid.shape
                 kwargs["overlap_split"] = self._split_overlap_requested()
-            if f64_storage:
+            if jnp.dtype(kernel_dtype) != jnp.dtype(self.dtype):
+                # split-dtype storage, both directions: f64-facing on
+                # f32 kernels, and f32-facing on bf16 kernels
                 kwargs["storage_dtype"] = self.dtype
             self._cache["fused"] = cls(
                 lshape,
@@ -536,7 +570,7 @@ class DiffusionSolver(SolverBase):
                     kwargs["steps_per_exchange"] = k
                 if dma:
                     kwargs.update(self._dma_stepper_kwargs())
-            if f64_storage:
+            if jnp.dtype(kernel_dtype) != jnp.dtype(self.dtype):
                 kwargs["storage_dtype"] = self.dtype
             self._cache["fused_slab"] = slab_cls(
                 lshape,
@@ -641,6 +675,7 @@ def _cli_build(args, grid, ndim, geometry: str = "cartesian"):
         overlap=args.overlap,
         steps_per_exchange=args.steps_per_exchange,
         exchange=args.exchange,
+        precision=getattr(args, "precision", "native"),
     )
 
 

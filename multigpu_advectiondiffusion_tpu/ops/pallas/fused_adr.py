@@ -92,6 +92,7 @@ def _stage_kernel(
     b: float,
     band: int,
     bc_value: float,
+    compute_dtype=None,
 ):
     """One z-block of one ADR RK stage, 2-slot double-buffered (the
     :mod:`fused_diffusion` prefetch/defer choreography: block ``k``
@@ -136,7 +137,16 @@ def _stage_kernel(
         copy_u(k, slot).wait()
     copy_v(k, slot).wait()
 
-    v = vs[slot]
+    # bf16-storage rung (the fused_diffusion convention): the state
+    # lives and moves through HBM at half the bytes; all ADR arithmetic
+    # runs in ``compute_dtype`` (f32) so the stencil taps, upwind
+    # differences and RK accumulation keep their cancellation digits
+    stored = vs[slot]
+    v = (
+        stored
+        if compute_dtype is None
+        else stored.astype(jnp.dtype(compute_dtype))
+    )
     vc = v[R : R + bz]  # stage input, core z-rows, full y/x width
     dtype = v.dtype
     dt = dt_ref[0].astype(dtype)
@@ -198,7 +208,7 @@ def _stage_kernel(
     if lam:
         rhs = rhs - jnp.asarray(lam, dtype) * vc
 
-    u_in = None if us is None else us[slot]
+    u_in = None if us is None else us[slot].astype(dtype)
     rk = (
         b * (vc + dt * rhs)
         if a == 0.0
@@ -220,7 +230,7 @@ def _stage_kernel(
     def _():
         copy_w(k - 2, slot).wait()
 
-    res[slot] = jnp.where(interior, rk, frozen)
+    res[slot] = jnp.where(interior, rk, frozen).astype(stored.dtype)
     copy_w(k, slot).start()
 
     @pl.when(k == n_blocks - 1)
@@ -231,7 +241,8 @@ def _stage_kernel(
 
 
 def _make_stage(padded_shape, interior_shape, dtype, *, bz, a, b,
-                u_source, sharded=False, global_shape=None, **phys):
+                u_source, sharded=False, global_shape=None,
+                compute_dtype=None, **phys):
     """Build one fused ADR RK-stage call; output aliased onto the last
     operand (``u_source`` as in :mod:`fused_diffusion`: "none" /
     "operand" / "target")."""
@@ -246,6 +257,7 @@ def _make_stage(padded_shape, interior_shape, dtype, *, bz, a, b,
         global_shape=tuple(global_shape or interior_shape),
         a=a,
         b=b,
+        compute_dtype=compute_dtype,
         **phys,
     )
 
@@ -327,12 +339,20 @@ class FusedADRStepper(FusedStepperBase):
     def __init__(self, interior_shape, dtype, spacing, diffusivity,
                  velocity, reaction, dt, band, bc_value,
                  kappa_variation: float = 0.0, block_z=None,
-                 global_shape=None):
+                 global_shape=None, storage_dtype=None):
         nz, ny, nx = interior_shape
         self.interior_shape = tuple(interior_shape)
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
         self.dtype = jnp.dtype(dtype)
+        # split-dtype storage, both directions (the fused_diffusion
+        # convention): ``storage_dtype`` is the FACING dtype (embed
+        # downcasts, extract restores); ``dtype`` is the kernel/HBM
+        # buffer dtype. bf16 kernels upcast to f32 for the arithmetic.
+        self._storage = jnp.dtype(storage_dtype or dtype)
+        compute_dtype = (
+            jnp.float32 if self.dtype == jnp.bfloat16 else None
+        )
         self.bc_value = float(bc_value)
         if len(tuple(velocity)) != 3:
             raise ValueError(
@@ -396,6 +416,7 @@ class FusedADRStepper(FusedStepperBase):
                 self.padded_shape, self.interior_shape, self.dtype,
                 bz=bz, a=a, b=b, u_source=src,
                 sharded=self.sharded, global_shape=self.global_shape,
+                compute_dtype=compute_dtype,
                 **phys,
             )
             for (a, b), src in zip(_STAGES, sources)
@@ -421,7 +442,8 @@ class FusedADRStepper(FusedStepperBase):
 
     def extract(self, S):
         nz, ny, nx = self.interior_shape
-        return lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
+        out = lax.slice(S, (R, R, R), (R + nz, R + ny, R + nx))
+        return out.astype(self._storage)
 
     def _dt_value(self, S):
         return jnp.asarray(self.dt, jnp.float32)

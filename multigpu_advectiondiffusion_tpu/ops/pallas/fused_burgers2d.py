@@ -114,6 +114,8 @@ class FusedBurgers2DStepper:
             "ghost_depth": int(self.halo),
             "exchange_depth": None,
             "steps_per_exchange": 1,
+            "storage_dtype": str(jnp.dtype(self.dtype)),
+            "bytes_per_cell": int(jnp.dtype(self.dtype).itemsize),
         }
 
     def __init__(self, interior_shape, dtype, spacing, flux: Flux,

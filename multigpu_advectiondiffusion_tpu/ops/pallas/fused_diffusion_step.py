@@ -169,6 +169,8 @@ class StepFusedDiffusionStepper:
             "ghost_depth": ZGHOST,
             "exchange_depth": None,
             "steps_per_exchange": 1,
+            "storage_dtype": str(jnp.dtype(self.dtype)),
+            "bytes_per_cell": int(jnp.dtype(self.dtype).itemsize),
         }
 
     def __init__(self, interior_shape, dtype, spacing, diffusivity, dt,

@@ -692,6 +692,10 @@ class _SlabRunStepper:
             # halo_verify proves window/disjointness/semaphore pairing
             # against the exchange arithmetic BEFORE any hardware run
             "remote_dma": getattr(self, "remote_dma", None),
+            # HBM/wire storage declaration (halo_verify derives every
+            # declared byte count from it; bf16 rungs carry 2 B/cell)
+            "storage_dtype": str(jnp.dtype(self.dtype)),
+            "bytes_per_cell": int(jnp.dtype(self.dtype).itemsize),
         }
 
     def _dma_block_viable(self, b: int) -> bool:  # pragma: no cover
@@ -1290,9 +1294,11 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
         bz = self.bz = block_z
         nz_eff = nz if self.sharded else -(-nz // bz) * bz
         self.n_slabs = nz_eff // bz
+        # bf16 buffers need the doubled sublane tile (min tile (16, 128))
+        sub = SUBLANE * max(1, 4 // self.dtype.itemsize)
         self.padded_shape = (
             nz_eff + 2 * self.exchange_depth,
-            round_up(ny + 2 * R, SUBLANE),
+            round_up(ny + 2 * R, sub),
             round_up(nx + 2 * R, LANE),
         )
         self.core_offsets = (self.exchange_depth, R, R)
@@ -1335,6 +1341,17 @@ class SlabRunDiffusionStepper(_SlabRunStepper):
                        gz0=base_z + 2 * R, a=a2, b=b2)
             return stage(t2, v[3 * R: w - 3 * R],
                          gz0=base_z + 3 * R, a=a3, b=b3)
+
+        if self.dtype == jnp.bfloat16:
+            # bf16-storage/f32-compute (ISSUE 16): the slab buffers (and
+            # every wire byte) stay bf16; each slab upcasts once, runs
+            # the three RK stages in f32, and downcasts the core rows
+            inner = step_fn
+
+            def step_fn(v, base_z):
+                return inner(
+                    v.astype(jnp.float32), base_z
+                ).astype(jnp.bfloat16)
 
         self._step_fn = step_fn
         self._init_exchange(exchange, mesh_axis, num_shards)
@@ -1452,7 +1469,7 @@ class SlabRunBurgersStepper(_SlabRunStepper):
                  global_shape=None, overlap_split: bool = False,
                  order: int = 5, steps_per_exchange: int = 1,
                  members: int = 1, exchange: str = "collective",
-                 mesh_axis=None, num_shards=None):
+                 mesh_axis=None, num_shards=None, storage_dtype=None):
         if order not in HALO:
             raise ValueError(f"unsupported WENO order {order}")
         if order == 7 and variant != "js":
@@ -1467,7 +1484,10 @@ class SlabRunBurgersStepper(_SlabRunStepper):
         self.global_shape = tuple(global_shape or interior_shape)
         self.sharded = self.global_shape != self.interior_shape
         self.dtype = jnp.dtype(dtype)
-        self._storage = self.dtype
+        # storage_dtype is the FACING dtype (the fused-stepper
+        # convention): extract restores it; bf16 kernel buffers under
+        # precision='bf16' face an f32 state
+        self._storage = jnp.dtype(storage_dtype or dtype)
         self.members = self._check_members(members)
         k = _check_steps_per_exchange(steps_per_exchange, self.sharded,
                                       nz, G)
@@ -1487,9 +1507,11 @@ class SlabRunBurgersStepper(_SlabRunStepper):
             raise ValueError(f"block_z={block_z} must divide nz={nz}")
         bz = self.bz = block_z
         self.n_slabs = nz // bz
+        # bf16 buffers need the doubled sublane tile (min tile (16, 128))
+        sub = SUBLANE * max(1, 4 // self.dtype.itemsize)
         self.padded_shape = (
             nz + 2 * self.exchange_depth,
-            round_up(ny + 2 * r, SUBLANE),
+            round_up(ny + 2 * r, sub),
             round_up(nx + 2 * r, LANE),
         )
         self.r = r
@@ -1607,6 +1629,17 @@ class SlabRunBurgersStepper(_SlabRunStepper):
             return stage(v[G: w - G], t2, a3, b3, bw,
                          base_z + G, "dyn" if deep else None, d)
 
+        if self.dtype == jnp.bfloat16:
+            # bf16-storage/f32-compute (ISSUE 16): slab buffers and
+            # wire bytes stay bf16; the WENO reconstruction and RK
+            # stages run in f32 per slab
+            inner = step_fn
+
+            def step_fn(v, base_z):
+                return inner(
+                    v.astype(jnp.float32), base_z
+                ).astype(jnp.bfloat16)
+
         self._step_fn = step_fn
         self._init_exchange(exchange, mesh_axis, num_shards)
         if self.sharded and self.exchange != "dma":
@@ -1663,4 +1696,5 @@ class SlabRunBurgersStepper(_SlabRunStepper):
     def extract(self, S):
         d, r = self.exchange_depth, self.r
         nz, ny, nx = self.interior_shape
-        return lax.slice(S, (d, r, r), (d + nz, r + ny, r + nx))
+        out = lax.slice(S, (d, r, r), (d + nz, r + ny, r + nx))
+        return out.astype(self._storage)

@@ -95,11 +95,17 @@ class FusedStepperBase:
         per ghost refresh), ``ghost_depth`` (rows refreshed per
         exchange site, ``>= fused_stages * h``), ``exchange_depth``
         (rows ppermuted per exchange, ``k * ghost_depth``; None for
-        single-chip-only steppers), ``steps_per_exchange`` (k), and
+        single-chip-only steppers), ``steps_per_exchange`` (k),
         ``remote_dma`` (the declared in-kernel exchange window, None
         while the exchange rides XLA collectives — see the class
-        attribute)."""
+        attribute), and the storage declaration (ISSUE 16):
+        ``storage_dtype`` is the HBM-resident buffer dtype — the dtype
+        every halo/DMA wire byte carries — and ``bytes_per_cell`` its
+        itemsize, from which the verifier derives every declared byte
+        count (f64-facing states run f32 buffers; ``precision='bf16'``
+        runs bf16 buffers at 2 B/cell)."""
         h = int(self.stencil_radius or self.halo)
+        buf = jnp.dtype(self.dtype)
         return {
             "kernel": self.engaged_label,
             "stage_radius": h,
@@ -112,6 +118,8 @@ class FusedStepperBase:
                 getattr(self, "steps_per_exchange", 1) or 1
             ),
             "remote_dma": getattr(self, "remote_dma", None),
+            "storage_dtype": str(buf),
+            "bytes_per_cell": int(buf.itemsize),
         }
 
     def _dt_value(self, S):

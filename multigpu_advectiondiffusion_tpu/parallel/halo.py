@@ -111,6 +111,7 @@ def exchange_ghosts(
     num_shards: int,
     bc: Boundary,
     repeats: int = 1,
+    wire_dtype=None,
 ):
     """The two ``ppermute`` shifts of a halo exchange, returned as the
     ``(lo, hi)`` ghost slabs without concatenating onto ``u``.
@@ -129,13 +130,24 @@ def exchange_ghosts(
     this trace site per run (e.g. the loop trip count when the exchange
     sits inside a ``fori_loop`` body), so ``halo.bytes_per_execution``
     reports true bytes moved instead of one trace-site's worth.
+
+    ``wire_dtype`` (ISSUE 16, the bf16-storage rung): when set to a
+    narrower dtype than ``u``, ONLY the exchanged ghost slabs are
+    down-cast before the ``ppermute`` and up-cast on receipt — the
+    interior never leaves ``u.dtype``. BC ghosts on global-edge shards
+    take the same round trip so edge shards see the same declared
+    storage rounding as interior shards. Byte counters report the wire
+    dtype's (halved) payload.
     """
     n_local = u.shape[axis]
     if n_local < halo:
         raise ValueError(
             f"shard of {n_local} cells can't serve a halo of {halo} on axis {axis}"
         )
-    _record_exchange(u, axis, halo, mesh_axis, repeats)
+    wire = None if wire_dtype is None else jnp.dtype(wire_dtype)
+    if wire == jnp.dtype(u.dtype):
+        wire = None
+    _record_exchange(u, axis, halo, mesh_axis, repeats, wire_dtype=wire)
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [((i + 1) % num_shards, i) for i in range(num_shards)]
     # left halo <- left neighbor's rightmost cells; right halo <- right
@@ -143,25 +155,33 @@ def exchange_ghosts(
     # named_scope: the two shifts appear as one labeled region per axis
     # in --trace captures, under the enclosing stepper span
     with jax.named_scope(f"tpucfd.halo_exchange_ax{axis}"):
-        from_left = lax.ppermute(
-            slice_axis(u, axis, n_local - halo, n_local), mesh_axis, fwd
-        )
-        from_right = lax.ppermute(slice_axis(u, axis, 0, halo), mesh_axis, bwd)
+        send_hi = slice_axis(u, axis, n_local - halo, n_local)
+        send_lo = slice_axis(u, axis, 0, halo)
+        if wire is not None:
+            send_hi = send_hi.astype(wire)
+            send_lo = send_lo.astype(wire)
+        from_left = lax.ppermute(send_hi, mesh_axis, fwd)
+        from_right = lax.ppermute(send_lo, mesh_axis, bwd)
         if bc.kind != "periodic":
             idx = lax.axis_index(mesh_axis)
-            from_left = jnp.where(
-                idx == 0, boundary_halo(u, axis, halo, bc, "left"), from_left
-            )
+            bc_left = boundary_halo(u, axis, halo, bc, "left")
+            bc_right = boundary_halo(u, axis, halo, bc, "right")
+            if wire is not None:
+                bc_left = bc_left.astype(wire)
+                bc_right = bc_right.astype(wire)
+            from_left = jnp.where(idx == 0, bc_left, from_left)
             from_right = jnp.where(
-                idx == num_shards - 1,
-                boundary_halo(u, axis, halo, bc, "right"),
-                from_right,
+                idx == num_shards - 1, bc_right, from_right
             )
+        if wire is not None:
+            from_left = from_left.astype(u.dtype)
+            from_right = from_right.astype(u.dtype)
         return from_left, from_right
 
 
 def _record_exchange(
-    u, axis: int, halo: int, mesh_axis: str, repeats: int = 1
+    u, axis: int, halo: int, mesh_axis: str, repeats: int = 1,
+    wire_dtype=None,
 ) -> None:
     """Telemetry record of one halo exchange *site*.
 
@@ -183,7 +203,12 @@ def _record_exchange(
     slab = 1
     for ax, n in enumerate(u.shape):
         slab *= halo if ax == axis else int(n)
-    nbytes = 2 * slab * jnp.dtype(u.dtype).itemsize
+    # wire_dtype: the bf16-storage rung moves ghost slabs down-cast on
+    # the wire — the payload is the wire dtype's itemsize, not the
+    # resident block's (ISSUE 16)
+    item = jnp.dtype(wire_dtype if wire_dtype is not None
+                     else u.dtype).itemsize
+    nbytes = 2 * slab * item
     sink.counter(
         "halo.exchanges_traced", 1, axis=axis, mesh_axis=mesh_axis
     )
@@ -200,6 +225,7 @@ def exchange_axis(
     mesh_axis: str,
     num_shards: int,
     bc: Boundary,
+    wire_dtype=None,
 ) -> jnp.ndarray:
     """Pad one axis of a shard-local block with neighbor (or BC) ghost cells.
 
@@ -208,7 +234,7 @@ def exchange_axis(
     wrapped block with BC ghosts (Dirichlet fill or edge replication).
     """
     from_left, from_right = exchange_ghosts(
-        u, axis, halo, mesh_axis, num_shards, bc
+        u, axis, halo, mesh_axis, num_shards, bc, wire_dtype=wire_dtype
     )
     return jnp.concatenate([from_left, u, from_right], axis=axis)
 
@@ -217,16 +243,20 @@ def make_padder(
     decomp: Decomposition,
     mesh_axis_sizes: Dict[str, int],
     bcs: Sequence[Boundary],
+    wire_dtype=None,
 ) -> Padder:
     """Padder closure for use inside ``shard_map``: ppermute on sharded
-    axes, plain BC padding on local axes."""
+    axes, plain BC padding on local axes. ``wire_dtype`` down-casts only
+    the exchanged ghost slabs on the wire (see
+    :func:`exchange_ghosts`)."""
 
     def padder(u: jnp.ndarray, axis: int, halo: int) -> jnp.ndarray:
         name = decomp.mesh_axis(axis)
         if name is None or axis_extent(mesh_axis_sizes, name) == 1:
             return pad_axis(u, axis, halo, bcs[axis])
         return exchange_axis(
-            u, axis, halo, name, axis_extent(mesh_axis_sizes, name), bcs[axis]
+            u, axis, halo, name, axis_extent(mesh_axis_sizes, name),
+            bcs[axis], wire_dtype=wire_dtype,
         )
 
     return padder
@@ -236,17 +266,21 @@ def make_ghost_fn(
     decomp: Decomposition,
     mesh_axis_sizes: Dict[str, int],
     bcs: Sequence[Boundary],
+    wire_dtype=None,
 ):
     """Ghost-slab closure for the overlapped schedule: returns
     ``(lo, hi)`` for sharded axes, ``None`` for local axes (whose ghosts
-    are plain BC padding with nothing to overlap)."""
+    are plain BC padding with nothing to overlap). ``wire_dtype``
+    down-casts only the exchanged slabs on the wire (see
+    :func:`exchange_ghosts`)."""
 
     def ghost_fn(u: jnp.ndarray, axis: int, halo: int):
         name = decomp.mesh_axis(axis)
         if name is None or axis_extent(mesh_axis_sizes, name) == 1:
             return None
         return exchange_ghosts(
-            u, axis, halo, name, axis_extent(mesh_axis_sizes, name), bcs[axis]
+            u, axis, halo, name, axis_extent(mesh_axis_sizes, name),
+            bcs[axis], wire_dtype=wire_dtype,
         )
 
     return ghost_fn

@@ -398,11 +398,16 @@ def solver_step_cost(solver, stepper: str) -> Optional[StepCost]:
     if kind is None:
         return None
     kwargs = solver_cost_kwargs(cfg)
+    # HBM passes are priced at the STORAGE dtype — what actually sits
+    # in (and streams from) HBM: the precision='bf16' rung pays
+    # 2 B/cell, not the facing f32's 4
+    # (models/base.SolverBase.storage_dtype)
+    storage = getattr(solver, "storage_dtype", solver.dtype)
     try:
         return step_cost(
             kind,
             cfg.grid.shape,
-            np.dtype(solver.dtype).itemsize,
+            np.dtype(storage).itemsize,
             stepper,
             stages=STAGES[cfg.integrator],
             **kwargs,

@@ -48,6 +48,15 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     },
     # the science regression gate's verdict (diagnostics/compare.py)
     "science": {"gate": {"ok", "regressions", "rows"}},
+    # low-precision storage rung (models/base._validate_precision,
+    # ISSUE 16): one event per solver constructed with
+    # precision='bf16' — records the storage/compute dtype split and
+    # whether the generic loop's compensation carry is armed
+    # (core.dtypes.bf16_carry_enabled), so a carry-off run is visible
+    # in the stream, not just in its error norms
+    "precision": {
+        "engage": {"storage_dtype", "compute_dtype", "carry"},
+    },
     "resilience": {
         "sentinel_armed": {"cadence", "growth"},
         "rollback": {"retry", "step", "rollback_to_it", "action"},

@@ -143,11 +143,23 @@ def dispatch_key(solver, program_key, steps=None) -> str:
         phys = _physics_fingerprint(solver.cfg)
     except Exception:  # noqa: BLE001 — an unkeyable config just misses
         phys = "?"
+    # storage dtype + compensation carry (ISSUE 16): the tuner key
+    # (``base``) already separates precision modes, but the carry
+    # toggle (core.dtypes.bf16_carry_enabled) changes the compiled
+    # generic-loop program WITHOUT changing the config — an entry
+    # compiled carry-on must never be served to a carry-off process
+    storage = getattr(solver, "storage_dtype", None)
+    storage = str(storage) if storage is not None else str(
+        getattr(solver.cfg, "dtype", "?")
+    )
+    carry = int(bool(getattr(solver, "_bf16_carry", True)))
     return "|".join([
         base,
         f"impl={getattr(solver.cfg, 'impl', 'xla')}",
         f"k={int(getattr(solver.cfg, 'steps_per_exchange', 1) or 1)}",
         f"ex={getattr(solver.cfg, 'exchange', 'collective')}",
+        f"storage={storage}",
+        f"carry={carry}",
         f"phys={phys}",
         f"prog={program_key}",
         f"steps={steps}",

@@ -97,6 +97,10 @@ def make_key(solver_cls, cfg, mesh, decomp, backend: str,
         kind,
         f"shape={shape}",
         f"dtype={cfg.dtype}",
+        # storage precision (ISSUE 16): a bf16-storage decision (half
+        # the HBM/wire bytes — different winning rung economics) must
+        # never be served to a native-precision run, and vice versa
+        f"prec={getattr(cfg, 'precision', 'native') or 'native'}",
         f"integ={cfg.integrator}",
         f"overlap={getattr(cfg, 'overlap', None)}",
         _mesh_tokens(mesh, decomp),

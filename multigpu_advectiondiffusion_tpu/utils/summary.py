@@ -130,6 +130,11 @@ class RunSummary:
                 line += f", steps/exchange={e['steps_per_exchange']}"
             if e.get("exchange", "collective") != "collective":
                 line += f", exchange={e['exchange']}"
+            if e.get("precision", "native") != "native":
+                line += (
+                    f", precision={e['precision']}"
+                    f" [storage {e.get('storage_dtype')}]"
+                )
             line += ")"
             print(f" kernel path        : {line}")
             if e.get("tuned"):

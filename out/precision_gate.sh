@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# Precision regression gate: run the canonical diagnostic round at the
+# bf16 storage rung (--precision bf16: HBM state and every halo wire
+# byte in bfloat16, all arithmetic in f32 with compensated accumulation
+# on the generic path) and diff its observable trajectories against the
+# newest archived bf16 round (PRECISION_r0*.json) with
+# diagnostics/compare.py's PER-STORAGE-DTYPE tolerance bands — the runs'
+# meta carries storage_dtype=bfloat16, so the gate judges them against
+# the wider bf16 bands, not f32's. Nonzero exit on drift beyond those
+# bands: a numerics change that the bandwidth rung can't absorb (a
+# dropped compensation carry, a downcast moved inside the RK loop)
+# trips THIS gate even while out/science_gate.sh's native round stays
+# green.
+#
+#   ./out/precision_gate.sh                 # fresh bf16 round vs newest PRECISION_r0*.json
+#   ./out/precision_gate.sh NEW.json        # gate an existing artifact
+#   ./out/precision_gate.sh NEW.json PRIOR  # explicit prior round
+#   ./out/precision_gate.sh --record OUT    # run the round, archive the artifact
+#   ./out/precision_gate.sh --selftest      # prove an unmodified bf16 round
+#                                           # PASSES and a carry-off round
+#                                           # (TPUCFD_BF16_NO_CARRY=1 — plain
+#                                           # bf16 accumulation, no hi/lo
+#                                           # compensation) FAILS
+#
+# Runs on the virtual CPU backend (no TPU needed), same as tier-1.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+newest_round() {
+  ls PRECISION_r0*.json 2>/dev/null | sort | tail -1
+}
+
+# run_round OUT.json — the canonical bf16 diagnostic round: the same
+# supervised diffusion3d + burgers1d solves as out/science_gate.sh, at
+# --precision bf16. Longer horizons than the science round on purpose:
+# the compensation carry's value is cumulative, so the carry-off
+# self-test needs enough steps for uncompensated rounding to leave the
+# bf16 bands. TPUCFD_BF16_NO_CARRY=1 in the environment is the
+# self-test's injection point (core.dtypes.bf16_carry_enabled).
+run_round() {
+  local out="$1"
+  local tmp
+  tmp="$(mktemp -d)"
+  python -m multigpu_advectiondiffusion_tpu.cli diffusion3d \
+    --n 16 14 12 --iters 120 --precision bf16 \
+    --sentinel-every 10 --diag-every 2 --save "$tmp/d3" >/dev/null
+  python -m multigpu_advectiondiffusion_tpu.cli burgers1d \
+    --n 128 --iters 120 --fixed-dt --precision bf16 \
+    --sentinel-every 10 --diag-every 2 --save "$tmp/b1" >/dev/null
+  python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \
+    --extract "$tmp/d3/summary.json" "$tmp/b1/summary.json" -o "$out"
+  rm -rf "$tmp"
+}
+
+if [[ "${1:-}" == "--record" ]]; then
+  OUT="${2:?usage: precision_gate.sh --record OUT.json}"
+  run_round "$OUT"
+  exit 0
+fi
+
+if [[ "${1:-}" == "--selftest" ]]; then
+  TMP="$(mktemp -d)"
+  trap 'rm -rf "$TMP"' EXIT
+  echo "precision_gate selftest: recording the reference bf16 round"
+  run_round "$TMP/base.json"
+  echo "precision_gate selftest: an unmodified bf16 round must PASS"
+  run_round "$TMP/clean.json"
+  python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \
+    "$TMP/clean.json" "$TMP/base.json"
+  echo "precision_gate selftest: a carry-off round (TPUCFD_BF16_NO_CARRY=1) must FAIL"
+  TPUCFD_BF16_NO_CARRY=1 run_round "$TMP/nocarry.json"
+  if python -m multigpu_advectiondiffusion_tpu.diagnostics.compare \
+      "$TMP/nocarry.json" "$TMP/base.json"; then
+    echo "precision_gate selftest: gate FAILED to trip with the compensation carry disabled" >&2
+    exit 1
+  fi
+  echo "precision_gate selftest: OK (gate trips carry-off, passes unmodified)"
+  exit 0
+fi
+
+if [[ -n "${1:-}" ]]; then
+  NEW="$1"
+else
+  NEW="$(mktemp -d)/precision_new.json"
+  echo "precision_gate: running the canonical bf16 diagnostic round"
+  run_round "$NEW"
+fi
+PRIOR="${2:-$(newest_round)}"
+[[ -n "$PRIOR" ]] || { echo "precision_gate: no PRECISION_r0*.json prior round found (record one with --record PRECISION_r01.json)" >&2; exit 1; }
+echo "precision_gate: $NEW vs $PRIOR"
+exec python -m multigpu_advectiondiffusion_tpu.diagnostics.compare "$NEW" "$PRIOR"

@@ -1,0 +1,406 @@
+"""The bf16-storage / f32-compute bandwidth rung (``precision='bf16'``,
+ISSUE 16).
+
+The rung's contract, each clause proven here:
+
+* state LIVES in bfloat16 (HBM buffers, every halo wire byte) while
+  every stencil tap and RK stage computes in float32 — the facing state
+  stays f32 and tracks the native run closely;
+* the generic-XLA loop carries a Kahan-style hi/lo compensation term,
+  and that term is what keeps long-horizon error bounded: with the
+  carry disabled (``TPUCFD_BF16_NO_CARRY=1``, the precision-gate
+  selftest's injection point) per-step increments round away at the
+  bf16 ulp and the error grows with the horizon;
+* sharded runs move HALF the halo bytes (the counters prove the exact
+  0.5 ratio);
+* every fused stepper declares its storage dtype + bytes-per-cell and
+  ``analysis.halo_verify`` refuses a spec that doesn't;
+* ineligible configs decline LOUDLY (wrong dtype, adaptive-dt Burgers,
+  ensembles) instead of silently running native storage;
+* the science gate (diagnostics/compare) judges bf16 rounds against
+  per-storage-dtype tolerance bands, with explicit ``--band`` overrides
+  still winning.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import pytest
+
+from multigpu_advectiondiffusion_tpu import (
+    BurgersConfig,
+    BurgersSolver,
+    DiffusionConfig,
+    DiffusionSolver,
+    Grid,
+    telemetry,
+)
+from multigpu_advectiondiffusion_tpu.core.dtypes import bf16_carry_enabled
+from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+    Decomposition,
+    make_mesh,
+)
+
+
+def _diff_cfg(impl="xla", precision="bf16", n=(16, 14, 12), **kw):
+    grid = Grid.make(*n, lengths=10.0)
+    return DiffusionConfig(
+        grid=grid, dtype="float32", impl=impl, precision=precision, **kw
+    )
+
+
+def _rel_l2(a, b):
+    a = jnp.asarray(a, jnp.float32).ravel()
+    b = jnp.asarray(b, jnp.float32).ravel()
+    return float(jnp.linalg.norm(a - b) / jnp.linalg.norm(b))
+
+
+# --------------------------------------------------------------------- #
+# Carry toggle + hi/lo split in isolation
+# --------------------------------------------------------------------- #
+def test_carry_toggle_env(monkeypatch):
+    monkeypatch.delenv("TPUCFD_BF16_NO_CARRY", raising=False)
+    assert bf16_carry_enabled()
+    for val in ("1", "true", "YES"):
+        monkeypatch.setenv("TPUCFD_BF16_NO_CARRY", val)
+        assert not bf16_carry_enabled()
+    monkeypatch.setenv("TPUCFD_BF16_NO_CARRY", "0")
+    assert bf16_carry_enabled()
+
+
+def test_pack_roundtrip_beats_plain_downcast(monkeypatch):
+    """``hi`` is exactly the bf16 downcast (so a wire transfer of the
+    packed state moves precisely the declared bf16 bytes) and the
+    carry's reconstruction is strictly closer to the f32 state than the
+    plain downcast."""
+    monkeypatch.delenv("TPUCFD_BF16_NO_CARRY", raising=False)
+    solver = DiffusionSolver(_diff_cfg())
+    u = solver.initial_state().u + 1.2345e-3  # off bf16-representable values
+    packed = solver._bf16_pack(u)
+    assert len(packed) == 2  # (hi, lo) with the carry armed
+    hi, lo = packed
+    assert hi.dtype == jnp.bfloat16 and lo.dtype == jnp.bfloat16
+    assert jnp.array_equal(hi, u.astype(jnp.bfloat16))
+    err_comp = _rel_l2(solver._bf16_unpack(packed), u)
+    err_plain = _rel_l2(hi.astype(jnp.float32), u)
+    assert err_comp < 0.25 * err_plain
+
+    monkeypatch.setenv("TPUCFD_BF16_NO_CARRY", "1")
+    bare = DiffusionSolver(_diff_cfg())
+    packed = bare._bf16_pack(u)
+    assert len(packed) == 1  # carry-off: plain downcast only
+    assert jnp.array_equal(
+        bare._bf16_unpack(packed), u.astype(jnp.bfloat16).astype(jnp.float32)
+    )
+
+
+def test_compensated_accumulation_bounded(monkeypatch):
+    """THE rung's numerical claim, in isolation on the generic loop:
+    vs the native-f32 trajectory, the compensated bf16 run's error
+    stays at a few bf16 round-offs and barely grows with the horizon,
+    while the uncompensated run's error is orders of magnitude larger
+    AND grows with the step count (small per-step increments round
+    away at the bf16 ulp without the carry)."""
+    monkeypatch.delenv("TPUCFD_BF16_NO_CARRY", raising=False)
+    cfg32 = _diff_cfg(precision="native")
+    cfg16 = dataclasses.replace(cfg32, precision="bf16")
+
+    def run(cfg, iters):
+        s = DiffusionSolver(cfg)
+        return s.run(s.initial_state(), iters).u
+
+    errs = {}
+    for iters in (60, 120):
+        ref = run(cfg32, iters)
+        monkeypatch.delenv("TPUCFD_BF16_NO_CARRY", raising=False)
+        carry = _rel_l2(run(cfg16, iters), ref)
+        monkeypatch.setenv("TPUCFD_BF16_NO_CARRY", "1")
+        nocarry = _rel_l2(run(cfg16, iters), ref)
+        errs[iters] = (carry, nocarry)
+        # compensated: bounded at a few bf16 ulps (measured ~6e-6)
+        assert carry < 1e-4, (iters, carry)
+        # uncompensated: dominated by accumulation stall (measured
+        # ~7e-3 at 60 steps, ~1.7e-2 at 120)
+        assert nocarry > 20 * carry, (iters, carry, nocarry)
+    # ...and GROWING with the horizon, unlike the compensated error
+    assert errs[120][1] > 1.5 * errs[60][1]
+
+
+# --------------------------------------------------------------------- #
+# Eligibility gates — loud declines, never silent native storage
+# --------------------------------------------------------------------- #
+def test_validation_rejects_ineligible_dtypes():
+    with pytest.raises(ValueError, match="redundant"):
+        DiffusionSolver(
+            dataclasses.replace(_diff_cfg(), dtype="bfloat16")
+        )
+    with pytest.raises(ValueError, match="must be float32"):
+        DiffusionSolver(dataclasses.replace(_diff_cfg(), dtype="float64"))
+    with pytest.raises(ValueError, match="precision"):
+        _diff_cfg(precision="fp8")
+
+
+def test_burgers_bf16_needs_fixed_dt_and_engages_slab():
+    grid = Grid.make(32, 24, 16, lengths=(2.0, 2.0, 2.0))
+    # adaptive dt: the fused rungs decline LOUDLY (the per-stage WENO
+    # kernel has no split-dtype machinery) and the storage split rides
+    # the generic loop around the per-axis ops instead
+    adaptive = BurgersSolver(
+        BurgersConfig(grid=grid, dtype="float32", impl="pallas",
+                      precision="bf16", adaptive_dt=True, nu=1e-5)
+    )
+    engaged = adaptive.engaged_path()
+    assert "slab" not in engaged["stepper"]
+    assert "--fixed-dt" in (engaged["fallback"] or "")
+    # fixed dt: Burgers' only fused bf16 rung, the whole-run slab
+    # program, engages
+    solver = BurgersSolver(
+        BurgersConfig(grid=grid, dtype="float32", impl="pallas",
+                      precision="bf16", adaptive_dt=False, nu=1e-5)
+    )
+    engaged = solver.engaged_path()
+    assert "slab" in engaged["stepper"]
+    assert engaged["precision"] == "bf16"
+    assert engaged["storage_dtype"] == "bfloat16"
+
+
+def test_ensemble_declines_bf16():
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+
+    with pytest.raises(ValueError, match="single-run rung"):
+        es = EnsembleSolver(DiffusionSolver, _diff_cfg(), 2)
+        es.run(es.initial_state(), 1)
+
+
+# --------------------------------------------------------------------- #
+# Engagement facts: engaged_path, telemetry, keys
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("impl", ["xla", "pallas", "pallas_slab"])
+def test_engaged_path_reports_storage_split(impl):
+    solver = DiffusionSolver(_diff_cfg(impl=impl))
+    engaged = solver.engaged_path()
+    assert engaged["precision"] == "bf16"
+    assert engaged["storage_dtype"] == "bfloat16"
+    # the FACING state stays f32 and tracks the native run closely
+    out = solver.run(solver.initial_state(), 5)
+    assert out.u.dtype == jnp.float32
+    native = DiffusionSolver(_diff_cfg(impl=impl, precision="native"))
+    ref = native.run(native.initial_state(), 5)
+    assert _rel_l2(out.u, ref.u) < 2e-2
+
+
+def test_precision_engage_event_emitted(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with telemetry.capture(path):
+        DiffusionSolver(_diff_cfg())
+    import json
+
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    engage = [e for e in events
+              if e.get("kind") == "precision" and e.get("name") == "engage"]
+    assert engage, "precision:engage event missing from the stream"
+    assert engage[0]["storage_dtype"] == "bfloat16"
+    assert engage[0]["compute_dtype"] == "float32"
+    assert engage[0]["carry"] is True
+
+
+def test_keys_fingerprint_storage_and_carry(monkeypatch):
+    """A bf16 tuner decision must never serve a native run; an AOT
+    entry compiled carry-on must never serve a carry-off process."""
+    import jax
+
+    from multigpu_advectiondiffusion_tpu.tuning.aot_cache import (
+        dispatch_key,
+    )
+    from multigpu_advectiondiffusion_tpu.tuning.autotuner import make_key
+
+    cfg16, cfg32 = _diff_cfg(), _diff_cfg(precision="native")
+    backend = jax.default_backend()
+    k16 = make_key(DiffusionSolver, cfg16, None, None, backend)
+    k32 = make_key(DiffusionSolver, cfg32, None, None, backend)
+    assert "prec=bf16" in k16 and "prec=native" in k32
+    assert k16 != k32
+
+    monkeypatch.delenv("TPUCFD_BF16_NO_CARRY", raising=False)
+    on = dispatch_key(DiffusionSolver(cfg16), "run")
+    monkeypatch.setenv("TPUCFD_BF16_NO_CARRY", "1")
+    off = dispatch_key(DiffusionSolver(cfg16), "run")
+    assert "storage=bfloat16" in on
+    assert on != off  # the carry toggle is a first-class key dimension
+
+
+def test_cost_model_prices_storage_bytes():
+    """HBM passes are priced at the STORAGE itemsize: the bf16 rung's
+    modeled bytes/step are half the native model's."""
+    from multigpu_advectiondiffusion_tpu.telemetry.costmodel import (
+        solver_step_cost,
+    )
+
+    s16 = DiffusionSolver(_diff_cfg())
+    s32 = DiffusionSolver(_diff_cfg(precision="native"))
+    stepper = s16.engaged_path()["stepper"]
+    b16 = solver_step_cost(s16, stepper).hbm_bytes
+    b32 = solver_step_cost(s32, stepper).hbm_bytes
+    assert b16 == 0.5 * b32, (b16, b32)
+
+
+# --------------------------------------------------------------------- #
+# Wire bytes: sharded halo traffic halves exactly
+# --------------------------------------------------------------------- #
+def _halo_bytes(cfg, devices):
+    mesh = make_mesh({"dz": 4}, devices=devices[:4])
+    solver = DiffusionSolver(cfg, mesh=mesh, decomp=Decomposition.slab("dz"))
+    import json
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = f"{tmp}/events.jsonl"
+        with telemetry.capture(path):
+            solver.run(solver.initial_state(), 2)
+        events = [json.loads(l) for l in open(path) if l.strip()]
+    return sum(
+        e.get("inc", 0)
+        for e in events
+        if e.get("kind") == "counter"
+        and e.get("name") == "halo.bytes_per_execution"
+    ), solver
+
+
+def test_sharded_halo_bytes_halved(devices):
+    """Ghost slabs cross the wire at the storage dtype: the traced
+    halo byte counters of the bf16 run are EXACTLY half the native
+    run's, and the sharded bf16 result still tracks native f32."""
+    b16, s16 = _halo_bytes(_diff_cfg(), devices)
+    b32, s32 = _halo_bytes(_diff_cfg(precision="native"), devices)
+    assert b32 > 0
+    assert b16 == 0.5 * b32, (b16, b32)
+    out16 = s16.run(s16.initial_state(), 10)
+    out32 = s32.run(s32.initial_state(), 10)
+    assert _rel_l2(out16.u, out32.u) < 2e-2
+
+
+# --------------------------------------------------------------------- #
+# Storage-declaration proofs (analysis.halo_verify)
+# --------------------------------------------------------------------- #
+def test_stencil_spec_declares_storage_and_verifies():
+    from multigpu_advectiondiffusion_tpu.analysis.halo_verify import (
+        verify_stepper,
+    )
+    from multigpu_advectiondiffusion_tpu.ops.pallas.fused_diffusion import (
+        FusedDiffusionStepper,
+    )
+
+    def make(dtype, **kw):
+        return FusedDiffusionStepper(
+            (24, 10, 12), dtype, (0.1,) * 3, [1.0] * 3, 1e-4, 2, 0.0,
+            **kw,
+        )
+
+    stepper = make(jnp.bfloat16, storage_dtype=jnp.float32)
+    spec = stepper.stencil_spec()
+    assert spec["storage_dtype"] == "bfloat16"
+    assert spec["bytes_per_cell"] == 2
+    assert verify_stepper(stepper) == []
+
+    # a spec that hides its storage dtype is REFUSED, and a lying
+    # bytes-per-cell is caught against the dtype's itemsize
+    class Undeclared(FusedDiffusionStepper):
+        def stencil_spec(self):
+            spec = dict(super().stencil_spec())
+            spec.pop("storage_dtype")
+            spec.pop("bytes_per_cell")
+            return spec
+
+    class Lying(FusedDiffusionStepper):
+        def stencil_spec(self):
+            return dict(super().stencil_spec(), bytes_per_cell=2)
+
+    bad = verify_stepper(
+        Undeclared((24, 10, 12), jnp.float32, (0.1,) * 3, [1.0] * 3,
+                   1e-4, 2, 0.0)
+    )
+    assert any("storage_dtype" in v.what for v in bad)
+    bad = verify_stepper(
+        Lying((24, 10, 12), jnp.float32, (0.1,) * 3, [1.0] * 3,
+              1e-4, 2, 0.0)
+    )
+    assert any("bytes_per_cell" in v.what for v in bad)
+
+
+def test_halo_verify_battery_covers_bf16():
+    """The full battery registers the bf16 combos (per-stage diffusion
+    and ADR, slab diffusion/Burgers incl. the dma rung) — count
+    enforced by EXPECTED_FAMILY_COMBOS, presence by name here."""
+    from multigpu_advectiondiffusion_tpu.analysis import halo_verify
+
+    names = {c.name for c in halo_verify.default_combos()}
+    for expected in (
+        "diffusion3d-stage[bf16]",
+        "slab-diffusion[bf16]",
+        "slab-diffusion[bf16,dma]",
+        "slab-burgers[o5,bf16]",
+        "adr3d-stage[bf16]",
+    ):
+        assert expected in names, expected
+
+
+# --------------------------------------------------------------------- #
+# Science gate: per-storage-dtype tolerance bands
+# --------------------------------------------------------------------- #
+def _round(dev, storage=None):
+    meta = {"solver": "DiffusionSolver"}
+    if storage:
+        meta["storage_dtype"] = storage
+    return {
+        "schema": 1,
+        "runs": {
+            "r": {
+                "meta": meta,
+                "observables": {
+                    "l2": [[10, 1.0], [20, 1.0 + dev]],
+                    "time": [[10, 0.5], [20, 0.5]],
+                },
+            }
+        },
+    }
+
+
+def test_compare_gate_uses_per_dtype_bands():
+    from multigpu_advectiondiffusion_tpu.diagnostics import compare as C
+
+    # a 5e-3 l2 deviation: DRIFT at f32 bands, ok at bf16 bands
+    assert not C.compare(_round(5e-3), _round(0.0)).ok
+    res = C.compare(_round(5e-3, "bfloat16"), _round(0.0, "bfloat16"))
+    assert res.ok
+    assert any("bfloat16 storage" in n for n in res.notes)
+    # beyond even the bf16 bands still trips
+    assert not C.compare(
+        _round(5e-2, "bfloat16"), _round(0.0, "bfloat16")
+    ).ok
+    # an explicit --band override outranks the per-dtype table
+    assert not C.compare(
+        _round(5e-3, "bfloat16"), _round(0.0, "bfloat16"),
+        bands={"l2": 1e-4},
+    ).ok
+    # time keeps its tight band at bf16: dt arithmetic is storage-
+    # independent, so a drifting schedule is a bug at any precision
+    bad = _round(0.0, "bfloat16")
+    bad["runs"]["r"]["observables"]["time"] = [[10, 0.5], [20, 0.50005]]
+    assert not C.compare(bad, _round(0.0, "bfloat16")).ok
+
+
+def test_diagnostics_meta_records_storage_dtype():
+    """physics.meta_for stamps the storage dtype a run's state lived
+    in — the hook the per-dtype bands resolve through."""
+    from multigpu_advectiondiffusion_tpu.diagnostics.physics import meta_for
+
+    assert meta_for(DiffusionSolver(_diff_cfg()))[
+        "storage_dtype"
+    ] == "bfloat16"
+    # native runs record their (compute) storage truthfully too — the
+    # gate simply finds no per-dtype table for float32
+    assert meta_for(DiffusionSolver(_diff_cfg(precision="native")))[
+        "storage_dtype"
+    ] == "float32"
