@@ -85,6 +85,13 @@ PRECISION_FIELDS = ("storage_dtype", "precision")
 # before the dma rung carry no field and read as "collective".
 SCHEDULE_FIELDS = ("exchange",)
 
+# Request-serving columns (ISSUE 17): the ``serving_*`` rows carry the
+# coalesced server's latency percentiles, mean batch occupancy and the
+# coalesced-over-sequential wall ratio beside the req/s headline. Same
+# coverage-note discipline: provenance, not gated throughput; rows
+# from rounds before the request server carry none of these.
+SERVING_FIELDS = ("p50_ms", "p99_ms", "occupancy", "vs_sequential")
+
 
 def row_family(key: Optional[str]) -> Optional[str]:
     """The solver family a metric/name belongs to, resolved through
@@ -328,7 +335,8 @@ def compare(
                                      old=row_value(old)))
             continue
         for field in (MEASURED_FIELDS + ENSEMBLE_FIELDS
-                      + SCHEDULE_FIELDS + PRECISION_FIELDS):
+                      + SCHEDULE_FIELDS + PRECISION_FIELDS
+                      + SERVING_FIELDS):
             if old.get(field) is not None and new.get(field) is None:
                 notes.append(
                     f"{key}: measured column {field!r} dropped "

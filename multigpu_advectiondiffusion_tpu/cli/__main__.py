@@ -572,6 +572,30 @@ def build_parser() -> argparse.ArgumentParser:
                             "--iters ...")
     service_cli.configure_submit(p)
 
+    # continuous-batching request server (service/server.py): scenario
+    # requests coalesced onto the ensemble member axis and marched as
+    # one batched dispatch, crash-safe by journal replay
+    p = sub.add_parser("serve-requests",
+                       help="run the crash-safe continuous-batching "
+                            "request server: compatible requests "
+                            "coalesce onto one batched ensemble "
+                            "dispatch, march in bounded slices "
+                            "(finished members return, joiners enter "
+                            "at slice boundaries), shed-with-retry-"
+                            "after under overload; --verify replays "
+                            "and linearization-checks the request "
+                            "journal offline (README 'Request "
+                            "serving')")
+    service_cli.configure_serve_requests(p)
+
+    p = sub.add_parser("request",
+                       help="park one scenario request in the "
+                            "server's spool (atomic; works while no "
+                            "server runs): request --root DIR --model "
+                            "diffusion --n 64 64 --t-end 0.2 "
+                            "[--operand diffusivity=0.5 --wait 60]")
+    service_cli.configure_request(p)
+
     return ap
 
 

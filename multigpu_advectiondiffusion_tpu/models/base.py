@@ -1470,15 +1470,33 @@ class SolverBase:
         (``jax.grad`` through a dynamic-trip ``while_loop`` is
         undefined): the gradient-based inverse-problem path
         (``examples/inverse_diffusivity.py``) differentiates through
-        this dispatch with respect to the member operands."""
+        this dispatch with respect to the member operands.
+
+        ``t_end`` may be a scalar (every member marches to the same
+        horizon) or a ``(B,)`` sequence — the request-serving shape
+        (service/server.py): coalesced requests asking different
+        horizons ride ONE dispatch, each member freezing at its own
+        ``te``. The scalar path keeps its original compiled key; the
+        per-member path compiles a variant with ``te`` as a batched
+        member scalar."""
+        import numpy as _np
+
         B = estate.members
         names, ops = self._ensemble_pack(operands, B)
         self._ensemble_gate(names)
         mtok = self._ensemble_mesh_token()
+        te_host = _np.asarray(t_end, dtype=_np.float64)
+        per_member_te = te_host.ndim > 0
+        if per_member_te and te_host.reshape(-1).shape[0] != B:
+            raise ValueError(
+                f"t_end has {te_host.reshape(-1).shape[0]} values for "
+                f"{B} members — pass a scalar or one horizon per member"
+            )
         self._ensemble_record(B, "ensemble-vmap[generic-xla]", "t_end",
                               names)
         with self._dispatch_span("advance_to_ensemble", mode="t_end",
-                                 t_end=float(t_end), members=B):
+                                 t_end=float(_np.max(te_host)),
+                                 members=B):
             def member(u, t, p, te):
                 ov = {n: p[i] for i, n in enumerate(names)} or None
                 eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
@@ -1511,6 +1529,24 @@ class SolverBase:
                 return lax.while_loop(
                     cond, body, (u, t, jnp.zeros((), jnp.int32))
                 )
+
+            if per_member_te:
+                # te rides the member axis like t/operands do: the vmap
+                # batches it, the ensemble mesh shards it with mspec
+                def block(us, ts, ps, tes):
+                    return jax.vmap(member, in_axes=(0, 0, 0, 0))(
+                        us, ts, ps, tes
+                    )
+
+                f = self._compiled(
+                    ("ens_adv", B, names, mtok, max_steps, "vte"),
+                    lambda: self._ensemble_wrap(block, 3, 2),
+                )
+                u, t, steps = f(
+                    estate.u, estate.t, ops,
+                    jnp.asarray(te_host.reshape(-1), estate.t.dtype),
+                )
+                return EnsembleState(u=u, t=t, it=estate.it + steps)
 
             def block(us, ts, ps, te):
                 return jax.vmap(member, in_axes=(0, 0, 0, None))(

@@ -192,6 +192,49 @@ def kill_rank(proc) -> None:
     os.kill(_pid(proc), _signal.SIGKILL)
 
 
+def kill_server_mid_batch(proc, root: str, timeout: float = 60.0,
+                          poll: float = 0.02) -> int:
+    """SIGKILL the request server (``service/server.py``) once it is
+    provably MID-BATCH: wait for a ``serve:slice`` event in the
+    server's telemetry stream — one bounded ``advance_to_ensemble``
+    slice committed, members checkpointed, more marching to do — then
+    deliver SIGKILL. Returns the number of slice events observed at
+    kill time; raises ``TimeoutError`` if the server never reaches a
+    slice boundary (it may have died first — check the process).
+
+    This is the chaos fixture of the zero-lost-request claim: the kill
+    lands after journal records exist for in-flight requests but
+    before they are done, so only a correct replay-and-resume restart
+    can answer every request exactly once."""
+    import time as _time
+
+    events = os.path.join(root, "serve_events.jsonl")
+    pid = _pid(proc)
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        slices = 0
+        try:
+            with open(events) as f:
+                for line in f:
+                    if '"serve"' in line and '"slice"' in line:
+                        slices += 1
+        except OSError:
+            slices = 0
+        if slices:
+            os.kill(pid, _signal.SIGKILL)
+            return slices
+        poll_fn = getattr(proc, "poll", None)
+        if callable(poll_fn) and poll_fn() is not None:
+            raise TimeoutError(
+                "server exited before reaching a slice boundary "
+                f"(rc={poll_fn()})"
+            )
+        _time.sleep(poll)
+    raise TimeoutError(
+        f"no serve:slice event in {events} within {timeout}s"
+    )
+
+
 def stall_rank(proc):
     """SIGSTOP a rank's OS process (pid stays alive, heartbeat goes
     stale — the wedged-not-dead failure). Returns a ``resume()``

@@ -1,7 +1,10 @@
 """Crash-safe multi-run scheduler (ISSUE 14, ROADMAP item 5): a
 journaled queue of CLI run requests multiplexed onto the device budget.
 See ``service/daemon.py`` for the architecture and README "Service
-mode" for usage."""
+mode" for usage. Since ISSUE 17 the package also hosts the
+continuous-batching request server (``service/server.py``): coalesced
+ensemble serving with SLOs, backpressure and zero-lost-request
+recovery — README "Request serving"."""
 
 from multigpu_advectiondiffusion_tpu.service.admission import (
     AdmissionController,
@@ -33,8 +36,25 @@ from multigpu_advectiondiffusion_tpu.service.queue import (
     new_job_id,
     submit_to_spool,
 )
+from multigpu_advectiondiffusion_tpu.service.requests import (
+    ALLOWED_REQUEST_TRANSITIONS,
+    REQUEST_STATES,
+    REQUEST_TERMINAL_STATES,
+    RequestQueue,
+    RequestRecord,
+    RequestSpec,
+    coalesce_key,
+    ingest_request_spool,
+    new_request_id,
+    submit_request_to_spool,
+)
+from multigpu_advectiondiffusion_tpu.service.server import (
+    RequestServer,
+    submit_request_over_socket,
+)
 
 __all__ = [
+    "ALLOWED_REQUEST_TRANSITIONS",
     "ALLOWED_TRANSITIONS",
     "AdmissionController",
     "EXIT_PREEMPTED",
@@ -45,15 +65,26 @@ __all__ = [
     "JobQueue",
     "JobRecord",
     "JobSpec",
+    "REQUEST_STATES",
+    "REQUEST_TERMINAL_STATES",
+    "RequestQueue",
+    "RequestRecord",
+    "RequestServer",
+    "RequestSpec",
     "STATES",
     "Scheduler",
     "SubprocessRunner",
     "TERMINAL_STATES",
     "WarmLedger",
     "classify_failure",
+    "coalesce_key",
+    "ingest_request_spool",
     "ingest_spool",
     "latest_watermark",
     "new_job_id",
+    "new_request_id",
+    "submit_request_over_socket",
+    "submit_request_to_spool",
     "submit_to_spool",
     "verify_records",
     "warm_key",

@@ -142,6 +142,258 @@ def run_serve(args) -> None:
     return None
 
 
+def configure_serve_requests(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--root", required=True, metavar="DIR",
+                   help="serving root: journal.jsonl, spool/, "
+                        "requests/<id>/ artifacts and the server's "
+                        "serve_events.jsonl live here")
+    p.add_argument("--max-batch", type=int, default=8, metavar="B",
+                   help="coalescing width: compatible requests folded "
+                        "onto one ensemble member axis (default 8)")
+    p.add_argument("--slice-steps", type=int, default=16, metavar="N",
+                   help="bounded advance slice: finished members "
+                        "return and joiners enter at every N-step "
+                        "boundary (default 16)")
+    p.add_argument("--queue-bound", type=int, default=64, metavar="N",
+                   help="backpressure: open requests beyond this shed "
+                        "with a retry-after verdict (default 64)")
+    p.add_argument("--retry-after", type=float, default=2.0,
+                   metavar="S",
+                   help="retry-after hint in shed verdicts (default 2)")
+    p.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="serving mesh, e.g. 'members=2' or "
+                        "'members=2,dz=2' — batches shard their member "
+                        "axis over it (clone-padded so B tiles)")
+    p.add_argument("--mem-budget-mb", type=int, default=0, metavar="MB",
+                   help="cap the batch width so the estimated live "
+                        "state fits (0 = unmetered)")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   metavar="K",
+                   help="slice checkpoints every K slices (default 1 "
+                        "— every slice boundary is crash-resumable)")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="also accept requests over an AF_UNIX "
+                        "datagram socket at PATH (off by default)")
+    p.add_argument("--poll", type=float, default=0.05, metavar="S",
+                   help="idle loop cadence in seconds")
+    p.add_argument("--until-idle", action="store_true",
+                   help="exit once every request is terminal (the "
+                        "gate/CI mode); default: serve until killed — "
+                        "the journal makes that safe at any instant")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   metavar="S",
+                   help="stop serving after S wall seconds")
+    p.add_argument("--verify", action="store_true",
+                   help="no daemon: replay the request journal, print "
+                        "the state table, and exit nonzero when it "
+                        "does not linearize against the request "
+                        "transition table")
+    p.add_argument("--require-complete", action="store_true",
+                   help="with --verify: also fail when any submitted "
+                        "request never reached done/failed/shed, or "
+                        "the journal has torn lines — the "
+                        "serve_gate.sh assertion")
+    p.set_defaults(fn=run_serve_requests)
+
+
+def configure_request(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--root", required=True, metavar="DIR")
+    p.add_argument("--request-id", default=None,
+                   help="stable id (default: generated); also the "
+                        "request's directory under <root>/requests/")
+    p.add_argument("--model", required=True,
+                   help="registry family name (diffusion/burgers/adr)")
+    p.add_argument("--n", type=int, nargs="+", default=[32, 32],
+                   metavar="N", help="grid sizes, physical order")
+    p.add_argument("--lengths", type=float, nargs="+", default=[],
+                   metavar="L", help="domain extents, physical order")
+    p.add_argument("--t-end", type=float, default=0.2,
+                   help="simulated-time horizon (default 0.2)")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64", "bfloat16"])
+    p.add_argument("--precision", default="native",
+                   choices=["native", "bf16"])
+    p.add_argument("--impl", default="xla",
+                   help="kernel rung (xla/pallas/.../auto; part of "
+                        "the coalesce key)")
+    p.add_argument("--req-mesh", default="", metavar="SPEC",
+                   help="require the server to run this mesh spec "
+                        "(default: accept whatever it runs)")
+    p.add_argument("--operand", action="append", default=[],
+                   metavar="NAME=VALUE",
+                   help="member-varying scalar override (e.g. "
+                        "diffusivity=0.5); repeatable")
+    p.add_argument("--ic", default=None,
+                   help="initial-condition name override")
+    p.add_argument("--ic-param", action="append", default=[],
+                   metavar="NAME=VALUE",
+                   help="IC parameter override; repeatable")
+    p.add_argument("--t0", type=float, default=None,
+                   help="initial simulated time override")
+    p.add_argument("--priority", type=int, default=0,
+                   help="higher coalesces/marches first; a strictly "
+                        "higher arrival preempts a running batch at "
+                        "the next slice boundary")
+    p.add_argument("--deadline", type=float, default=None, metavar="S",
+                   help="SLO: seconds from admission; drives the "
+                        "deadline-aware batch ordering")
+    p.add_argument("--max-retries", type=int, default=1,
+                   help="crash-resume budget (default 1)")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="submit over the server's AF_UNIX socket "
+                        "instead of the spool file")
+    p.add_argument("--wait", type=float, default=None, metavar="S",
+                   help="poll the request's verdict.json until it is "
+                        "terminal (or S seconds pass; exit 3 on "
+                        "timeout, 1 on failed, 75 on shed)")
+    p.set_defaults(fn=run_request)
+
+
+def _kv_floats(items, flag: str) -> dict:
+    out = {}
+    for item in items:
+        key, sep, val = item.partition("=")
+        if not sep or not key:
+            raise SystemExit(f"{flag} wants NAME=VALUE, got {item!r}")
+        out[key] = float(val)
+    return out
+
+
+def run_serve_requests(args) -> None:
+    from multigpu_advectiondiffusion_tpu.service.journal import (
+        Journal,
+        verify_records,
+    )
+    from multigpu_advectiondiffusion_tpu.service.requests import (
+        ALLOWED_REQUEST_TRANSITIONS,
+        REQUEST_TERMINAL_STATES,
+        RequestQueue,
+    )
+
+    if args.verify:
+        journal_path = os.path.join(args.root, "journal.jsonl")
+        records, torn = Journal.replay(journal_path)
+        problems = verify_records(
+            records, torn=torn,
+            allowed_transitions=ALLOWED_REQUEST_TRANSITIONS,
+            terminal_states=REQUEST_TERMINAL_STATES,
+            initial_state="received",
+            require_complete=args.require_complete,
+        )
+        q, report = RequestQueue.replay(
+            Journal(journal_path, fsync=False)
+        )
+        print(f"-- journal {journal_path}: {len(records)} record(s), "
+              f"{torn} torn line(s), {len(q.requests)} request(s)")
+        for rec in sorted(q.requests.values(), key=lambda r: r.order):
+            print(f"   {rec.request_id:<24} {rec.state:<10} "
+                  f"attempts={rec.attempts} slices={rec.slices} "
+                  f"failures={len(rec.failures)}")
+        for msg in report.get("problems", []):
+            problems.append(f"replay: {msg}")
+        for msg in problems:
+            print(f"   PROBLEM: {msg}", file=sys.stderr)
+        if problems:
+            raise SystemExit(1)
+        print("-- request journal linearizes")
+        return None
+
+    from multigpu_advectiondiffusion_tpu.service.server import (
+        RequestServer,
+    )
+
+    server = RequestServer(
+        args.root,
+        max_batch=args.max_batch,
+        slice_steps=args.slice_steps,
+        queue_bound=args.queue_bound,
+        retry_after_s=args.retry_after,
+        mesh=args.mesh,
+        mem_budget_bytes=args.mem_budget_mb * (1 << 20),
+        checkpoint_every=args.checkpoint_every,
+        socket_path=args.socket,
+    )
+    try:
+        outcome = server.serve(
+            until_idle=args.until_idle,
+            max_seconds=args.max_seconds,
+            poll_seconds=args.poll,
+        )
+    finally:
+        server.close()
+    states = outcome.get("states", {})
+    print(f"-- serve-requests: {outcome.get('reason')}; "
+          + ", ".join(f"{k}={v}" for k, v in sorted(states.items())))
+    if outcome.get("reason") == "stalled":
+        raise SystemExit(2)
+    return None
+
+
+def run_request(args) -> None:
+    import json
+    import time
+
+    from multigpu_advectiondiffusion_tpu.service.requests import (
+        RequestSpec,
+        new_request_id,
+        request_dir,
+        submit_request_to_spool,
+    )
+
+    spec = RequestSpec(
+        request_id=args.request_id or new_request_id(),
+        model=args.model,
+        n=list(args.n),
+        lengths=list(args.lengths),
+        t_end=args.t_end,
+        dtype=args.dtype,
+        precision=args.precision,
+        impl=args.impl,
+        mesh=args.req_mesh,
+        operands=_kv_floats(args.operand, "--operand"),
+        ic=args.ic,
+        ic_params=_kv_floats(args.ic_param, "--ic-param"),
+        t0=args.t0,
+        priority=args.priority,
+        deadline_s=args.deadline,
+        max_retries=args.max_retries,
+    )
+    if args.socket:
+        from multigpu_advectiondiffusion_tpu.service.server import (
+            submit_request_over_socket,
+        )
+
+        submit_request_over_socket(args.socket, spec)
+        print(f"-- submitted {spec.request_id} over {args.socket}")
+    else:
+        path = submit_request_to_spool(args.root, spec)
+        print(f"-- submitted {spec.request_id} "
+              f"(priority {spec.priority}) -> {path}")
+    if args.wait is None:
+        return None
+    verdict_path = os.path.join(
+        request_dir(args.root, spec.request_id), "verdict.json"
+    )
+    deadline = time.monotonic() + args.wait
+    while time.monotonic() < deadline:
+        try:
+            with open(verdict_path) as f:
+                verdict = json.load(f)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+            continue
+        print(json.dumps(verdict, sort_keys=True))
+        status = verdict.get("status")
+        if status == "failed":
+            raise SystemExit(1)
+        if status == "shed":
+            raise SystemExit(75)
+        return None
+    print(f"-- no verdict for {spec.request_id} within {args.wait}s",
+          file=sys.stderr)
+    raise SystemExit(3)
+
+
 def run_submit(args) -> None:
     from multigpu_advectiondiffusion_tpu.service.queue import (
         JobSpec,

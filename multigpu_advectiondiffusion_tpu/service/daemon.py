@@ -524,7 +524,11 @@ class Scheduler:
         return rec
 
     def _ingest_spool(self) -> None:
-        for rec in ingest_spool(self.root, self.queue):
+        def on_skip(name, reason):
+            self._sink.event("sched", "spool_skip",
+                             file=name, error=reason)
+
+        for rec in ingest_spool(self.root, self.queue, on_skip=on_skip):
             self._sink.event(
                 "job", "submit", job=rec.job_id,
                 priority=rec.spec.priority, devices=rec.spec.devices,

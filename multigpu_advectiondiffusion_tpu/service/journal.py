@@ -178,18 +178,27 @@ class Journal:
 def verify_records(records: List[dict],
                    torn: int = 0,
                    allowed_transitions=None,
-                   require_complete: bool = False) -> List[str]:
+                   require_complete: bool = False,
+                   terminal_states=None,
+                   initial_state: str = "queued") -> List[str]:
     """Structural linearization check over replayed records: sequence
     numbers strictly increase, every transition names a submitted job,
     every (from, to) pair is legal, and — with ``require_complete`` —
     every submitted job reached a terminal state. Returns a list of
-    problem strings (empty = the journal linearizes)."""
+    problem strings (empty = the journal linearizes).
+
+    The defaults check the job scheduler's table; the request server
+    passes its own ``allowed_transitions``/``terminal_states``/
+    ``initial_state`` (``service/requests.py``) — one verifier, two
+    state machines."""
     from multigpu_advectiondiffusion_tpu.service.queue import (
         ALLOWED_TRANSITIONS,
         TERMINAL_STATES,
     )
 
     allowed = allowed_transitions or ALLOWED_TRANSITIONS
+    terminal = (TERMINAL_STATES if terminal_states is None
+                else frozenset(terminal_states))
     problems: List[str] = []
     last_seq: Optional[int] = None
     state: dict = {}
@@ -208,7 +217,7 @@ def verify_records(records: List[dict],
         if rtype == "submit":
             if job in state:
                 problems.append(f"seq {seq}: duplicate submit of {job!r}")
-            state[job] = "queued"
+            state[job] = initial_state
         elif rtype == "state":
             if job not in state:
                 problems.append(
@@ -233,7 +242,7 @@ def verify_records(records: List[dict],
         if torn:
             problems.append(f"{torn} torn journal line(s)")
         for job, st in sorted(state.items()):
-            if st not in TERMINAL_STATES:
+            if st not in terminal:
                 problems.append(
                     f"job {job!r} never reached a terminal state "
                     f"(journal leaves it {st!r})"

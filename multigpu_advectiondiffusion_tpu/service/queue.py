@@ -286,12 +286,17 @@ def submit_to_spool(root: str, spec: JobSpec) -> str:
     return path
 
 
-def ingest_spool(root: str, queue: JobQueue) -> List[JobRecord]:
+def ingest_spool(root: str, queue: JobQueue,
+                 on_skip=None) -> List[JobRecord]:
     """Move every parked spec into the journal-backed queue; a spec
     whose id the journal already knows (the daemon died between
     journaling and unlinking) is deduplicated by dropping the spool
-    file. Unparseable spec files are left in place for the operator.
-    Returns the newly ingested records."""
+    file. A torn or corrupt spec file (truncated JSON, non-dict
+    payload, a spec that fails validation) never crashes the daemon:
+    it is quarantined as ``<name>.bad`` next to the spool, a named
+    ``note`` record lands in the journal, and ``on_skip(name, error)``
+    — when given — lets the caller mirror the skip as a telemetry
+    event. Returns the newly ingested records."""
     d = spool_dir(root)
     if not os.path.isdir(d):
         return []
@@ -302,10 +307,26 @@ def ingest_spool(root: str, queue: JobQueue) -> List[JobRecord]:
         path = os.path.join(d, name)
         try:
             with open(path) as f:
-                spec = JobSpec.from_json(json.load(f))
+                payload = json.load(f)
+            if not isinstance(payload, dict):
+                raise ValueError(
+                    f"spec payload is {type(payload).__name__}, not dict"
+                )
+            spec = JobSpec.from_json(payload)
             spec.validate()
-        except (ValueError, TypeError, OSError):
-            continue  # malformed spec: leave for the operator
+        except (ValueError, TypeError, KeyError, OSError) as err:
+            # quarantine, report, continue — a poisoned mailbox entry
+            # must not take the daemon (or block the entries behind it)
+            reason = f"{type(err).__name__}: {err}"[:200]
+            try:
+                os.replace(path, path + ".bad")
+            except OSError:
+                pass
+            queue.journal.append("note", note="spool_skip",
+                                 file=name, error=reason)
+            if on_skip is not None:
+                on_skip(name, reason)
+            continue
         if spec.job_id not in queue.jobs:
             ingested.append(queue.submit(spec))
         os.remove(path)

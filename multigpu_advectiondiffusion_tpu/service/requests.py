@@ -1,0 +1,443 @@
+"""Scenario requests: specs, the request-lifecycle state machine, and
+the journal-backed request queue the serving daemon recovers from.
+
+PR 14's scheduler multiplexes *jobs* (one subprocess per run); this
+layer multiplexes *requests* — many users asking for solves that are
+compatible enough to share ONE batched ensemble dispatch
+(``service/server.py``). The shapes deliberately mirror
+``service/queue.py``:
+
+* a :class:`RequestSpec` JSON round-trips through the same atomic
+  spool mailbox (tmp + ``os.replace``; two processes never append to
+  one journal);
+* every lifecycle transition is a CRC-sealed record in the PR 14
+  write-ahead journal *before* the in-memory queue mutates, so a
+  SIGKILLed server replays to exactly what it knew;
+* ``verify_records`` (``service/journal.py``) checks the request
+  journal against THIS module's transition table and terminal states —
+  one verifier, two state machines.
+
+The request lifecycle::
+
+    received -> admitted -> batched -> running -> done | failed
+        |           |          |          \\
+        v           v          v           v
+      shed        failed    requeued <-- (preemption / crash recovery)
+    (backpressure)             |
+                               v
+                           batched | failed
+
+``shed`` is the backpressure verdict (bounded queue: overload is a
+policy outcome with a retry-after hint, never an OOM); ``requeued``
+carries the slice checkpoint a recovering batch resumes from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from multigpu_advectiondiffusion_tpu.service.journal import Journal
+
+#: request lifecycle states (ISSUE 17)
+REQUEST_STATES = (
+    "received", "admitted", "batched", "running", "requeued",
+    "done", "failed", "shed",
+)
+REQUEST_TERMINAL_STATES = frozenset({"done", "failed", "shed"})
+
+#: legal (from, to) pairs — ``verify_records(...,
+#: allowed_transitions=ALLOWED_REQUEST_TRANSITIONS,
+#: terminal_states=REQUEST_TERMINAL_STATES, initial_state='received')``
+#: holds the serving journal to this table
+ALLOWED_REQUEST_TRANSITIONS = frozenset({
+    ("received", "admitted"),
+    ("received", "shed"),            # backpressure: bounded queue
+    ("admitted", "batched"),
+    ("admitted", "failed"),          # per-request validation failure
+    ("batched", "running"),
+    ("batched", "requeued"),         # crash recovery: never marched
+    ("running", "done"),
+    ("running", "failed"),           # divergence forensics / deadline
+    ("running", "requeued"),         # preemption / crash recovery
+    ("requeued", "batched"),
+    ("requeued", "failed"),          # retries exhausted
+})
+
+_DTYPES = ("float32", "float64", "bfloat16")
+_PRECISIONS = ("native", "bf16")
+
+
+@dataclasses.dataclass
+class RequestSpec:
+    """One scenario request: the physics a user wants solved, plus the
+    SLO metadata the server schedules it by. JSON round-trips for the
+    spool and the journal.
+
+    ``model``/``n``/``lengths``/``dtype``/``precision``/``impl``/
+    ``mesh`` form the *coalesce key* (:func:`coalesce_key`): requests
+    agreeing on all of them compile to the SAME batched executable and
+    may share one ensemble dispatch. ``operands`` (member-varying
+    scalars, e.g. diffusivity), ``ic``/``ic_params``/``t0`` and
+    ``t_end`` vary freely *within* a batch — they ride the member
+    axis."""
+
+    request_id: str
+    model: str                       # registry family name
+    n: List[int] = dataclasses.field(default_factory=lambda: [32, 32])
+    lengths: List[float] = dataclasses.field(default_factory=list)
+    t_end: float = 0.2
+    dtype: str = "float32"
+    precision: str = "native"
+    impl: str = "xla"
+    #: serving-mesh constraint token ("" = whatever the server runs);
+    #: a non-empty value must match the server's --mesh spec verbatim
+    mesh: str = ""
+    #: member-varying scalar overrides (names from the family's
+    #: ``ensemble_operands()``), e.g. ``{"diffusivity": 0.5}``
+    operands: Dict[str, float] = dataclasses.field(default_factory=dict)
+    ic: Optional[str] = None
+    ic_params: Dict[str, float] = dataclasses.field(default_factory=dict)
+    t0: Optional[float] = None
+    priority: int = 0
+    #: SLO: seconds from admission before the deadline triggers the
+    #: priority-preemption path (None = best effort)
+    deadline_s: Optional[float] = None
+    max_retries: int = 1
+
+    def validate(self) -> None:
+        """Structural validation only — cheap enough for ingest and
+        replay (no model/registry import). Model resolution and operand
+        names are checked at batch-formation time, where a bad request
+        fails ALONE (``admitted -> failed``), never the daemon."""
+        if (not self.request_id or "/" in self.request_id
+                or ".." in self.request_id):
+            raise ValueError(f"bad request id {self.request_id!r}")
+        if not self.model or not isinstance(self.model, str):
+            raise ValueError(f"{self.request_id}: empty model name")
+        if not (isinstance(self.n, (list, tuple)) and
+                1 <= len(self.n) <= 3 and
+                all(isinstance(v, int) and v >= 2 for v in self.n)):
+            raise ValueError(
+                f"{self.request_id}: n must be 1-3 ints >= 2, got "
+                f"{self.n!r}"
+            )
+        if self.lengths and len(self.lengths) != len(self.n):
+            raise ValueError(
+                f"{self.request_id}: {len(self.lengths)} lengths for "
+                f"{len(self.n)} grid axes"
+            )
+        te = float(self.t_end)
+        if not (te == te and abs(te) != float("inf")):
+            raise ValueError(f"{self.request_id}: non-finite t_end")
+        if self.dtype not in _DTYPES:
+            raise ValueError(
+                f"{self.request_id}: dtype {self.dtype!r} not in "
+                f"{_DTYPES}"
+            )
+        if self.precision not in _PRECISIONS:
+            raise ValueError(
+                f"{self.request_id}: precision {self.precision!r} not "
+                f"in {_PRECISIONS}"
+            )
+        if not isinstance(self.operands, dict) or not all(
+            isinstance(k, str) for k in self.operands
+        ):
+            raise ValueError(
+                f"{self.request_id}: operands must map names to scalars"
+            )
+        if self.deadline_s is not None and float(self.deadline_s) <= 0:
+            raise ValueError(
+                f"{self.request_id}: deadline_s must be positive"
+            )
+        if int(self.max_retries) < 0:
+            raise ValueError(
+                f"{self.request_id}: max_retries must be >= 0"
+            )
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, data: dict) -> "RequestSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+
+def new_request_id() -> str:
+    return f"req-{int(time.time())}-{uuid.uuid4().hex[:6]}"
+
+
+def coalesce_key(spec: RequestSpec) -> str:
+    """The batching compatibility token: requests with equal keys
+    compile to the same batched executable (same family, grid, dtype,
+    precision rung, impl rung, mesh) and may fold onto one ensemble
+    member axis. Everything member-varying (operands, ICs, horizons)
+    is deliberately absent."""
+    return "|".join([
+        spec.model,
+        "x".join(str(int(v)) for v in spec.n),
+        ",".join(f"{float(v):g}" for v in (spec.lengths or [])),
+        spec.dtype,
+        spec.precision,
+        spec.impl,
+        spec.mesh or "",
+    ])
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """In-memory view of one request, rebuilt from the journal."""
+
+    spec: RequestSpec
+    state: str = "received"
+    order: int = 0            # FIFO tiebreak within a priority band
+    attempts: int = 0
+    slices: int = 0           # bounded advance slices marched so far
+    batch: Optional[str] = None
+    member: Optional[int] = None   # member lane in its current batch
+    t: Optional[float] = None      # last journaled solve time
+    it: int = 0
+    checkpoint: Optional[str] = None  # slice checkpoint a resume loads
+    admitted_wall: Optional[float] = None
+    failures: List[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def request_id(self) -> str:
+        return self.spec.request_id
+
+    def sort_key(self) -> tuple:
+        """Deadline-aware priority order: higher priority first, then
+        the earliest absolute deadline, then FIFO."""
+        deadline = float("inf")
+        if self.spec.deadline_s is not None:
+            base = self.admitted_wall or 0.0
+            deadline = base + float(self.spec.deadline_s)
+        return (-self.spec.priority, deadline, self.order)
+
+    def deadline_wall(self) -> Optional[float]:
+        if self.spec.deadline_s is None or self.admitted_wall is None:
+            return None
+        return self.admitted_wall + float(self.spec.deadline_s)
+
+
+class RequestQueue:
+    """The journal-backed request queue: every mutation journals first
+    (``service/journal.py`` record vocabulary — ``submit``/``state``
+    with the request id in the ``job`` field, so ``verify_records``
+    and ``tpucfd-trace`` read both journals with one parser)."""
+
+    def __init__(self, journal: Journal):
+        self.journal = journal
+        self.requests: Dict[str, RequestRecord] = {}
+        self._order = 0
+
+    # ------------------------------------------------------------------ #
+    def submit(self, spec: RequestSpec) -> RequestRecord:
+        spec.validate()
+        if spec.request_id in self.requests:
+            raise ValueError(
+                f"request id {spec.request_id!r} already submitted"
+            )
+        self.journal.append("submit", job=spec.request_id,
+                            spec=spec.to_json())
+        return self._apply_submit(spec)
+
+    def _apply_submit(self, spec: RequestSpec) -> RequestRecord:
+        self._order += 1
+        rec = RequestRecord(spec=spec, order=self._order)
+        self.requests[spec.request_id] = rec
+        return rec
+
+    def transition(self, request_id: str, to: str,
+                   **info) -> RequestRecord:
+        rec = self.requests[request_id]
+        frm = rec.state
+        if (frm, to) not in ALLOWED_REQUEST_TRANSITIONS:
+            raise ValueError(
+                f"illegal request transition {frm!r} -> {to!r} for "
+                f"{request_id}"
+            )
+        self.journal.append("state", job=request_id,
+                            **{"from": frm, "to": to}, **info)
+        self._apply_transition(rec, frm, to, info)
+        return rec
+
+    def _apply_transition(self, rec: RequestRecord, frm: str, to: str,
+                          info: dict) -> None:
+        rec.state = to
+        if "batch" in info:
+            rec.batch = info["batch"]
+        if "member" in info:
+            rec.member = (None if info["member"] is None
+                          else int(info["member"]))
+        if "t" in info and info["t"] is not None:
+            rec.t = float(info["t"])
+        if "it" in info and info["it"] is not None:
+            rec.it = int(info["it"])
+        if "checkpoint" in info:
+            rec.checkpoint = info["checkpoint"]
+        if "attempt" in info:
+            rec.attempts = max(rec.attempts, int(info["attempt"]))
+        if "slices" in info and info["slices"] is not None:
+            rec.slices = int(info["slices"])
+        if "failure" in info and isinstance(info["failure"], dict):
+            rec.failures.append(info["failure"])
+        if to == "admitted" and rec.admitted_wall is None:
+            rec.admitted_wall = float(
+                info.get("wall") or time.time()
+            )
+        if to == "requeued":
+            rec.batch = None
+            rec.member = None
+
+    # ------------------------------------------------------------------ #
+    def batchable(self) -> List[RequestRecord]:
+        """Requests waiting for a batch slot (admitted or requeued),
+        deadline-aware priority order."""
+        return sorted(
+            (r for r in self.requests.values()
+             if r.state in ("admitted", "requeued")),
+            key=RequestRecord.sort_key,
+        )
+
+    def in_flight(self) -> List[RequestRecord]:
+        return [r for r in self.requests.values()
+                if r.state in ("batched", "running")]
+
+    def open_requests(self) -> List[RequestRecord]:
+        return [r for r in self.requests.values()
+                if r.state not in REQUEST_TERMINAL_STATES]
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def replay(cls, journal: Journal) -> Tuple["RequestQueue", dict]:
+        """Rebuild a queue from ``journal.path`` — illegal records are
+        skipped (and reported) rather than fatal, the JobQueue.replay
+        discipline."""
+        records, torn = Journal.replay(journal.path)
+        q = cls(journal)
+        problems: List[str] = []
+        for rec in records:
+            rtype, rid = rec.get("type"), rec.get("job")
+            if rtype == "submit":
+                try:
+                    spec = RequestSpec.from_json(rec.get("spec") or {})
+                    spec.validate()
+                except (TypeError, ValueError) as err:
+                    problems.append(
+                        f"seq {rec.get('seq')}: bad spec: {err}"
+                    )
+                    continue
+                if spec.request_id in q.requests:
+                    problems.append(
+                        f"seq {rec.get('seq')}: duplicate submit {rid!r}"
+                    )
+                    continue
+                q._apply_submit(spec)
+            elif rtype == "state":
+                r = q.requests.get(rid)
+                if r is None:
+                    problems.append(
+                        f"seq {rec.get('seq')}: state for unknown {rid!r}"
+                    )
+                    continue
+                frm, to = rec.get("from"), rec.get("to")
+                if (frm != r.state
+                        or (frm, to) not in ALLOWED_REQUEST_TRANSITIONS):
+                    problems.append(
+                        f"seq {rec.get('seq')}: skipping illegal "
+                        f"{frm!r}->{to!r} for {rid!r} "
+                        f"(state {r.state!r})"
+                    )
+                    continue
+                # the journal envelope's "wall" rides into the info
+                # dict, so replay restores the ORIGINAL admission wall
+                # clock and deadlines survive a restart
+                q._apply_transition(r, frm, to, rec)
+        report = {
+            "records": len(records),
+            "torn_lines": torn,
+            "problems": problems,
+            "requests": len(q.requests),
+        }
+        return q, report
+
+
+# --------------------------------------------------------------------- #
+# Spool: the multi-writer-safe submission mailbox (queue.py discipline)
+# --------------------------------------------------------------------- #
+def request_spool_dir(root: str) -> str:
+    return os.path.join(root, "spool")
+
+
+def request_dir(root: str, request_id: str) -> str:
+    """Per-request artifact directory (verdict/result/checkpoint)."""
+    return os.path.join(root, "requests", request_id)
+
+
+def submit_request_to_spool(root: str, spec: RequestSpec) -> str:
+    """Atomically park ``spec`` for the serving daemon (tmp + rename).
+    Usable while no server runs — requests wait until one ingests
+    them."""
+    spec.validate()
+    d = request_spool_dir(root)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{spec.request_id}.json")
+    if os.path.exists(path):
+        raise ValueError(
+            f"request id {spec.request_id!r} already spooled"
+        )
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".req_", suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(spec.to_json(), f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def ingest_request_spool(root: str, queue: RequestQueue,
+                         on_skip=None) -> List[RequestRecord]:
+    """Move every parked request into the journal-backed queue.
+    Dedupe-by-id across restarts (a server that died between journaling
+    and unlinking drops the spool file on the next pass); torn/corrupt
+    spool JSON is quarantined as ``<name>.bad`` with a named journal
+    ``note`` record and an optional ``on_skip(name, reason)`` callback
+    — the hardened ``service/queue.ingest_spool`` discipline."""
+    d = request_spool_dir(root)
+    if not os.path.isdir(d):
+        return []
+    ingested: List[RequestRecord] = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            if not isinstance(payload, dict):
+                raise ValueError(
+                    f"request payload is {type(payload).__name__}, "
+                    "not dict"
+                )
+            spec = RequestSpec.from_json(payload)
+            spec.validate()
+        except (ValueError, TypeError, KeyError, OSError) as err:
+            reason = f"{type(err).__name__}: {err}"[:200]
+            try:
+                os.replace(path, path + ".bad")
+            except OSError:
+                pass
+            queue.journal.append("note", note="spool_skip",
+                                 file=name, error=reason)
+            if on_skip is not None:
+                on_skip(name, reason)
+            continue
+        if spec.request_id not in queue.requests:
+            ingested.append(queue.submit(spec))
+        os.remove(path)
+    return ingested

@@ -1,0 +1,880 @@
+"""Crash-safe continuous-batching request server (ISSUE 17).
+
+PR 14's scheduler multiplexes *jobs* — one subprocess per run, the
+reference's ``Run.m`` one-binary-per-configuration shape made durable.
+This daemon multiplexes *requests*: scenario solves arriving through
+the atomic spool mailbox (or an optional local-socket RPC) are
+coalesced by compatibility key (``requests.coalesce_key`` — same
+family/grid/dtype/precision/impl/mesh compiles the same executable)
+onto the ensemble member axis (PR 9/11) and marched as ONE batched
+dispatch through bounded ``advance_to_ensemble(max_steps=)`` slices —
+the LLM-continuous-batching shape applied to PDE solves:
+
+* finished members return results at the slice boundary while
+  stragglers keep stepping;
+* newly arrived compatible requests JOIN at the next slice boundary
+  (the batch is parked-and-reformed — PR 9 proved the vmap lanes
+  bit-exact regardless of batch composition, and each step is a pure
+  function of ``(u, t)``, so re-batching never changes any member's
+  trajectory);
+* divergence of one member (``EnsembleMemberDivergedError`` names
+  indices) fails ONLY that request with forensics; the rest re-batch
+  and complete.
+
+Robustness is the headline, and it is the PR 14 discipline end to end:
+every request transition is a CRC-sealed record in the write-ahead
+journal *before* the in-memory queue mutates, per-member slice
+checkpoints land atomically each slice, and result artifacts publish
+before the ``done`` record — so a SIGKILL at ANY instant replays to
+zero lost (and zero duplicated) requests: in-flight members resume
+from their slice checkpoint, unstarted ones re-batch, and either path
+is bit-exact against an uninterrupted run. Overload is policy, not a
+crash: the bounded queue sheds with a structured retry-after verdict
+(``serve:shed``), and the memory-watermark admission estimate caps
+batch width before anything allocates.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from multigpu_advectiondiffusion_tpu.service.admission import WarmLedger
+from multigpu_advectiondiffusion_tpu.service.journal import Journal
+from multigpu_advectiondiffusion_tpu.service.requests import (
+    RequestQueue,
+    RequestRecord,
+    RequestSpec,
+    coalesce_key,
+    ingest_request_spool,
+    request_dir,
+    submit_request_to_spool,
+)
+
+#: rough live-state multiplier for the admission estimate: solution +
+#: integrator stages + halo/stencil temporaries per member
+_STATE_BYTES_FACTOR = 8
+
+_ITEMSIZE = {"float32": 4, "float64": 8, "bfloat16": 2}
+
+
+def _finish_eps(te: float) -> float:
+    """The ensemble engine's per-member freeze epsilon
+    (models/base.advance_to_ensemble) — the server's finished test MUST
+    match it, or a frozen lane would be marched forever."""
+    return 1e-12 * max(1.0, abs(float(te)))
+
+
+def submit_request_over_socket(socket_path: str,
+                               spec: RequestSpec) -> None:
+    """The optional local RPC: one datagram, one request. The server
+    writes it into the same spool mailbox the file path uses, so both
+    fronts share the journal-first ingest."""
+    import socket as _socket
+
+    spec.validate()
+    s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
+    try:
+        s.sendto(json.dumps(spec.to_json()).encode(), socket_path)
+    finally:
+        s.close()
+
+
+class _Batch:
+    """One live coalesced dispatch: the ensemble front end, the batched
+    state, and the lane -> request mapping (``None`` lanes are clone
+    padding so B tiles a member-sharded mesh; their results are
+    discarded)."""
+
+    def __init__(self, batch_id: str, key: str, ens, estate,
+                 reqs: List[Optional[RequestRecord]],
+                 te: List[float]):
+        self.batch_id = batch_id
+        self.key = key
+        self.ens = ens
+        self.estate = estate
+        self.reqs = reqs
+        self.te = te
+        self.started = False
+        self.slices = 0
+        self.prev_it = np.asarray(estate.it).copy()
+
+    def active(self) -> List[RequestRecord]:
+        return [r for r in self.reqs if r is not None
+                and r.state in ("batched", "running")]
+
+    @property
+    def priority(self) -> int:
+        live = self.active()
+        return max((r.spec.priority for r in live), default=-(1 << 30))
+
+
+class RequestServer:
+    """The serving daemon. Layout under ``root``::
+
+        journal.jsonl        the request write-ahead journal
+        serve_events.jsonl   the daemon's own telemetry stream
+        spool/               atomic submission mailbox
+        requests/<id>/       verdict.json / result.json / result.bin /
+                             member.ckpt (slice checkpoint) / crash.json
+    """
+
+    def __init__(self, root: str, max_batch: int = 8,
+                 slice_steps: int = 16, queue_bound: int = 64,
+                 retry_after_s: float = 2.0,
+                 mesh: Optional[str] = None,
+                 mem_budget_bytes: int = 0,
+                 checkpoint_every: int = 1,
+                 growth: float = 1e3,
+                 socket_path: Optional[str] = None,
+                 fsync: bool = True):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        os.makedirs(os.path.join(self.root, "requests"), exist_ok=True)
+        from multigpu_advectiondiffusion_tpu.telemetry.sink import (
+            TelemetrySink,
+        )
+
+        # a PRIVATE sink (the scheduler-daemon discipline): in-process
+        # solver runs install their own module-level sinks and must not
+        # tear down the server's stream
+        self._sink = TelemetrySink(
+            os.path.join(self.root, "serve_events.jsonl")
+        )
+        self.journal = Journal(
+            os.path.join(self.root, "journal.jsonl"), fsync=fsync
+        )
+        self.queue, self.replay_report = RequestQueue.replay(self.journal)
+        self.max_batch = max(1, int(max_batch))
+        self.slice_steps = max(1, int(slice_steps))
+        self.queue_bound = max(1, int(queue_bound))
+        self.retry_after_s = float(retry_after_s)
+        self.mesh_spec = mesh or ""
+        self.mem_budget_bytes = int(mem_budget_bytes or 0)
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.growth = float(growth)
+        self.ledger = self._rebuild_ledger()
+        self._batch: Optional[_Batch] = None
+        self._templates: Dict[str, dict] = {}
+        self._recovered = False
+        self._stalled_ticks = 0
+        self._sock = None
+        self.socket_path = socket_path
+        if socket_path:
+            self._open_socket(socket_path)
+
+    # ------------------------------------------------------------------ #
+    # Plumbing
+    # ------------------------------------------------------------------ #
+    def request_dir(self, request_id: str) -> str:
+        return request_dir(self.root, request_id)
+
+    def _ckpt_path(self, request_id: str) -> str:
+        return os.path.join(self.request_dir(request_id), "member.ckpt")
+
+    def _rebuild_ledger(self) -> WarmLedger:
+        """Warmth survives the server's death exactly like the queue:
+        rebuilt from the journal's ``warm`` note records."""
+        ledger = WarmLedger()
+        records, _ = Journal.replay(self.journal.path)
+        for rec in records:
+            if rec.get("type") == "note" and rec.get("note") == "warm":
+                key = rec.get("key")
+                if key:
+                    ledger.observe(
+                        key,
+                        compile_seconds=rec.get("compile_seconds", 0.0),
+                        peak_bytes=rec.get("peak_bytes"),
+                    )
+        return ledger
+
+    def _transition(self, request_id: str, to: str,
+                    **info) -> RequestRecord:
+        frm = self.queue.requests[request_id].state
+        rec = self.queue.transition(request_id, to, **info)
+        self._sink.event("req", "state", job=request_id,
+                         **{"from": frm, "to": to})
+        return rec
+
+    def _write_verdict(self, request_id: str, verdict: dict) -> None:
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            atomic_write_text,
+        )
+
+        d = self.request_dir(request_id)
+        os.makedirs(d, exist_ok=True)
+        atomic_write_text(
+            os.path.join(d, "verdict.json"),
+            json.dumps(verdict, sort_keys=True, indent=1),
+        )
+
+    def _member_bytes(self, spec: RequestSpec) -> int:
+        cells = int(math.prod(int(v) for v in spec.n))
+        item = _ITEMSIZE.get(spec.dtype, 4)
+        if spec.precision == "bf16":
+            item = 4  # f32 compute temporaries dominate the estimate
+        return cells * item * _STATE_BYTES_FACTOR
+
+    # ------------------------------------------------------------------ #
+    # Socket RPC (optional)
+    # ------------------------------------------------------------------ #
+    def _open_socket(self, path: str) -> None:
+        import socket as _socket
+
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
+        s.bind(path)
+        s.setblocking(False)
+        self._sock = s
+
+    def _drain_socket(self) -> None:
+        if self._sock is None:
+            return
+        while True:
+            try:
+                data = self._sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            try:
+                payload = json.loads(data.decode())
+                if not isinstance(payload, dict):
+                    raise ValueError("socket payload is not a dict")
+                spec = RequestSpec.from_json(payload)
+                submit_request_to_spool(self.root, spec)
+            except (ValueError, TypeError, KeyError) as err:
+                self._sink.event(
+                    "serve", "spool_skip", file="<socket>",
+                    error=f"{type(err).__name__}: {err}"[:200],
+                )
+
+    # ------------------------------------------------------------------ #
+    # Recovery
+    # ------------------------------------------------------------------ #
+    def recover(self) -> dict:
+        """Replay already rebuilt the queue; classify what the dead
+        server left in flight. Members with a slice checkpoint resume
+        from it, the rest re-run from their ICs — both bit-exact (each
+        step is a pure function of the state, so WHERE the march was
+        split cannot change it)."""
+        if self._recovered:
+            return {}
+        self._recovered = True
+        requeued = failed = 0
+        for rec in list(self.queue.in_flight()):
+            rid = rec.request_id
+            ckpt = self._ckpt_path(rid)
+            self._transition(
+                rid, "requeued", reason="crash_recovery",
+                attempt=rec.attempts + 1,
+                checkpoint=ckpt if os.path.exists(ckpt) else None,
+            )
+            if rec.attempts > rec.spec.max_retries + 1:
+                self._fail(rec, reason="retries_exhausted")
+                failed += 1
+            else:
+                requeued += 1
+        report = {
+            "records": self.replay_report.get("records", 0),
+            "torn_lines": self.replay_report.get("torn_lines", 0),
+            "requests": len(self.queue.requests),
+            "requeued": requeued,
+            "failed": failed,
+        }
+        self._sink.event("serve", "recover", **report)
+        return report
+
+    # ------------------------------------------------------------------ #
+    # Ingest + admission
+    # ------------------------------------------------------------------ #
+    def _ingest(self) -> None:
+        self._drain_socket()
+
+        def on_skip(name, reason):
+            self._sink.event("serve", "spool_skip",
+                             file=name, error=reason)
+
+        for rec in ingest_request_spool(self.root, self.queue,
+                                        on_skip=on_skip):
+            self._sink.event("req", "submit", job=rec.request_id,
+                             priority=rec.spec.priority)
+        received = sorted(
+            (r for r in self.queue.requests.values()
+             if r.state == "received"),
+            key=lambda r: r.order,
+        )
+        for rec in received:
+            if len(self.queue.open_requests()) > self.queue_bound:
+                self._shed(rec)
+            else:
+                self._admit(rec)
+
+    def _shed(self, rec: RequestRecord) -> None:
+        """Backpressure by policy: the bounded queue sheds the newest
+        arrival with a structured retry-after verdict instead of
+        growing until something OOMs."""
+        rid = rec.request_id
+        self._transition(rid, "shed", reason="queue_bound",
+                         retry_after_s=self.retry_after_s)
+        self._write_verdict(rid, {
+            "status": "shed",
+            "reason": "queue_bound",
+            "retry_after_s": self.retry_after_s,
+            "open_requests": len(self.queue.open_requests()),
+            "queue_bound": self.queue_bound,
+        })
+        self._sink.event(
+            "serve", "shed", job=rid,
+            open=len(self.queue.open_requests()),
+            bound=self.queue_bound,
+            retry_after_s=self.retry_after_s,
+        )
+
+    def _admit(self, rec: RequestRecord) -> None:
+        """Semantic admission: model resolves through the registry,
+        operand names are the family's, the mesh constraint matches,
+        and the memory estimate fits the budget. A bad request fails
+        ALONE (``admitted -> failed``), never the daemon."""
+        rid = rec.request_id
+        spec = rec.spec
+        problem = None
+        try:
+            tpl = self._template(spec)
+            supported = set(tpl["solver"].ensemble_operands())
+            unknown = sorted(set(spec.operands) - supported)
+            if unknown:
+                problem = (
+                    f"operand(s) {unknown} are not member-varying "
+                    f"scalars of {spec.model!r} ({sorted(supported)})"
+                )
+        except Exception as err:  # noqa: BLE001 — per-request verdict
+            problem = f"{type(err).__name__}: {err}"[:300]
+        if problem is None and spec.mesh and spec.mesh != self.mesh_spec:
+            problem = (
+                f"request wants mesh {spec.mesh!r} but this server "
+                f"runs {self.mesh_spec or '<unsharded>'!r}"
+            )
+        if problem is None and self.mem_budget_bytes:
+            need = self._member_bytes(spec)
+            if need > self.mem_budget_bytes:
+                problem = (
+                    f"memory_budget: one member needs ~{need} bytes, "
+                    f"budget is {self.mem_budget_bytes}"
+                )
+        self._transition(rid, "admitted")
+        if problem is not None:
+            self._fail(rec, reason=problem)
+            return
+        key = coalesce_key(spec)
+        self._sink.event(
+            "serve", "admit", job=rid, key=key,
+            warm=self.ledger.lookup(key) is not None,
+        )
+
+    # ------------------------------------------------------------------ #
+    # Model templates + member states
+    # ------------------------------------------------------------------ #
+    def _template(self, spec: RequestSpec) -> dict:
+        """Per-coalesce-key solver template: family, config, a probe
+        solver (operand-name validation, member configs), and the
+        parsed serving mesh. Cached — every request in a batch shares
+        it by construction."""
+        key = coalesce_key(spec)
+        tpl = self._templates.get(key)
+        if tpl is not None:
+            return tpl
+        import dataclasses
+
+        from multigpu_advectiondiffusion_tpu.core.grid import Grid
+        from multigpu_advectiondiffusion_tpu.models import registry
+
+        fam = registry.get(spec.model)
+        grid = Grid.make(
+            *spec.n,
+            lengths=[float(v) for v in spec.lengths] or None,
+        )
+        fields = {f.name for f in dataclasses.fields(fam.config_cls)}
+        kwargs = {
+            k: v for k, v in dict(
+                dtype=spec.dtype, precision=spec.precision,
+                impl=spec.impl,
+            ).items() if k in fields
+        }
+        cfg = fam.config_cls(grid=grid, **kwargs)
+        solver = fam.solver_cls(cfg)
+        mesh = decomp = None
+        if self.mesh_spec:
+            from multigpu_advectiondiffusion_tpu.cli.drivers import (
+                parse_ensemble_mesh,
+            )
+
+            mesh, decomp = parse_ensemble_mesh(self.mesh_spec, grid)
+        tpl = {"family": fam, "cfg": cfg, "solver": solver,
+               "mesh": mesh, "decomp": decomp}
+        self._templates[key] = tpl
+        return tpl
+
+    @staticmethod
+    def _member_overrides(spec: RequestSpec) -> dict:
+        ov = dict(spec.operands)
+        if spec.ic:
+            ov["ic"] = spec.ic
+        if spec.ic_params:
+            ov["ic_params"] = tuple(sorted(
+                (k, float(v)) for k, v in spec.ic_params.items()
+            ))
+        if spec.t0 is not None:
+            ov["t0"] = float(spec.t0)
+        return ov
+
+    def _member_state(self, rec: RequestRecord, tpl: dict):
+        """The lane's starting state: the slice checkpoint when one
+        exists and loads (crash resume), else the initial condition. A
+        torn/corrupt checkpoint falls back to the IC — slower, but
+        bit-exact by the slicing invariance."""
+        import dataclasses
+
+        ckpt = self._ckpt_path(rec.request_id)
+        cfg = tpl["cfg"]
+        if os.path.exists(ckpt):
+            try:
+                from multigpu_advectiondiffusion_tpu.utils.io import (
+                    load_checkpoint,
+                )
+
+                st = load_checkpoint(ckpt)
+                if tuple(st.u.shape) == tuple(cfg.grid.shape):
+                    return st
+            except Exception:  # noqa: BLE001 — IC fallback below
+                pass
+        fields = {f.name for f in dataclasses.fields(cfg)}
+        ov = {
+            k: v for k, v in self._member_overrides(rec.spec).items()
+            if k in fields
+        }
+        member_cfg = dataclasses.replace(cfg, **ov) if ov else cfg
+        return tpl["family"].solver_cls(member_cfg).initial_state()
+
+    # ------------------------------------------------------------------ #
+    # Batch formation
+    # ------------------------------------------------------------------ #
+    def _form_batch(self) -> Optional[_Batch]:
+        cands = self.queue.batchable()
+        if not cands:
+            return None
+        lead = cands[0]
+        key = coalesce_key(lead.spec)
+        group = [r for r in cands if coalesce_key(r.spec) == key]
+        cap = self.max_batch
+        if self.mem_budget_bytes:
+            per = self._member_bytes(lead.spec)
+            by_mem = max(1, self.mem_budget_bytes // max(1, per))
+            if by_mem < cap:
+                cap = int(by_mem)
+                for rec in group[cap:]:
+                    self._sink.event("serve", "defer",
+                                     job=rec.request_id,
+                                     reason="memory")
+        group = group[:cap]
+        try:
+            tpl = self._template(lead.spec)
+        except Exception as err:  # noqa: BLE001 — fail the group
+            for rec in group:
+                self._fail(rec,
+                           reason=f"{type(err).__name__}: {err}"[:300])
+            return None
+        # per-member starting states; a request whose IC/checkpoint
+        # cannot build fails alone
+        reqs: List[Optional[RequestRecord]] = []
+        states, te, overrides = [], [], []
+        for rec in group:
+            try:
+                st = self._member_state(rec, tpl)
+            except Exception as err:  # noqa: BLE001
+                self._fail(rec,
+                           reason=f"state: {type(err).__name__}: "
+                                  f"{err}"[:300])
+                continue
+            reqs.append(rec)
+            states.append(st)
+            te.append(float(rec.spec.t_end))
+            overrides.append(self._member_overrides(rec.spec))
+        if not reqs:
+            return None
+        from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+            member_extent,
+        )
+
+        mext = member_extent(tpl["mesh"])
+        pad = (-len(reqs)) % mext
+        for _ in range(pad):
+            # clone lanes so B tiles the member-sharded mesh; their
+            # results are discarded at the slice boundary
+            reqs.append(None)
+            states.append(states[0])
+            te.append(te[0])
+            overrides.append(dict(overrides[0]))
+        from multigpu_advectiondiffusion_tpu.models.ensemble import (
+            EnsembleSolver,
+        )
+
+        try:
+            ens = EnsembleSolver(
+                tpl["family"].solver_cls, tpl["cfg"], overrides,
+                mesh=tpl["mesh"], decomp=tpl["decomp"],
+            )
+            estate = self._stack(ens, states)
+            ens.arm(estate)
+        except Exception as err:  # noqa: BLE001 — fail the group
+            for rec in reqs:
+                if rec is not None:
+                    self._fail(rec,
+                               reason=f"batch: {type(err).__name__}: "
+                                      f"{err}"[:300])
+            return None
+        batch_id = f"b{uuid.uuid4().hex[:8]}"
+        for i, rec in enumerate(reqs):
+            if rec is None:
+                continue
+            self._transition(
+                rec.request_id, "batched", batch=batch_id, member=i,
+                checkpoint=self._ckpt_path(rec.request_id),
+            )
+        self._sink.event(
+            "serve", "batch", batch=batch_id, key=key,
+            members=sum(1 for r in reqs if r is not None),
+            lanes=len(reqs),
+        )
+        return _Batch(batch_id, key, ens, estate, reqs, te)
+
+    @staticmethod
+    def _stack(ens, states):
+        """Stack member states and place them on the ensemble sharding
+        (the EnsembleSolver.initial_state device_put, applied to OUR
+        lane states — resumes and joins carry live states, not ICs)."""
+        from multigpu_advectiondiffusion_tpu.models.state import (
+            EnsembleState,
+        )
+
+        est = EnsembleState.stack(states)
+        if ens.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding
+
+            uspec, mspec = ens.solver._ensemble_specs()
+            est = EnsembleState(
+                u=jax.device_put(est.u,
+                                 NamedSharding(ens.mesh, uspec)),
+                t=jax.device_put(est.t,
+                                 NamedSharding(ens.mesh, mspec)),
+                it=jax.device_put(est.it,
+                                  NamedSharding(ens.mesh, mspec)),
+            )
+        return est
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle endpoints
+    # ------------------------------------------------------------------ #
+    def _fail(self, rec: RequestRecord, reason: str,
+              forensics: Optional[dict] = None) -> None:
+        rid = rec.request_id
+        if forensics:
+            from multigpu_advectiondiffusion_tpu.utils.io import (
+                atomic_write_text,
+            )
+
+            d = self.request_dir(rid)
+            os.makedirs(d, exist_ok=True)
+            atomic_write_text(os.path.join(d, "crash.json"),
+                              json.dumps(forensics, sort_keys=True))
+        self._write_verdict(rid, {
+            "status": "failed", "reason": reason,
+            "attempts": rec.attempts,
+            **({"forensics": "crash.json"} if forensics else {}),
+        })
+        self._transition(rid, "failed", reason=reason,
+                         failure={"reason": reason})
+        self._sink.event("req", "failed", job=rid, reason=reason[:200])
+
+    def _finish(self, rec: RequestRecord, b: _Batch, lane: int,
+                estate) -> None:
+        """Publish the lane's result, then journal ``done`` — in that
+        order, so a crash between the two re-runs the member (same
+        bits) instead of losing the answer."""
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            atomic_write_text,
+            save_binary,
+        )
+
+        rid = rec.request_id
+        st = estate.member(lane)
+        u = np.asarray(st.u)
+        t, it = float(np.asarray(st.t)), int(np.asarray(st.it))
+        d = self.request_dir(rid)
+        os.makedirs(d, exist_ok=True)
+        save_binary(u, os.path.join(d, "result.bin"))
+        seconds = (
+            time.time() - rec.admitted_wall
+            if rec.admitted_wall else None
+        )
+        summary = {
+            "request_id": rid,
+            "t": t,
+            "it": it,
+            "batch": b.batch_id,
+            "member": lane,
+            "slices": b.slices,
+            "max_abs": float(np.max(np.abs(u))),
+            "l2": float(np.sqrt(np.mean(u.astype(np.float64) ** 2))),
+            "shape": list(u.shape),
+            "seconds": seconds,
+        }
+        atomic_write_text(os.path.join(d, "result.json"),
+                          json.dumps(summary, sort_keys=True, indent=1))
+        self._write_verdict(rid, {
+            "status": "done", "seconds": seconds,
+            "result": "result.json",
+        })
+        self._transition(rid, "done", t=t, it=it, slices=b.slices)
+        self._sink.event("req", "done", job=rid,
+                         seconds=seconds, slices=b.slices)
+        try:
+            os.remove(self._ckpt_path(rid))
+        except OSError:
+            pass
+
+    def _save_member_ckpt(self, rec: RequestRecord, st) -> None:
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            save_checkpoint,
+        )
+
+        d = self.request_dir(rec.request_id)
+        os.makedirs(d, exist_ok=True)
+        save_checkpoint(self._ckpt_path(rec.request_id), st)
+
+    def _park(self, b: _Batch, reason: str) -> None:
+        """Dissolve the batch at a slice boundary: every unfinished
+        member checkpoints and requeues (journaled), so the next
+        formation — with joiners, without diverged lanes, or after the
+        preempting key — resumes bit-exactly."""
+        for i, rec in enumerate(b.reqs):
+            if rec is None or rec.state not in ("batched", "running"):
+                continue
+            self._save_member_ckpt(rec, b.estate.member(i))
+            self._transition(rec.request_id, "requeued", reason=reason,
+                             checkpoint=self._ckpt_path(rec.request_id))
+        self._batch = None
+
+    # ------------------------------------------------------------------ #
+    # The slice loop
+    # ------------------------------------------------------------------ #
+    def _handle_divergence(self, b: _Batch, err, estate) -> None:
+        from multigpu_advectiondiffusion_tpu.resilience.errors import (
+            EnsembleMemberDivergedError,
+        )
+
+        assert isinstance(err, EnsembleMemberDivergedError)
+        bad = set(err.members)
+        jobs = []
+        for i in sorted(bad):
+            rec = b.reqs[i] if i < len(b.reqs) else None
+            if rec is None:
+                continue  # a clone lane diverged with its original
+            jobs.append(rec.request_id)
+            norm = err.member_norms[err.members.index(i)]
+            self._fail(rec, reason=f"diverged: {err.reason}",
+                       forensics={
+                           "type": type(err).__name__,
+                           "member": i,
+                           "batch": b.batch_id,
+                           "step": err.step,
+                           "t": err.t,
+                           "norm": norm,
+                           "reason": err.reason,
+                       })
+        self._sink.event("serve", "divergence", batch=b.batch_id,
+                         jobs=jobs)
+        # survivors re-batch from their PRE-slice state: the diverged
+        # lanes polluted only themselves, but the pre-slice state is
+        # the last one every survivor is known-healthy at
+        self._park(b, reason="divergence_rebatch")
+
+    def _joiners(self, b: _Batch) -> int:
+        return sum(
+            1 for r in self.queue.batchable()
+            if coalesce_key(r.spec) == b.key
+        )
+
+    def _preempting(self, b: _Batch) -> Optional[RequestRecord]:
+        for r in self.queue.batchable():
+            if coalesce_key(r.spec) != b.key and (
+                r.spec.priority > b.priority
+            ):
+                return r
+        return None
+
+    def _tick_batch(self) -> bool:
+        if self._batch is None:
+            self._batch = self._form_batch()
+            if self._batch is None:
+                return False
+        b = self._batch
+        if not b.started:
+            for rec in b.reqs:
+                if rec is not None and rec.state == "batched":
+                    self._transition(
+                        rec.request_id, "running",
+                        attempt=max(rec.attempts, 1),
+                        batch=b.batch_id, slices=b.slices,
+                    )
+            b.started = True
+        t0 = time.monotonic()
+        estate = b.ens.advance_to(b.estate, list(b.te),
+                                  max_steps=self.slice_steps)
+        try:
+            b.ens.check_health(estate, growth=self.growth)
+        except Exception as err:  # EnsembleMemberDivergedError
+            from multigpu_advectiondiffusion_tpu.resilience.errors import (
+                EnsembleMemberDivergedError,
+            )
+
+            if isinstance(err, EnsembleMemberDivergedError):
+                self._handle_divergence(b, err, estate)
+                return True
+            raise
+        prev_it = b.prev_it
+        b.estate = estate
+        b.slices += 1
+        b.prev_it = np.asarray(estate.it).copy()
+        t_np = np.asarray(estate.t, dtype=np.float64)
+        it_np = b.prev_it
+        done = 0
+        for i, rec in enumerate(b.reqs):
+            if rec is None or rec.state != "running":
+                continue
+            te = b.te[i]
+            finished = (
+                t_np[i] >= te - _finish_eps(te)
+                or int(it_np[i]) == int(prev_it[i])  # frozen lane
+            )
+            if finished:
+                self._finish(rec, b, i, estate)
+                done += 1
+            elif b.slices % self.checkpoint_every == 0:
+                self._save_member_ckpt(rec, estate.member(i))
+        active = len(b.active())
+        self._sink.event(
+            "serve", "slice", batch=b.batch_id, slice=b.slices,
+            active=active, done=done,
+            occupancy=round(active / max(1, len(b.reqs)), 4),
+            seconds=round(time.monotonic() - t0, 6),
+        )
+        if self.ledger.lookup(b.key) is None:
+            # first completed slice for this key: the executable exists
+            # now — journal the warmth so a restarted server knows
+            self.ledger.observe(b.key, compile_seconds=0.0)
+            self.journal.append("note", note="warm", key=b.key)
+        if active == 0:
+            self._batch = None
+            return True
+        pre = self._preempting(b)
+        if pre is not None:
+            self._sink.event(
+                "serve", "preempt", batch=b.batch_id,
+                for_job=pre.request_id, parked=active,
+            )
+            self._park(b, reason="preempted")
+            return True
+        joiners = self._joiners(b)
+        if joiners and active < self.max_batch:
+            self._sink.event("serve", "join", batch=b.batch_id,
+                             waiting=joiners)
+            self._park(b, reason="rebatch_join")
+        return True
+
+    # ------------------------------------------------------------------ #
+    # The loop
+    # ------------------------------------------------------------------ #
+    def tick(self) -> dict:
+        self.recover()
+        self._ingest()
+        progressed = self._tick_batch()
+        return {
+            "progressed": progressed,
+            "open": len(self.queue.open_requests()),
+        }
+
+    def state_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for rec in self.queue.requests.values():
+            counts[rec.state] = counts.get(rec.state, 0) + 1
+        return counts
+
+    def serve(self, until_idle: bool = True,
+              max_seconds: Optional[float] = None,
+              max_ticks: Optional[int] = None,
+              poll_seconds: float = 0.05) -> dict:
+        """The serving loop. ``until_idle`` returns once every request
+        is terminal; otherwise serve runs until a signal kills the
+        process — the journal makes that safe at any instant."""
+        self.recover()
+        self._sink.event(
+            "serve", "start", root=self.root,
+            max_batch=self.max_batch, slice_steps=self.slice_steps,
+            queue_bound=self.queue_bound,
+        )
+        t0 = time.monotonic()
+        ticks = 0
+        reason = "idle"
+        while True:
+            out = self.tick()
+            ticks += 1
+            if not out["progressed"]:
+                self._stalled_ticks += 1
+            else:
+                self._stalled_ticks = 0
+            if max_ticks is not None and ticks >= max_ticks:
+                reason = "ticks"
+                break
+            if max_seconds is not None and (
+                time.monotonic() - t0 > max_seconds
+            ):
+                reason = "timeout"
+                break
+            if until_idle:
+                if out["open"] == 0 and self._batch is None:
+                    reason = "idle"
+                    break
+                if self._stalled_ticks > 50 and self._batch is None:
+                    # open requests nothing can batch (e.g. everything
+                    # deferred) — refuse to spin forever
+                    reason = "stalled"
+                    break
+            if not out["progressed"]:
+                time.sleep(poll_seconds)
+        outcome = {"reason": reason, "states": self.state_counts()}
+        self._sink.event("serve", "stop", reason=reason,
+                         states=outcome["states"])
+        return outcome
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self.journal.close()
+        close = getattr(self._sink, "close", None)
+        if callable(close):
+            close()

@@ -728,6 +728,128 @@ def scheduler_timeline(streams: List[Stream]) -> dict:
     }
 
 
+def request_timeline(streams: List[Stream]) -> dict:
+    """Request-serving timeline from a request server's
+    ``serve:*``/``req:*`` events (``service/server.py`` streams them to
+    ``serve_events.jsonl``): per-request state trajectories with
+    aligned times, batch membership, slice progress/occupancy, sheds,
+    joins, preemptions, member-attributed divergences and recovery
+    replays — the consumable view of the request journal. Empty dict
+    when the streams carry no serving events."""
+    reqs: Dict[str, dict] = {}
+    batches: Dict[str, dict] = {}
+    sheds = []
+    preempts = []
+    divergences = []
+    recoveries = []
+
+    def _req(rid) -> dict:
+        return reqs.setdefault(rid, {
+            "request": rid, "states": [], "priority": None, "key": None,
+            "warm": None, "batches": [], "slices": None, "final": None,
+            "seconds": None, "fail_reason": None,
+        })
+
+    for s in streams:
+        for ev in s.events:
+            kind, name = ev.get("kind"), ev.get("name")
+            if kind == "req":
+                r = _req(ev.get("job"))
+                gt = round(s.gt(ev), 6)
+                if name == "submit":
+                    r["priority"] = ev.get("priority")
+                    r["states"].append({"t": gt, "state": "received"})
+                elif name == "state":
+                    r["states"].append(
+                        {"t": gt, "state": ev.get("to"),
+                         "reason": ev.get("reason")}
+                    )
+                    r["final"] = ev.get("to")
+                elif name == "done":
+                    r["seconds"] = ev.get("seconds")
+                    r["slices"] = ev.get("slices")
+                elif name == "failed":
+                    r["fail_reason"] = ev.get("reason")
+            elif kind == "serve":
+                gt = round(s.gt(ev), 6)
+                if name == "admit":
+                    r = _req(ev.get("job"))
+                    r["key"] = ev.get("key")
+                    r["warm"] = ev.get("warm")
+                elif name == "shed":
+                    sheds.append({
+                        "t": gt, "request": ev.get("job"),
+                        "open": ev.get("open"), "bound": ev.get("bound"),
+                        "retry_after_s": ev.get("retry_after_s"),
+                    })
+                elif name == "batch":
+                    batches[ev.get("batch")] = {
+                        "batch": ev.get("batch"), "t": gt,
+                        "key": ev.get("key"),
+                        "members": ev.get("members"),
+                        "lanes": ev.get("lanes"),
+                        "slices": 0, "occupancy": [],
+                    }
+                elif name == "slice":
+                    b = batches.get(ev.get("batch"))
+                    if b is not None:
+                        b["slices"] = max(
+                            b["slices"], int(ev.get("slice") or 0)
+                        )
+                        occ = ev.get("occupancy")
+                        if occ is not None:
+                            b["occupancy"].append(occ)
+                elif name == "preempt":
+                    preempts.append({
+                        "t": gt, "batch": ev.get("batch"),
+                        "for_request": ev.get("for_job"),
+                        "parked": ev.get("parked"),
+                    })
+                elif name == "divergence":
+                    divergences.append({
+                        "t": gt, "batch": ev.get("batch"),
+                        "requests": ev.get("jobs"),
+                    })
+                elif name == "recover":
+                    recoveries.append({
+                        "t": gt,
+                        "records": ev.get("records"),
+                        "torn_lines": ev.get("torn_lines"),
+                        "requests": ev.get("requests"),
+                        "requeued": ev.get("requeued"),
+                        "failed": ev.get("failed"),
+                    })
+    if not reqs and not recoveries and not batches:
+        return {}
+    for r in reqs.values():
+        ts = [p["t"] for p in r["states"]]
+        r["span_s"] = (
+            round(max(ts) - min(ts), 6) if len(ts) > 1 else 0.0
+        )
+        # batch membership is not carried per-request in the stream;
+        # attribute by coalesce-key match
+        r["batches"] = [
+            b["batch"] for b in batches.values()
+            if r["key"] is not None and b.get("key") == r["key"]
+        ]
+    mean_occ = None
+    occs = [o for b in batches.values() for o in b["occupancy"]]
+    if occs:
+        mean_occ = round(sum(occs) / len(occs), 4)
+    return {
+        "requests": sorted(
+            reqs.values(),
+            key=lambda r: r["states"][0]["t"] if r["states"] else 0.0,
+        ),
+        "batches": sorted(batches.values(), key=lambda b: b["t"]),
+        "sheds": sheds,
+        "preemptions": preempts,
+        "divergences": divergences,
+        "recoveries": recoveries,
+        "mean_occupancy": mean_occ,
+    }
+
+
 # --------------------------------------------------------------------- #
 # The report
 # --------------------------------------------------------------------- #
@@ -750,6 +872,9 @@ class TraceReport:
     # scheduler queue timeline (sched:*/job:* events from a service
     # daemon's stream) — empty on batch-mode streams
     queue: dict = dataclasses.field(default_factory=dict)
+    # request-serving timeline (serve:*/req:* events from a request
+    # server's stream) — empty on non-serving streams
+    serving: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -923,6 +1048,51 @@ class TraceReport:
             for p in self.queue.get("preemptions", ()):
                 add(f"   preempt: {p['victim']} -> {p['for_job']} "
                     f"(blocked on {p.get('blocked')}) at t={p['t']:.3f}")
+        sv = self.serving
+        if sv.get("requests") or sv.get("recoveries"):
+            add("-" * 68)
+            add(" request serving timeline (server serve:*/req:* events)")
+            for rc in sv.get("recoveries", ()):
+                add(f"   t={rc['t']:.3f} recovery: "
+                    f"{rc.get('records')} journal record(s), "
+                    f"{rc.get('torn_lines')} torn, "
+                    f"{rc.get('requeued')} requeued, "
+                    f"{rc.get('failed')} failed")
+            for r in sv.get("requests", ()):
+                chain = " -> ".join(
+                    p["state"] for p in r["states"]
+                ) or "?"
+                warm = " [warm]" if r.get("warm") else ""
+                extra = ""
+                if r.get("slices") is not None:
+                    extra = f", {r['slices']} slice(s)"
+                if r.get("fail_reason"):
+                    extra += f", failed: {r['fail_reason']}"
+                add(f"   {r['request']} (pri {r.get('priority')}, "
+                    f"{r['span_s']:.3f} s{extra}){warm}: {chain}")
+            for b in sv.get("batches", ()):
+                occ = b.get("occupancy") or []
+                occ_note = (
+                    f", occupancy {min(occ):.2f}..{max(occ):.2f}"
+                    if occ else ""
+                )
+                add(f"   batch {b['batch']} [{str(b.get('key'))[:40]}]: "
+                    f"{b.get('members')} member(s) in "
+                    f"{b.get('lanes')} lane(s), "
+                    f"{b.get('slices')} slice(s){occ_note}")
+            for sh in sv.get("sheds", ()):
+                add(f"   shed: {sh['request']} at t={sh['t']:.3f} "
+                    f"(open {sh.get('open')}/{sh.get('bound')}, "
+                    f"retry after {sh.get('retry_after_s')} s)")
+            for p in sv.get("preemptions", ()):
+                add(f"   preempt: batch {p['batch']} parked "
+                    f"{p.get('parked')} member(s) for "
+                    f"{p['for_request']} at t={p['t']:.3f}")
+            for d in sv.get("divergences", ()):
+                add(f"   divergence: batch {d['batch']} failed "
+                    f"{d.get('requests')} at t={d['t']:.3f}")
+            if sv.get("mean_occupancy") is not None:
+                add(f"   mean batch occupancy: {sv['mean_occupancy']}")
         add("=" * 68)
         return "\n".join(lines)
 
@@ -951,4 +1121,5 @@ def analyze(paths: Sequence[str]) -> TraceReport:
         xla=measured_introspection(streams),
         physics=physics_diagnostics(streams),
         queue=scheduler_timeline(streams),
+        serving=request_timeline(streams),
     )

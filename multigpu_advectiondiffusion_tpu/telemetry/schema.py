@@ -159,7 +159,40 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "retry": {"job", "attempt", "policy", "dt_scale"},
         "adopt": {"job", "pid"},
         "journal_degraded": {"pending"},
+        # hardened spool ingest (service/queue.ingest_spool): a torn or
+        # corrupt mailbox entry is quarantined and reported, never fatal
+        "spool_skip": {"file", "error"},
         "stop": {"reason", "states"},
+    },
+    # continuous-batching request server (service/server.py, ISSUE 17):
+    # the daemon's own decisions, streamed to <root>/serve_events.jsonl
+    # — recovery replays, per-request admission/shed verdicts, batch
+    # formation, slice progress (the request timeline's spine), joins,
+    # preemptions, member-attributed divergence, spool quarantines
+    "serve": {
+        "start": {"root", "max_batch", "slice_steps", "queue_bound"},
+        "recover": {"records", "torn_lines", "requests", "requeued",
+                    "failed"},
+        "admit": {"job", "key", "warm"},
+        "defer": {"job", "reason"},
+        "shed": {"job", "open", "bound", "retry_after_s"},
+        "batch": {"batch", "key", "members", "lanes"},
+        "slice": {"batch", "slice", "active", "done", "occupancy",
+                  "seconds"},
+        "join": {"batch", "waiting"},
+        "preempt": {"batch", "for_job", "parked"},
+        "divergence": {"batch", "jobs"},
+        "spool_skip": {"file", "error"},
+        "stop": {"reason", "states"},
+    },
+    # per-request lifecycle in the server's stream: every journal
+    # transition is mirrored as a req:state event so tpucfd-trace can
+    # render the request timeline without reading the journal
+    "req": {
+        "submit": {"job", "priority"},
+        "state": {"job", "from", "to"},
+        "done": {"job", "seconds", "slices"},
+        "failed": {"job", "reason"},
     },
     # per-job lifecycle in the scheduler's stream, namespaced by job
     # id: every journal transition is mirrored as a job:state event so

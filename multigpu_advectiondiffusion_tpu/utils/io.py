@@ -401,6 +401,25 @@ def _ckpt_header(arr: np.ndarray, t: float, it: int, crc: int) -> bytes:
     )
 
 
+def _restored_state(u, t, it) -> SolverState:
+    """Rebuild a loaded state under the ``SolverState.create`` dtype
+    contract: ``t`` tracks ``u``'s precision (f64 only for f64 fields)
+    and ``it`` is int32. The header stores ``t`` as a double, and under
+    ``jax_enable_x64`` a bare ``jnp.asarray(float)`` would resurrect it
+    as f64 — which changes the final clamped ``dt = t_end - t`` rounding
+    on resume, so a checkpointed run would no longer be bit-identical
+    to an uninterrupted one."""
+    import jax.numpy as jnp
+
+    u = jnp.asarray(u)
+    rdt = jnp.float64 if u.dtype == jnp.float64 else jnp.float32
+    return SolverState(
+        u=u,
+        t=jnp.asarray(t, dtype=rdt),
+        it=jnp.asarray(it, dtype=jnp.int32),
+    )
+
+
 def _save_ckpt(path: str, state: SolverState) -> None:
     import ctypes
     import zlib
@@ -469,7 +488,7 @@ def _load_ckpt(path: str) -> SolverState:
     if zlib.crc32(payload) != crc:
         raise IOError(f"checkpoint CRC mismatch (corrupt file): {path}")
     u = np.frombuffer(payload, dtype=dtype).reshape(shape)
-    return SolverState(u=jnp.asarray(u), t=jnp.asarray(t), it=jnp.asarray(it))
+    return _restored_state(u, t, it)
 
 
 def _load_ckpt_native(lib, path: str) -> SolverState:
@@ -501,9 +520,7 @@ def _load_ckpt_native(lib, path: str) -> SolverState:
         raise IOError(f"checkpoint CRC mismatch (corrupt file): {path}")
     if rc != 0:
         raise IOError(f"truncated checkpoint payload: {path}")
-    return SolverState(
-        u=jnp.asarray(out), t=jnp.asarray(t.value), it=jnp.asarray(it.value)
-    )
+    return _restored_state(out, t.value, it.value)
 
 
 def save_checkpoint(
@@ -564,10 +581,7 @@ def load_checkpoint(path: str, sharding=None) -> SolverState:
         st = _load_ckpt(path)
     else:
         with np.load(path, allow_pickle=False) as z:
-            st = SolverState(
-                u=jnp.asarray(z["u"]), t=jnp.asarray(z["t"]),
-                it=jnp.asarray(z["it"]),
-            )
+            st = _restored_state(z["u"], z["t"], z["it"])
     if sharding is not None:
         # single-file checkpoints load as one host array; honor the
         # requested placement here so direct API callers get the same
@@ -914,8 +928,11 @@ def load_checkpoint_sharded(directory: str, sharding=None) -> SolverState:
     meta, entries = _sharded_manifest(directory)
     gshape = tuple(meta["global_shape"])
     dtype = np.dtype(meta["dtype"])
-    t = jnp.asarray(meta["t"])
-    it = jnp.asarray(int(meta["it"]))
+    # scalar dtypes follow the SolverState.create contract (see
+    # _restored_state) so a sharded resume stays bit-identical too
+    rdt = jnp.float64 if dtype == np.float64 else jnp.float32
+    t = jnp.asarray(meta["t"], dtype=rdt)
+    it = jnp.asarray(int(meta["it"]), dtype=jnp.int32)
 
     if sharding is None:
         u = _assemble_block(directory, entries, dtype, (0,) * len(gshape),

@@ -1,0 +1,163 @@
+#!/usr/bin/env bash
+# Request-serving gate (ISSUE 17): the continuous-batching server's
+# end-to-end chaos proof, runnable in CI.
+#
+# 1. Kill/replay gate: submit 4 coalescible requests (mixed widths,
+#    priorities and an SLO deadline), start the server, SIGKILL it the
+#    moment a batch has marched at least one slice, restart it, and
+#    assert (a) every request reached `done` EXACTLY once across both
+#    server lives, (b) the request journal linearizes
+#    (`serve-requests --verify --require-complete`), (c) the second
+#    incarnation journaled a crash_recovery requeue, and (d) every
+#    request published a result.bin and a `done` verdict.
+# 2. `--selftest`: proves the gate's assertions have teeth —
+#    a dropped-request fixture (a request the server admitted but
+#    never answered) must trip `--verify --require-complete` while
+#    plain `--verify` still passes, and a torn spool file (the
+#    half-written JSON a crashed client leaves) must be quarantined
+#    as `<name>.bad` with a named journal record, not crash the
+#    server or block its neighbours.
+#
+#   ./out/serve_gate.sh             # the kill/replay gate
+#   ./out/serve_gate.sh --selftest  # dropped-request + torn-spool proofs
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+CLI=(python -m multigpu_advectiondiffusion_tpu.cli)
+REQ=(request --model diffusion --n 12 12 --ic gaussian)
+
+if [[ "${1:-}" == "--selftest" ]]; then
+    echo "serve_gate: selftest 1 — a dropped request must trip" \
+         "--require-complete"
+    ROOT="$TMP/dropped"
+    # a horizon the 1.5s serving window cannot reach: the request is
+    # admitted and marching (journalled, non-terminal) when the server
+    # stops — exactly the state a lost request leaves behind
+    "${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id drop1 \
+        --t-end 50.0
+    "${CLI[@]}" serve-requests --root "$ROOT" --max-batch 2 \
+        --slice-steps 1 --poll 0.02 --max-seconds 1.5
+    # the journal still linearizes (every transition legal) ...
+    "${CLI[@]}" serve-requests --root "$ROOT" --verify
+    # ... but completeness must trip on the unanswered request
+    if "${CLI[@]}" serve-requests --root "$ROOT" --verify \
+        --require-complete > "$TMP/drop.out" 2>&1; then
+        echo "serve_gate: SELFTEST FAILED — dropped request passed" \
+             "--require-complete" >&2
+        exit 1
+    fi
+    grep -q "terminal" "$TMP/drop.out" || {
+        echo "serve_gate: SELFTEST FAILED — wrong trip reason:" >&2
+        cat "$TMP/drop.out" >&2
+        exit 1
+    }
+    echo "serve_gate: selftest 1 OK — dropped request tripped the gate"
+
+    echo "serve_gate: selftest 2 — a torn spool file must be" \
+         "quarantined, not served or fatal"
+    ROOT="$TMP/torn"
+    "${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id good1 \
+        --t-end 0.15
+    # the torn tail a crashed client leaves mid-write
+    printf '{"request_id": "torn1", "model": "diff' \
+        > "$ROOT/spool/zz-torn.json"
+    "${CLI[@]}" serve-requests --root "$ROOT" --max-batch 2 \
+        --slice-steps 4 --poll 0.02 --until-idle
+    [[ -f "$ROOT/spool/zz-torn.json.bad" ]] || {
+        echo "serve_gate: SELFTEST FAILED — torn spool file not" \
+             "quarantined as .bad" >&2
+        exit 1
+    }
+    grep -q '"spool_skip"' "$ROOT/journal.jsonl" || {
+        echo "serve_gate: SELFTEST FAILED — no spool_skip journal" \
+             "record for the torn file" >&2
+        exit 1
+    }
+    python - "$ROOT" <<'PY'
+import json, sys
+v = json.load(open(f"{sys.argv[1]}/requests/good1/verdict.json"))
+assert v["status"] == "done", f"good neighbour not served: {v}"
+PY
+    "${CLI[@]}" serve-requests --root "$ROOT" --verify --require-complete
+    echo "serve_gate: selftest 2 OK — torn spool quarantined," \
+         "neighbour served"
+    echo "serve_gate: selftest PASS"
+    exit 0
+fi
+
+ROOT="$TMP/root"
+echo "serve_gate: submitting 4 coalescible requests (mixed widths," \
+     "priorities, one SLO deadline)"
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r1 --t-end 0.5 \
+    --ic-param width=0.08
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r2 --t-end 0.5 \
+    --ic-param width=0.10
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r3 --t-end 0.4 \
+    --priority 5
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r4 --t-end 0.45 \
+    --deadline 300
+
+echo "serve_gate: server up; waiting for the first marched slice"
+"${CLI[@]}" serve-requests --root "$ROOT" --until-idle --max-batch 4 \
+    --slice-steps 2 --poll 0.02 > "$TMP/server1.out" 2>&1 &
+SERVER=$!
+for _ in $(seq 1 2400); do
+    if grep -q '"slice"' "$ROOT/serve_events.jsonl" 2> /dev/null; then
+        break
+    fi
+    if ! kill -0 "$SERVER" 2> /dev/null; then
+        echo "serve_gate: server exited before the kill window:" >&2
+        cat "$TMP/server1.out" >&2
+        exit 1
+    fi
+    sleep 0.05
+done
+grep -q '"slice"' "$ROOT/serve_events.jsonl" || {
+    echo "serve_gate: server never marched a slice" >&2
+    exit 1
+}
+
+echo "serve_gate: SIGKILL the server mid-batch (pid $SERVER)"
+kill -9 "$SERVER"
+wait "$SERVER" 2> /dev/null || true
+
+echo "serve_gate: restart — journal replay must answer every request"
+"${CLI[@]}" serve-requests --root "$ROOT" --until-idle --max-batch 4 \
+    --slice-steps 2 --poll 0.02
+
+echo "serve_gate: verify the request journal linearizes, complete"
+"${CLI[@]}" serve-requests --root "$ROOT" --verify --require-complete
+
+python - "$ROOT" <<'PY'
+import json, os, sys
+
+root = sys.argv[1]
+records = [json.loads(l) for l in open(os.path.join(
+    root, "journal.jsonl")) if l.strip()]
+recs = [r.get("record", r) for r in records]
+rids = ("r1", "r2", "r3", "r4")
+for rid in rids:
+    dones = [r for r in recs if r.get("type") == "state"
+             and r.get("job") == rid and r.get("to") == "done"]
+    assert len(dones) == 1, \
+        f"{rid}: answered {len(dones)} times, want exactly once"
+    assert os.path.exists(os.path.join(
+        root, "requests", rid, "result.bin")), f"{rid}: no result.bin"
+    v = json.load(open(os.path.join(root, "requests", rid,
+                                    "verdict.json")))
+    assert v["status"] == "done", f"{rid}: verdict {v}"
+requeues = [r for r in recs if r.get("type") == "state"
+            and r.get("reason") == "crash_recovery"]
+assert requeues, "no crash_recovery requeue journalled on restart"
+evs = [json.loads(l) for l in open(os.path.join(
+    root, "serve_events.jsonl")) if l.strip()]
+recover = [e for e in evs
+           if e["kind"] == "serve" and e["name"] == "recover"]
+assert recover, "second server life journalled no serve:recover"
+print(f"serve_gate: OK — {len(rids)} requests answered exactly once, "
+      f"{len(requeues)} requeued after SIGKILL, journal complete")
+PY
+echo "serve_gate: PASS"

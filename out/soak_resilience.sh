@@ -13,6 +13,9 @@
 #   LINT_GATE=1 ./out/soak_resilience.sh     # also run the static-
 #                                   # analysis gate (clean tree +
 #                                   # rule selftests) after
+#   SERVE_GATE=1 ./out/soak_resilience.sh    # also run the request-
+#                                   # serving kill/replay gate and its
+#                                   # selftest after (out/serve_gate.sh)
 #
 # Runs on the virtual CPU backend (no TPU needed), same as tier-1.
 set -euo pipefail
@@ -51,4 +54,12 @@ if [[ "${LINT_GATE:-0}" == "1" ]]; then
   # seeded-violation selftest + the halo verifier's injected
   # off-by-one — see out/lint_gate.sh
   JAX_PLATFORMS=cpu ./out/lint_gate.sh
+fi
+
+if [[ "${SERVE_GATE:-0}" == "1" ]]; then
+  # and on the request server: its assertion teeth (dropped-request +
+  # torn-spool fixtures), then the SIGKILL-mid-batch kill/replay gate
+  # — see out/serve_gate.sh
+  JAX_PLATFORMS=cpu ./out/serve_gate.sh --selftest
+  JAX_PLATFORMS=cpu ./out/serve_gate.sh
 fi
