@@ -686,6 +686,12 @@ def _serving_rows(on_tpu: bool):
         out = srv.serve(until_idle=True, poll_seconds=0.001)
         wall = time.perf_counter() - t0
         srv.close()
+        # max queue depth comes from the server's own exported gauge
+        # watermark (the snapshot the close() above just published)
+        max_depth = None
+        g = srv.metrics.gauges.get("serve_queue_depth")
+        if g is not None and g.max is not None:
+            max_depth = int(g.max)
         lat = []
         for rid in rids:
             p = os.path.join(root, "requests", rid, "result.json")
@@ -708,28 +714,36 @@ def _serving_rows(on_tpu: bool):
                             and e.get("occupancy") is not None):
                         occ.append(e["occupancy"])
         done = (out.get("states") or {}).get("done", 0)
-        return wall, sorted(lat), occ, done
+        return wall, sorted(lat), occ, done, max_depth
 
     work = tempfile.mkdtemp(prefix="tpucfd_bench_serve_")
     try:
         # warm round per configuration: pays the B=8 and B=1 compiles
         _round(os.path.join(work, "warm_coal"), B)
         _round(os.path.join(work, "warm_seq"), 1)
-        coal_s, lat, occ, coal_done = _round(
+        coal_s, lat, occ, coal_done, max_depth = _round(
             os.path.join(work, "coalesced"), B
         )
-        seq_s, seq_lat, _, seq_done = _round(
+        seq_s, seq_lat, _, seq_done, _ = _round(
             os.path.join(work, "sequential"), 1
         )
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
-    def _pct(sorted_ms, q):
-        if not sorted_ms:
-            return None
-        idx = min(len(sorted_ms) - 1,
-                  max(0, int(round(q * (len(sorted_ms) - 1)))))
-        return round(sorted_ms[idx], 3)
+    # one quantile codepath (ISSUE 18): latencies go through the shared
+    # fixed-log-boundary histogram — the SAME estimator the fleet's
+    # merged snapshots and tpucfd-status report, so a bench row and a
+    # dashboard never disagree about what "p99" means
+    from multigpu_advectiondiffusion_tpu.telemetry.metrics import (
+        Histogram,
+    )
+
+    def _pct(ms_values, q):
+        h = Histogram("bench_latency_ms")
+        for v in ms_values:
+            h.observe(v)
+        est = h.quantile(q)
+        return round(est, 3) if est is not None else None
 
     row = {
         "metric": f"serving_diffusion2d_b{B}_rps",
@@ -738,7 +752,9 @@ def _serving_rows(on_tpu: bool):
         "requests": B,
         "seconds": round(coal_s, 5),
         "p50_ms": _pct(lat, 0.50),
+        "p95_ms": _pct(lat, 0.95),
         "p99_ms": _pct(lat, 0.99),
+        "max_queue_depth": max_depth,
         "occupancy": round(sum(occ) / len(occ), 4) if occ else None,
         "sequential_seconds": round(seq_s, 5),
         "sequential_p50_ms": _pct(seq_lat, 0.50),

@@ -85,12 +85,18 @@ PRECISION_FIELDS = ("storage_dtype", "precision")
 # before the dma rung carry no field and read as "collective".
 SCHEDULE_FIELDS = ("exchange",)
 
-# Request-serving columns (ISSUE 17): the ``serving_*`` rows carry the
-# coalesced server's latency percentiles, mean batch occupancy and the
-# coalesced-over-sequential wall ratio beside the req/s headline. Same
-# coverage-note discipline: provenance, not gated throughput; rows
-# from rounds before the request server carry none of these.
-SERVING_FIELDS = ("p50_ms", "p99_ms", "occupancy", "vs_sequential")
+# Request-serving columns (ISSUE 17, widened by ISSUE 18): the
+# ``serving_*`` rows carry the coalesced server's latency percentiles
+# (p50/p95/p99, re-sourced through the shared fixed-log-boundary
+# histogram in telemetry/metrics.py — the same estimator the fleet's
+# merged snapshots report), mean batch occupancy, the queue-depth
+# watermark from the server's exported gauge, and the coalesced-over-
+# sequential wall ratio beside the req/s headline. Same coverage-note
+# discipline: provenance, not gated throughput; rows from rounds
+# before the request server carry none of these, and rounds before
+# the metrics layer lack p95_ms/max_queue_depth.
+SERVING_FIELDS = ("p50_ms", "p95_ms", "p99_ms", "occupancy",
+                  "max_queue_depth", "vs_sequential")
 
 
 def row_family(key: Optional[str]) -> Optional[str]:

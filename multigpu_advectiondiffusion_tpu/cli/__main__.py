@@ -596,6 +596,20 @@ def build_parser() -> argparse.ArgumentParser:
                             "[--operand diffusivity=0.5 --wait 60]")
     service_cli.configure_request(p)
 
+    # tpucfd-status: the fleet dashboard (also standalone:
+    # python -m multigpu_advectiondiffusion_tpu.cli.status)
+    from multigpu_advectiondiffusion_tpu.cli import status as status_cli
+
+    p = sub.add_parser("status",
+                       help="fleet status dashboard (tpucfd-status): "
+                            "journal-replayed request/job states + "
+                            "merged cross-process metrics snapshots "
+                            "(latency quantiles, queue depth, SLO "
+                            "verdict) — live tty redraw, --once for "
+                            "scripts, --json for machines")
+    status_cli.configure_parser(p)
+    p.set_defaults(fn=status_cli.run)
+
     return ap
 
 

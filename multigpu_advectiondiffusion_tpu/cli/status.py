@@ -1,0 +1,289 @@
+"""``tpucfd-status``: the live serving dashboard (ISSUE 18).
+
+One screen answering "is the fleet healthy right now": request/job
+state counts replayed from the CRC journal, the merged cross-process
+metrics snapshot (latency quantiles through the one shared histogram
+codepath, queue depth + its watermark, shed/fail counters), and the
+deadline-SLO verdict (journaled ``slo_alert``/``slo_resolve`` notes —
+an alert the dead server raised is still an alert).
+
+Three consumers, three modes:
+
+* a person at a tty — live redraw (the multi-line sibling of
+  ``ProgressLine``'s carriage-return discipline: repaint in place,
+  never scroll);
+* a script — ``--once`` renders a single frame and exits;
+* a machine — ``--json`` emits the status dict verbatim.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def configure_parser(ap: argparse.ArgumentParser) -> None:
+    """Arguments shared by the standalone prog and the CLI subcommand."""
+    ap.add_argument("--root", required=True, metavar="DIR",
+                    help="service root (request server or scheduler): "
+                         "journal.jsonl, metrics/, and the event "
+                         "streams live here")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (the script mode; "
+                         "default: live tty redraw)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the status dict as JSON (implies "
+                         "--once unless --interval polling is wanted)")
+    ap.add_argument("--interval", type=float, default=1.0, metavar="S",
+                    help="live-mode refresh cadence (default 1)")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    metavar="S",
+                    help="live mode: stop after S wall seconds "
+                         "(default: until Ctrl-C)")
+    ap.add_argument("--metrics", default=None, metavar="PATH",
+                    help="also stream this verb's own status:render "
+                         "events to a JSONL sink at PATH")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpucfd-status",
+        description="fleet status: journal-replayed request/job "
+                    "states + merged metrics snapshots + SLO verdict, "
+                    "as a live tty dashboard, one-shot text frame, "
+                    "or JSON",
+    )
+    configure_parser(ap)
+    return ap
+
+
+# --------------------------------------------------------------------- #
+# Collection
+# --------------------------------------------------------------------- #
+def _state_counts(root: str) -> dict:
+    """Replay the root's journal into request/job state counts. The
+    journal is the durable truth (the metrics snapshot is a cadence
+    behind by design), so the dashboard's state table reads it."""
+    from multigpu_advectiondiffusion_tpu.service.journal import Journal
+
+    out = {"requests": {}, "jobs": {}, "journal_records": 0,
+           "torn_lines": 0, "slo": {"alerts": 0, "resolves": 0,
+                                    "firing": False, "last_alert": None}}
+    path = os.path.join(root, "journal.jsonl")
+    if not os.path.exists(path):
+        return out
+    records, torn = Journal.replay(path)
+    out["journal_records"] = len(records)
+    out["torn_lines"] = int(torn)
+    is_serving = os.path.isdir(os.path.join(root, "requests"))
+    key = "requests" if is_serving else "jobs"
+    states = {}
+    for rec in records:
+        rtype = rec.get("type")
+        if rtype == "submit" and rec.get("job"):
+            states[rec["job"]] = "received" if is_serving else "queued"
+        elif rtype == "state" and rec.get("job"):
+            states[rec["job"]] = rec.get("to")
+        elif rtype == "note":
+            note = rec.get("note")
+            if note == "slo_alert":
+                out["slo"]["alerts"] += 1
+                out["slo"]["firing"] = True
+                out["slo"]["last_alert"] = {
+                    k: rec.get(k)
+                    for k in ("slo", "window_s", "burn_rate",
+                              "threshold", "wall")
+                    if rec.get(k) is not None
+                }
+            elif note == "slo_resolve":
+                out["slo"]["resolves"] += 1
+                out["slo"]["firing"] = False
+    for state in states.values():
+        out[key][state] = out[key].get(state, 0) + 1
+    return out
+
+
+def collect_status(root: str) -> dict:
+    """One status frame: journal truth + merged metrics + quantiles."""
+    from multigpu_advectiondiffusion_tpu.telemetry.metrics import (
+        merge_snapshot_dirs,
+        snapshot_histogram,
+    )
+
+    root = os.path.abspath(root)
+    status = {"root": root, "wall_time": round(time.time(), 3)}
+    status.update(_state_counts(root))
+    merged = merge_snapshot_dirs(os.path.join(root, "metrics"))
+    status["metrics"] = {
+        "snapshots": merged.get("snapshots", 0),
+        "skipped": merged.get("skipped", []),
+        "procs": merged.get("merged_procs", []),
+        "wall_time": merged.get("wall_time"),
+        "counters": merged.get("counters", {}),
+        "gauges": merged.get("gauges", {}),
+    }
+    quantiles = {}
+    for name in ("serve_request_latency_seconds", "serve_slice_seconds",
+                 "serve_batch_occupancy", "serve_journal_fsync_seconds",
+                 "sched_job_seconds"):
+        hist = snapshot_histogram(merged, name)
+        if hist is None or hist.count == 0:
+            continue
+        quantiles[name] = {
+            "count": hist.count,
+            "mean": round(hist.mean(), 6),
+            "p50": round(hist.quantile(0.50), 6),
+            "p95": round(hist.quantile(0.95), 6),
+            "p99": round(hist.quantile(0.99), 6),
+            "max": hist.max,
+        }
+    status["quantiles"] = quantiles
+    return status
+
+
+# --------------------------------------------------------------------- #
+# Rendering
+# --------------------------------------------------------------------- #
+def _fmt_states(states: dict) -> str:
+    return (", ".join(f"{k}={v}" for k, v in sorted(states.items()))
+            or "none")
+
+
+def render_text(status: dict) -> List[str]:
+    """The dashboard frame as lines (the live mode repaints them)."""
+    met = status["metrics"]
+    counters = met["counters"]
+    gauges = met["gauges"]
+    lines = [
+        f"tpucfd-status  {status['root']}",
+        f"  journal   {status['journal_records']} record(s), "
+        f"{status['torn_lines']} torn line(s)",
+    ]
+    if status["requests"]:
+        lines.append(f"  requests  {_fmt_states(status['requests'])}")
+    if status["jobs"]:
+        lines.append(f"  jobs      {_fmt_states(status['jobs'])}")
+    depth = gauges.get("serve_queue_depth") or {}
+    if depth:
+        lines.append(
+            f"  queue     depth={depth.get('value')} "
+            f"max={depth.get('max')}"
+        )
+    flow = []
+    for label, key in (("recv", "serve_requests_received_total"),
+                       ("done", "serve_requests_done_total"),
+                       ("failed", "serve_requests_failed_total"),
+                       ("shed", "serve_requests_shed_total"),
+                       ("requeued", "serve_requests_requeued_total"),
+                       ("slices", "serve_slices_total")):
+        if key in counters:
+            flow.append(f"{label}={counters[key]}")
+    if flow:
+        lines.append("  serving   " + " ".join(flow))
+    lat = status["quantiles"].get("serve_request_latency_seconds")
+    if lat:
+        lines.append(
+            f"  latency   p50={lat['p50'] * 1e3:.1f}ms "
+            f"p95={lat['p95'] * 1e3:.1f}ms "
+            f"p99={lat['p99'] * 1e3:.1f}ms "
+            f"(n={lat['count']})"
+        )
+    sl = status["quantiles"].get("serve_slice_seconds")
+    if sl:
+        lines.append(
+            f"  slices    p50={sl['p50'] * 1e3:.1f}ms "
+            f"p99={sl['p99'] * 1e3:.1f}ms (n={sl['count']})"
+        )
+    slo = status["slo"]
+    verdict = "FIRING" if slo["firing"] else "ok"
+    detail = ""
+    if slo["last_alert"]:
+        la = slo["last_alert"]
+        detail = (f"  last: {la.get('slo')} burn={la.get('burn_rate')}"
+                  f" window={la.get('window_s')}s")
+    lines.append(
+        f"  slo       {verdict}  alerts={slo['alerts']} "
+        f"resolves={slo['resolves']}{detail}"
+    )
+    lines.append(
+        f"  snapshots {met['snapshots']} proc(s)"
+        + (f", {len(met['skipped'])} skipped" if met["skipped"] else "")
+    )
+    return lines
+
+
+class _Redraw:
+    """Multi-line in-place repaint: ANSI cursor-up + clear-line per
+    frame on a tty (the ProgressLine discipline lifted to a block);
+    plain sequential frames when piped."""
+
+    def __init__(self, out=None):
+        self.out = out if out is not None else sys.stdout
+        self.is_tty = hasattr(self.out, "isatty") and self.out.isatty()
+        self._painted = 0
+
+    def frame(self, lines: List[str]) -> None:
+        if self.is_tty and self._painted:
+            self.out.write(f"\x1b[{self._painted}A")
+        for line in lines:
+            if self.is_tty:
+                self.out.write("\x1b[2K")
+            self.out.write(line + "\n")
+        self._painted = len(lines)
+        self.out.flush()
+
+
+# --------------------------------------------------------------------- #
+# Driver
+# --------------------------------------------------------------------- #
+def run(args) -> None:
+    from multigpu_advectiondiffusion_tpu import telemetry
+
+    once = args.once or (args.json and args.max_seconds is None)
+    redraw = _Redraw()
+    t0 = time.monotonic()
+    while True:
+        status = collect_status(args.root)
+        telemetry.event(
+            "status", "render", root=status["root"],
+            requests=sum(status["requests"].values()),
+            jobs=sum(status["jobs"].values()),
+        )
+        if args.json:
+            print(json.dumps(status, sort_keys=True))
+        else:
+            redraw.frame(render_text(status))
+        if once:
+            return
+        if args.max_seconds is not None and (
+            time.monotonic() - t0 >= args.max_seconds
+        ):
+            return
+        try:
+            time.sleep(max(0.05, args.interval))
+        except KeyboardInterrupt:
+            return
+
+
+def main(argv: Optional[list] = None) -> None:
+    args = build_parser().parse_args(argv)
+    owned = None
+    if args.metrics:
+        from multigpu_advectiondiffusion_tpu import telemetry
+
+        owned = telemetry.install(args.metrics)
+    try:
+        run(args)
+    finally:
+        if owned is not None:
+            from multigpu_advectiondiffusion_tpu import telemetry
+
+            telemetry.uninstall(owned)
+
+
+if __name__ == "__main__":
+    main()

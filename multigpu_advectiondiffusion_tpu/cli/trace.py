@@ -39,8 +39,10 @@ def configure_parser(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("streams", nargs="+", metavar="STREAM",
                     help="one or more --metrics JSONL files (rotated "
                          ".1 segments ride along automatically), or a "
-                         "directory containing them — one file per "
-                         "process of a multi-rank run")
+                         "service root directory — its top-level "
+                         "streams (rank sinks, sched_events.jsonl, "
+                         "serve_events.jsonl) AND the per-job streams "
+                         "under <root>/jobs/<id>/ are auto-discovered")
     ap.add_argument("--export", default=None, metavar="PATH",
                     help="write the merged, clock-aligned trace as "
                          "Chrome trace_event JSON — opens directly at "

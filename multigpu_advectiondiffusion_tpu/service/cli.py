@@ -183,6 +183,19 @@ def configure_serve_requests(p: argparse.ArgumentParser) -> None:
     p.add_argument("--max-seconds", type=float, default=None,
                    metavar="S",
                    help="stop serving after S wall seconds")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve a read-only /metrics (Prometheus text) "
+                        "+ /metrics.json endpoint on loopback at PORT "
+                        "(0 = ephemeral; off by default)")
+    p.add_argument("--metrics-every", type=float, default=2.0,
+                   metavar="S",
+                   help="atomic metrics-snapshot cadence under "
+                        "<root>/metrics/<proc>/ (default 2)")
+    p.add_argument("--slo-objective", type=float, default=0.99,
+                   metavar="F",
+                   help="deadline-SLO good-fraction target driving "
+                        "the burn-rate alerts (default 0.99)")
     p.add_argument("--verify", action="store_true",
                    help="no daemon: replay the request journal, print "
                         "the state table, and exit nonzero when it "
@@ -312,7 +325,13 @@ def run_serve_requests(args) -> None:
         mem_budget_bytes=args.mem_budget_mb * (1 << 20),
         checkpoint_every=args.checkpoint_every,
         socket_path=args.socket,
+        metrics_port=args.metrics_port,
+        metrics_every_s=args.metrics_every,
+        slo_objective=args.slo_objective,
     )
+    if server.metrics_port is not None:
+        print(f"-- metrics endpoint: "
+              f"http://127.0.0.1:{server.metrics_port}/metrics")
     try:
         outcome = server.serve(
             until_idle=args.until_idle,

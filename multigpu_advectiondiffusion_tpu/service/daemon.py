@@ -419,6 +419,22 @@ class Scheduler:
         self._handles: Dict[str, dict] = {}
         self._deferred: Dict[str, str] = {}
         self._recovered = False
+        # fleet metrics (ISSUE 18): the scheduler's own snapshot dir,
+        # per incarnation, unioned with the server's by
+        # telemetry.metrics.merge_snapshot_dirs
+        from multigpu_advectiondiffusion_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
+
+        self.metrics = MetricsRegistry(proc=f"daemon-{os.getpid()}")
+        self.metrics_dir = os.path.join(
+            self.root, "metrics", self.metrics.proc
+        )
+        self.metrics_every_s = 2.0
+        self._last_export = 0.0
+        self.journal.on_commit_seconds = self.metrics.histogram(
+            "sched_journal_fsync_seconds"
+        ).observe
 
     # ------------------------------------------------------------------ #
     def job_dir(self, job_id: str) -> str:
@@ -518,6 +534,7 @@ class Scheduler:
             priority=spec.priority, devices=spec.devices,
             max_retries=spec.max_retries,
         )
+        self.metrics.counter("sched_jobs_submitted_total").inc()
         if self.journal.degraded:
             self._sink.event("sched", "journal_degraded",
                              pending=len(self.journal._pending))
@@ -534,6 +551,7 @@ class Scheduler:
                 priority=rec.spec.priority, devices=rec.spec.devices,
                 max_retries=rec.spec.max_retries,
             )
+            self.metrics.counter("sched_jobs_submitted_total").inc()
 
     # ------------------------------------------------------------------ #
     # Attempt lifecycle
@@ -642,6 +660,7 @@ class Scheduler:
                 mem_in_use=info.get("mem_in_use"),
                 free_devices=free_devices,
             )
+            self.metrics.counter("sched_jobs_admitted_total").inc()
             self._start(rec, info)
             admitted += 1
         return admitted
@@ -705,6 +724,7 @@ class Scheduler:
                 attempt=rec.attempts, policy=policy,
                 dt_scale=dt_scale, reason=reason,
             )
+            self.metrics.counter("sched_retries_total").inc()
             return
         # retries exhausted for this policy: terminal, with forensics
         self._transition(rec.job_id, "failed", failure=entry,
@@ -747,6 +767,8 @@ class Scheduler:
             self._sink.event("job", "exit", job=job_id, rc=rc,
                              seconds=seconds,
                              adopted=bool(h.get("adopted")))
+            self.metrics.counter("sched_job_exits_total").inc()
+            self.metrics.histogram("sched_job_seconds").observe(seconds)
             if rc == 0:
                 self._finalize_done(rec, rc, mesh_arg=h["mesh_arg"])
             elif rc == EXIT_PREEMPTED:
@@ -790,6 +812,7 @@ class Scheduler:
             victim_priority=victim.spec.priority,
             priority=top.spec.priority,
         )
+        self.metrics.counter("sched_preemptions_total").inc()
 
     # ------------------------------------------------------------------ #
     # The loop
@@ -805,12 +828,33 @@ class Scheduler:
         if self.journal.degraded:
             self._sink.event("sched", "journal_degraded",
                              pending=len(self.journal._pending))
+        self.metrics.gauge("sched_jobs_running").set(len(self._handles))
+        self.metrics.gauge("sched_jobs_open").set(
+            len(self.queue.open_jobs())
+        )
+        self.export_metrics(force=False)
         return {
             "running": len(self._handles),
             "open": len(self.queue.open_jobs()),
             "reaped": reaped,
             "admitted": admitted,
         }
+
+    def export_metrics(self, force: bool = True) -> Optional[dict]:
+        """Publish this incarnation's atomic metrics snapshot under
+        ``metrics/<proc>/`` (throttled unless forced)."""
+        now = time.monotonic()
+        if not force and now - self._last_export < self.metrics_every_s:
+            return None
+        self._last_export = now
+        snap = self.metrics.write_snapshot(self.metrics_dir)
+        self._sink.event(
+            "metrics", "snapshot", dir=self.metrics_dir,
+            counters=len(snap["counters"]),
+            gauges=len(snap["gauges"]),
+            histograms=len(snap["histograms"]),
+        )
+        return snap
 
     def serve(self, until_idle: bool = False,
               max_seconds: Optional[float] = None) -> dict:
@@ -870,5 +914,6 @@ class Scheduler:
                 time.sleep(0.1)
 
     def close(self) -> None:
+        self.export_metrics(force=True)
         self.journal.close()
         self._sink.close()

@@ -63,6 +63,12 @@ class Journal:
         self._f = None
         self.degraded = False
         self._pending: List[str] = []
+        # durable-commit latency observer: the metrics layer sets this
+        # to Histogram.observe so every fsync'd commit lands in
+        # serve_journal_fsync_seconds without the journal importing
+        # telemetry
+        self.on_commit_seconds = None
+        self.last_commit_seconds = None
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -94,7 +100,11 @@ class Journal:
         backlog = self._pending + [line]
         for attempt in (0, 1):
             try:
+                t0 = time.monotonic()
                 self._write("\n".join(backlog) + "\n")
+                self.last_commit_seconds = time.monotonic() - t0
+                if self.on_commit_seconds is not None:
+                    self.on_commit_seconds(self.last_commit_seconds)
                 self._pending = []
                 self.degraded = False
                 return True

@@ -133,10 +133,19 @@ class RequestServer:
                  checkpoint_every: int = 1,
                  growth: float = 1e3,
                  socket_path: Optional[str] = None,
-                 fsync: bool = True):
+                 fsync: bool = True,
+                 metrics_port: Optional[int] = None,
+                 metrics_every_s: float = 2.0,
+                 slo_objective: float = 0.99,
+                 slo_windows=None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         os.makedirs(os.path.join(self.root, "requests"), exist_ok=True)
+        from multigpu_advectiondiffusion_tpu.telemetry.metrics import (
+            DEFAULT_SLO_WINDOWS,
+            MetricsRegistry,
+            SloTracker,
+        )
         from multigpu_advectiondiffusion_tpu.telemetry.sink import (
             TelemetrySink,
         )
@@ -147,9 +156,27 @@ class RequestServer:
         self._sink = TelemetrySink(
             os.path.join(self.root, "serve_events.jsonl")
         )
+        # fleet metrics (ISSUE 18): one snapshot dir PER INCARNATION —
+        # a restarted server must not overwrite the dead life's
+        # counters, because the merged union across incarnations is
+        # what reconciles exactly-once against the replayed journal
+        self.metrics = MetricsRegistry(proc=f"server-{os.getpid()}")
+        self.metrics_dir = os.path.join(
+            self.root, "metrics", self.metrics.proc
+        )
+        self.metrics_every_s = float(metrics_every_s)
+        self._last_export = 0.0
+        self.slo = SloTracker(
+            name="request_deadline", objective=float(slo_objective),
+            windows=slo_windows or DEFAULT_SLO_WINDOWS,
+            emit=self._emit_slo,
+        )
         self.journal = Journal(
             os.path.join(self.root, "journal.jsonl"), fsync=fsync
         )
+        self.journal.on_commit_seconds = self.metrics.histogram(
+            "serve_journal_fsync_seconds"
+        ).observe
         self.queue, self.replay_report = RequestQueue.replay(self.journal)
         self.max_batch = max(1, int(max_batch))
         self.slice_steps = max(1, int(slice_steps))
@@ -168,6 +195,10 @@ class RequestServer:
         self.socket_path = socket_path
         if socket_path:
             self._open_socket(socket_path)
+        self._http = None
+        self.metrics_port: Optional[int] = None
+        if metrics_port is not None:
+            self._start_metrics_http(int(metrics_port))
 
     # ------------------------------------------------------------------ #
     # Plumbing
@@ -200,6 +231,8 @@ class RequestServer:
         rec = self.queue.transition(request_id, to, **info)
         self._sink.event("req", "state", job=request_id,
                          **{"from": frm, "to": to})
+        if to == "requeued":
+            self.metrics.counter("serve_requests_requeued_total").inc()
         return rec
 
     def _write_verdict(self, request_id: str, verdict: dict) -> None:
@@ -220,6 +253,97 @@ class RequestServer:
         if spec.precision == "bf16":
             item = 4  # f32 compute temporaries dominate the estimate
         return cells * item * _STATE_BYTES_FACTOR
+
+    # ------------------------------------------------------------------ #
+    # Fleet metrics + SLO surface (ISSUE 18)
+    # ------------------------------------------------------------------ #
+    def _emit_slo(self, name: str, payload: dict) -> None:
+        """An SLO verdict goes to BOTH surfaces: the event stream (for
+        live consumers) and the journal (a note record, so the alert
+        survives the process exactly like every request transition)."""
+        self._sink.event("slo", name, **payload)
+        self.journal.append("note", note=f"slo_{name}", **payload)
+        counter = ("serve_slo_alerts_total" if name == "alert"
+                   else "serve_slo_resolves_total")
+        self.metrics.counter(counter).inc()
+
+    def _observe_deadline(self, rec: RequestRecord,
+                          seconds: Optional[float], ok: bool) -> None:
+        """Feed one terminal verdict to the deadline SLO (requests
+        without a declared deadline carry no SLO contract)."""
+        deadline = rec.spec.deadline_s
+        if deadline is None:
+            return
+        met = ok and seconds is not None and (
+            float(seconds) <= float(deadline)
+        )
+        self.metrics.counter(
+            "serve_deadline_met_total" if met
+            else "serve_deadline_missed_total"
+        ).inc()
+        self.slo.observe(met)
+        self.slo.evaluate()
+
+    def export_metrics(self, force: bool = True) -> Optional[dict]:
+        """Publish this incarnation's snapshot (atomic JSON + Prom
+        text under ``metrics/<proc>/``). Throttled to
+        ``metrics_every_s`` unless forced."""
+        now = time.monotonic()
+        if not force and now - self._last_export < self.metrics_every_s:
+            return None
+        self._last_export = now
+        self.metrics.gauge("serve_queue_depth").set(
+            len(self.queue.open_requests())
+        )
+        snap = self.metrics.write_snapshot(self.metrics_dir)
+        self._sink.event(
+            "metrics", "snapshot", dir=self.metrics_dir,
+            counters=len(snap["counters"]),
+            gauges=len(snap["gauges"]),
+            histograms=len(snap["histograms"]),
+        )
+        return snap
+
+    def _start_metrics_http(self, port: int) -> None:
+        """The first brick of the HTTP transport debt: a read-only
+        stdlib endpoint on loopback serving ``/metrics`` (Prometheus
+        text) and ``/metrics.json`` from the live registry."""
+        import http.server
+        import threading
+
+        registry = self.metrics
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib contract
+                if self.path.split("?")[0] == "/metrics":
+                    body = registry.to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.split("?")[0] == "/metrics.json":
+                    body = json.dumps(
+                        registry.snapshot(), sort_keys=True
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet by design
+                pass
+
+        self._http = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), _Handler
+        )
+        self.metrics_port = int(self._http.server_address[1])
+        thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True
+        )
+        thread.start()
+        self._sink.event("metrics", "serve", port=self.metrics_port)
 
     # ------------------------------------------------------------------ #
     # Socket RPC (optional)
@@ -308,6 +432,7 @@ class RequestServer:
                                         on_skip=on_skip):
             self._sink.event("req", "submit", job=rec.request_id,
                              priority=rec.spec.priority)
+            self.metrics.counter("serve_requests_received_total").inc()
         received = sorted(
             (r for r in self.queue.requests.values()
              if r.state == "received"),
@@ -339,6 +464,7 @@ class RequestServer:
             bound=self.queue_bound,
             retry_after_s=self.retry_after_s,
         )
+        self.metrics.counter("serve_requests_shed_total").inc()
 
     def _admit(self, rec: RequestRecord) -> None:
         """Semantic admission: model resolves through the registry,
@@ -380,6 +506,7 @@ class RequestServer:
             "serve", "admit", job=rid, key=key,
             warm=self.ledger.lookup(key) is not None,
         )
+        self.metrics.counter("serve_requests_admitted_total").inc()
 
     # ------------------------------------------------------------------ #
     # Model templates + member states
@@ -555,6 +682,7 @@ class RequestServer:
             members=sum(1 for r in reqs if r is not None),
             lanes=len(reqs),
         )
+        self.metrics.counter("serve_batches_formed_total").inc()
         return _Batch(batch_id, key, ens, estate, reqs, te)
 
     @staticmethod
@@ -604,7 +732,12 @@ class RequestServer:
         })
         self._transition(rid, "failed", reason=reason,
                          failure={"reason": reason})
-        self._sink.event("req", "failed", job=rid, reason=reason[:200])
+        extra = ({"deadline_s": rec.spec.deadline_s}
+                 if rec.spec.deadline_s is not None else {})
+        self._sink.event("req", "failed", job=rid, reason=reason[:200],
+                         **extra)
+        self.metrics.counter("serve_requests_failed_total").inc()
+        self._observe_deadline(rec, seconds=None, ok=False)
 
     def _finish(self, rec: RequestRecord, b: _Batch, lane: int,
                 estate) -> None:
@@ -646,8 +779,16 @@ class RequestServer:
             "result": "result.json",
         })
         self._transition(rid, "done", t=t, it=it, slices=b.slices)
+        extra = ({"deadline_s": rec.spec.deadline_s}
+                 if rec.spec.deadline_s is not None else {})
         self._sink.event("req", "done", job=rid,
-                         seconds=seconds, slices=b.slices)
+                         seconds=seconds, slices=b.slices, **extra)
+        self.metrics.counter("serve_requests_done_total").inc()
+        if seconds is not None:
+            self.metrics.histogram(
+                "serve_request_latency_seconds"
+            ).observe(seconds)
+        self._observe_deadline(rec, seconds=seconds, ok=True)
         try:
             os.remove(self._ckpt_path(rid))
         except OSError:
@@ -773,11 +914,19 @@ class RequestServer:
             elif b.slices % self.checkpoint_every == 0:
                 self._save_member_ckpt(rec, estate.member(i))
         active = len(b.active())
+        slice_seconds = round(time.monotonic() - t0, 6)
+        occupancy = round(active / max(1, len(b.reqs)), 4)
         self._sink.event(
             "serve", "slice", batch=b.batch_id, slice=b.slices,
             active=active, done=done,
-            occupancy=round(active / max(1, len(b.reqs)), 4),
-            seconds=round(time.monotonic() - t0, 6),
+            occupancy=occupancy, seconds=slice_seconds,
+        )
+        self.metrics.counter("serve_slices_total").inc()
+        self.metrics.histogram("serve_slice_seconds").observe(
+            slice_seconds
+        )
+        self.metrics.histogram("serve_batch_occupancy").observe(
+            occupancy
         )
         if self.ledger.lookup(b.key) is None:
             # first completed slice for this key: the executable exists
@@ -809,9 +958,13 @@ class RequestServer:
         self.recover()
         self._ingest()
         progressed = self._tick_batch()
+        open_count = len(self.queue.open_requests())
+        self.metrics.gauge("serve_queue_depth").set(open_count)
+        self.slo.evaluate()  # time alone can clear (or breach) windows
+        self.export_metrics(force=False)
         return {
             "progressed": progressed,
-            "open": len(self.queue.open_requests()),
+            "open": open_count,
         }
 
     def state_counts(self) -> Dict[str, int]:
@@ -865,9 +1018,18 @@ class RequestServer:
         outcome = {"reason": reason, "states": self.state_counts()}
         self._sink.event("serve", "stop", reason=reason,
                          states=outcome["states"])
+        self.export_metrics(force=True)
         return outcome
 
     def close(self) -> None:
+        self.export_metrics(force=True)
+        if self._http is not None:
+            try:
+                self._http.shutdown()
+                self._http.server_close()
+            except OSError:
+                pass
+            self._http = None
         if self._sock is not None:
             try:
                 self._sock.close()

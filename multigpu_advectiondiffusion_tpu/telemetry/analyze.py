@@ -127,14 +127,33 @@ def load_stream(path: str, include_rotated: bool = True) -> Stream:
                   skipped_lines=skipped)
 
 
+def discover_streams(root: str) -> List[str]:
+    """Every JSONL stream a service root owns: the top-level daemon /
+    server sinks (``sched_events.jsonl``, ``serve_events.jsonl``,
+    rank streams) AND the per-job namespaced streams under
+    ``<root>/jobs/<id>/`` the scheduler gives each worker. Rotated
+    ``.1`` segments are NOT listed — they ride along with their owner
+    via :func:`load_stream`'s prepend, never as separate streams.
+    ``journal.jsonl`` files are CRC-sealed write-ahead journals, not
+    event streams: excluded, they have their own replay readers."""
+    found = sorted(glob.glob(os.path.join(root, "*.jsonl")))
+    found.extend(sorted(
+        glob.glob(os.path.join(root, "jobs", "*", "*.jsonl"))
+    ))
+    return [f for f in found
+            if os.path.basename(f) != "journal.jsonl"]
+
+
 def load_streams(paths: Sequence[str]) -> List[Stream]:
     """Expand files/directories into Streams, one per JSONL file.
-    Directories contribute every ``*.jsonl`` inside (rotated ``.1``
-    files ride along with their owner, never as separate streams)."""
+    A directory is treated as a service root: it contributes its
+    top-level ``*.jsonl`` streams AND the scheduler's per-job streams
+    under ``jobs/<id>/`` (:func:`discover_streams`); rotated ``.1``
+    files ride along with their owner, never as separate streams."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+            files.extend(discover_streams(p))
         else:
             files.append(p)
     if not files:

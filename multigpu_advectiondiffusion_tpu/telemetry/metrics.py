@@ -1,0 +1,776 @@
+"""Fleet metrics: process-local registry, exact cross-process merge,
+snapshots, replay, and SLO burn-rate alerting (ISSUE 18).
+
+The observability ladder so far instruments individual *runs* (events,
+traces, XLA introspection, physics diagnostics); the scheduler (PR 14)
+and the request server (PR 17) made this a long-lived *service* with
+no aggregated surface an operator can watch. This module is that
+surface:
+
+* **Instruments** — monotonic :class:`Counter`, :class:`Gauge` (last
+  value + running max), and :class:`Histogram` over FIXED
+  log-boundary buckets. Fixed boundaries are the load-bearing design
+  decision: every process buckets into the same edges
+  (:data:`LOG_BUCKET_BOUNDS`), so merging two histograms is an
+  elementwise integer add — EXACT, associative, order-independent —
+  where merging two t-digest/sorted-sample summaries is neither.
+  The price is quantile resolution: a quantile estimate is log-linear
+  interpolation inside its bucket, so the worst-case relative error
+  is one bucket's width, ``BUCKETS_PER_DECADE``-th root of 10 - 1
+  (≈ 29% at the default 9 buckets/decade). Counts, sums, min/max and
+  bucket totals stay exact.
+* **Registry** (:class:`MetricsRegistry`) — the per-process instrument
+  namespace. Fed two ways: first-class calls on the serving/scheduler
+  hot paths (``service/server.py``, ``service/daemon.py``), and
+  :func:`registry_from_events` — the replay adapter deriving the SAME
+  instruments from any ``--metrics`` JSONL stream, so a historical
+  run (or a crashed server's stream) is queryable with one codepath.
+  Instrumented counters and replay-derived counters agree exactly-once
+  by construction: both count the same emission sites.
+* **Snapshots** — :meth:`MetricsRegistry.write_snapshot` publishes the
+  registry atomically (``utils/io.atomic_write_text``) as both
+  ``metrics.json`` (this module's schema) and ``metrics.prom``
+  (Prometheus text exposition, scrapable by anything). A SIGKILL
+  between writes leaves the previous snapshot intact — atomic rename
+  is the whole point. :func:`merge_snapshot_dirs` unions the per-
+  process snapshot directories a fleet leaves behind (one per rank /
+  daemon / server incarnation): counters and histograms add exactly,
+  gauges take the newest value and the running max.
+* **SLO engine** (:class:`SloTracker`) — per-request deadline
+  verdicts (``RequestSpec.deadline_s``) feed multi-window burn-rate
+  evaluation (the SRE-workbook shape: a fast window catches a cliff,
+  a slow window catches a smolder). Crossing a window's threshold
+  yields an ``slo:alert``; clearing every window yields
+  ``slo:resolve``. The request server emits these as registered
+  events AND journals them, so an alert survives the process.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+METRICS_SCHEMA = 1
+
+# --------------------------------------------------------------------- #
+# Fixed log-boundary buckets
+# --------------------------------------------------------------------- #
+#: buckets per decade; the worst-case relative quantile error is
+#: 10**(1/BUCKETS_PER_DECADE) - 1 (≈ 0.292 at 9)
+BUCKETS_PER_DECADE = 9
+
+#: decade span: 1e-6 .. 1e4 (microseconds to hours, in seconds — also
+#: serves dimensionless ratios like occupancy and queue depths)
+_LOG10_LO, _LOG10_HI = -6, 4
+
+#: the one canonical boundary vector. Computed from the same integer
+#: exponents on every process (same expression, same platform floats),
+#: so two processes NEVER disagree about an edge and bucket merges are
+#: exact elementwise adds.
+LOG_BUCKET_BOUNDS = tuple(
+    10.0 ** (k / BUCKETS_PER_DECADE)
+    for k in range(_LOG10_LO * BUCKETS_PER_DECADE,
+                   _LOG10_HI * BUCKETS_PER_DECADE + 1)
+)
+
+#: identifies the boundary vector inside snapshots, so a merge refuses
+#: histograms bucketed against a different (incompatible) edge set
+#: instead of silently adding misaligned counts
+BOUNDS_KEY = (
+    f"log{BUCKETS_PER_DECADE}[1e{_LOG10_LO},1e{_LOG10_HI}]"
+)
+
+#: documented worst-case relative quantile error of the fixed buckets
+QUANTILE_REL_ERROR = 10.0 ** (1.0 / BUCKETS_PER_DECADE) - 1.0
+
+
+class Counter:
+    """Monotonic event count. Merge = add (exact)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        self.value += int(n)
+
+
+class Gauge:
+    """Last-observed value plus its running max (the watermark shape:
+    queue depth *now* and the deepest it ever got)."""
+
+    __slots__ = ("name", "value", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        v = float(v)
+        self.value = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+
+class Histogram:
+    """Fixed log-boundary-bucket histogram.
+
+    ``counts[i]`` holds observations with
+    ``bounds[i-1] < x <= bounds[i]``; ``counts[0]`` is the underflow
+    bucket (``x <= bounds[0]``), ``counts[-1]`` the overflow. Because
+    the boundaries are a module constant, :meth:`merge` is an exact
+    elementwise add — the property the cross-process snapshot union
+    rests on."""
+
+    __slots__ = ("name", "bounds", "counts", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, name: str,
+                 bounds: Sequence[float] = LOG_BUCKET_BOUNDS):
+        self.name = name
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    # ------------------------------------------------------------------ #
+    def _bucket(self, x: float) -> int:
+        """Binary search for the first bound >= x."""
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if x <= self.bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        if x != x:  # NaN: refuse silently-poisoned quantiles
+            return
+        self.counts[self._bucket(x)] += 1
+        self.count += 1
+        self.sum += x
+        if self.min is None or x < self.min:
+            self.min = x
+        if self.max is None or x > self.max:
+            self.max = x
+
+    def merge(self, other: "Histogram") -> None:
+        if other.bounds != self.bounds:
+            raise ValueError(
+                f"histogram {self.name}: incompatible bucket bounds"
+            )
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+        if other.min is not None and (self.min is None
+                                      or other.min < self.min):
+            self.min = other.min
+        if other.max is not None and (self.max is None
+                                      or other.max > self.max):
+            self.max = other.max
+
+    # ------------------------------------------------------------------ #
+    def quantile(self, q: float) -> Optional[float]:
+        """Quantile estimate by log-linear interpolation inside the
+        containing bucket, clamped to the observed ``[min, max]``.
+        Worst-case relative error: one bucket's width
+        (:data:`QUANTILE_REL_ERROR`); counts/rank selection are exact.
+        """
+        if self.count == 0:
+            return None
+        q = min(1.0, max(0.0, float(q)))
+        rank = q * (self.count - 1) + 1  # 1-based target rank
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                frac = (rank - seen) / c
+                if i == 0:
+                    lo, hi = (self.min if self.min is not None
+                              else 0.0), self.bounds[0]
+                elif i == len(self.bounds):
+                    lo = self.bounds[-1]
+                    hi = self.max if self.max is not None else lo
+                else:
+                    lo, hi = self.bounds[i - 1], self.bounds[i]
+                lo = max(lo, 1e-300)
+                hi = max(hi, lo)
+                est = lo * (hi / lo) ** frac if hi > lo else lo
+                if self.min is not None:
+                    est = max(est, self.min)
+                if self.max is not None:
+                    est = min(est, self.max)
+                return est
+            seen += c
+        return self.max
+
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+
+# --------------------------------------------------------------------- #
+# Registry
+# --------------------------------------------------------------------- #
+class MetricsRegistry:
+    """One process's instrument namespace. All accessors are
+    get-or-create, so instrumentation sites never pre-declare."""
+
+    def __init__(self, proc: str = ""):
+        self.proc = proc or f"pid{os.getpid()}"
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    # ------------------------------------------------------------------ #
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge(name)
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(name)
+        return h
+
+    # ------------------------------------------------------------------ #
+    def snapshot(self) -> dict:
+        """The registry as one JSON-serializable dict (the snapshot
+        file's schema; also what :func:`merge_snapshots` consumes)."""
+        return {
+            "schema": METRICS_SCHEMA,
+            "proc": self.proc,
+            "wall_time": round(time.time(), 6),
+            "counters": {n: c.value
+                         for n, c in sorted(self.counters.items())},
+            "gauges": {
+                n: {"value": g.value, "max": g.max}
+                for n, g in sorted(self.gauges.items())
+            },
+            "histograms": {
+                n: {
+                    "bounds_key": BOUNDS_KEY,
+                    "counts": list(h.counts),
+                    "count": h.count,
+                    "sum": h.sum,
+                    "min": h.min,
+                    "max": h.max,
+                }
+                for n, h in sorted(self.histograms.items())
+                if h.bounds == LOG_BUCKET_BOUNDS
+            },
+        }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (0.0.4) of the live registry."""
+        return snapshot_to_prometheus(self.snapshot())
+
+    def write_snapshot(self, directory: str) -> dict:
+        """Atomically publish ``metrics.json`` + ``metrics.prom`` under
+        ``directory`` (one directory per process incarnation — the
+        merge unions them). Returns the snapshot dict. A crash between
+        the two writes leaves BOTH previous files intact (atomic
+        rename), so the last published snapshot is always parseable.
+        """
+        from multigpu_advectiondiffusion_tpu.utils.io import (
+            atomic_write_text,
+        )
+
+        os.makedirs(directory, exist_ok=True)
+        snap = self.snapshot()
+        atomic_write_text(
+            os.path.join(directory, "metrics.json"),
+            json.dumps(snap, sort_keys=True),
+        )
+        atomic_write_text(
+            os.path.join(directory, "metrics.prom"),
+            snapshot_to_prometheus(snap),
+        )
+        return snap
+
+
+# --------------------------------------------------------------------- #
+# Snapshot serialization / merge
+# --------------------------------------------------------------------- #
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    return "tpucfd_" + s if not s.startswith("tpucfd_") else s
+
+
+def _prom_num(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def snapshot_to_prometheus(snap: dict) -> str:
+    """One snapshot dict -> Prometheus text exposition format."""
+    lines: List[str] = []
+    for name, value in sorted((snap.get("counters") or {}).items()):
+        pn = _prom_name(name)
+        if not pn.endswith("_total"):
+            pn += "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {int(value)}")
+    for name, g in sorted((snap.get("gauges") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        if g.get("value") is not None:
+            lines.append(f"{pn} {_prom_num(g['value'])}")
+        if g.get("max") is not None:
+            lines.append(f"# TYPE {pn}_max gauge")
+            lines.append(f"{pn}_max {_prom_num(g['max'])}")
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        counts = h.get("counts") or []
+        for i, bound in enumerate(LOG_BUCKET_BOUNDS):
+            cum += counts[i] if i < len(counts) else 0
+            lines.append(
+                f'{pn}_bucket{{le="{repr(bound)}"}} {cum}'
+            )
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {int(h.get("count", 0))}')
+        lines.append(f"{pn}_sum {_prom_num(float(h.get('sum', 0.0)))}")
+        lines.append(f"{pn}_count {int(h.get('count', 0))}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Minimal parser of the exposition format this module writes:
+    ``{sample_name or name{le=...}: value}``. The metrics gate uses it
+    to prove a published ``metrics.prom`` actually parses."""
+    samples: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, value = parts
+        samples[name] = float(value)
+    return samples
+
+
+def snapshot_histogram(snap: dict, name: str) -> Optional[Histogram]:
+    """Rehydrate one named histogram out of a snapshot dict (merged or
+    single-process) so consumers query quantiles through the one
+    shared codepath instead of re-deriving them."""
+    h = (snap.get("histograms") or {}).get(name)
+    if h is None:
+        return None
+    if h.get("bounds_key") != BOUNDS_KEY:
+        raise ValueError(
+            f"histogram {name}: snapshot bucketed against "
+            f"{h.get('bounds_key')!r}, this build reads {BOUNDS_KEY!r}"
+        )
+    hist = Histogram(name)
+    counts = [int(c) for c in (h.get("counts") or [])]
+    if len(counts) != len(hist.counts):
+        raise ValueError(f"histogram {name}: bucket count mismatch")
+    hist.counts = counts
+    hist.count = int(h.get("count", 0))
+    hist.sum = float(h.get("sum", 0.0))
+    hist.min = h.get("min")
+    hist.max = h.get("max")
+    return hist
+
+
+def load_snapshot(path: str) -> dict:
+    """Read one ``metrics.json`` snapshot (raises on a corrupt file —
+    the gate's corruption selftest depends on that being loud)."""
+    with open(path) as f:
+        snap = json.load(f)
+    if not isinstance(snap, dict) or "counters" not in snap:
+        raise ValueError(f"not a metrics snapshot: {path}")
+    return snap
+
+
+def merge_snapshots(snaps: Iterable[dict]) -> dict:
+    """Union per-process snapshots into one fleet view.
+
+    Counters and histogram buckets ADD (exact — each process counted
+    disjoint local events against identical boundaries); gauges take
+    the value from the newest snapshot (by ``wall_time``) and the max
+    across all of them."""
+    merged = MetricsRegistry(proc="merged")
+    gauge_wall: Dict[str, float] = {}
+    newest = 0.0
+    procs = []
+    for snap in snaps:
+        wall = float(snap.get("wall_time") or 0.0)
+        newest = max(newest, wall)
+        procs.append(snap.get("proc") or "?")
+        for name, value in (snap.get("counters") or {}).items():
+            merged.counter(name).inc(int(value))
+        for name, g in (snap.get("gauges") or {}).items():
+            gauge = merged.gauge(name)
+            if g.get("max") is not None:
+                if gauge.max is None or g["max"] > gauge.max:
+                    gauge.max = float(g["max"])
+            if g.get("value") is not None and wall >= gauge_wall.get(
+                name, -1.0
+            ):
+                gauge.value = float(g["value"])
+                gauge_wall[name] = wall
+        for name, h in (snap.get("histograms") or {}).items():
+            if h.get("bounds_key") != BOUNDS_KEY:
+                raise ValueError(
+                    f"histogram {name}: snapshot bucketed against "
+                    f"{h.get('bounds_key')!r}, this build merges "
+                    f"{BOUNDS_KEY!r}"
+                )
+            hist = merged.histogram(name)
+            other = Histogram(name)
+            other.counts = [int(c) for c in (h.get("counts") or [])]
+            if len(other.counts) != len(hist.counts):
+                raise ValueError(
+                    f"histogram {name}: bucket count mismatch"
+                )
+            other.count = int(h.get("count", 0))
+            other.sum = float(h.get("sum", 0.0))
+            other.min = h.get("min")
+            other.max = h.get("max")
+            hist.merge(other)
+    out = merged.snapshot()
+    out["wall_time"] = newest
+    out["merged_procs"] = sorted(procs)
+    return out
+
+
+def merge_snapshot_dirs(root: str) -> dict:
+    """Merge every ``<root>/*/metrics.json`` snapshot (one directory
+    per rank/daemon/server incarnation). Corrupt snapshots are skipped
+    and reported in the result's ``skipped`` list — a half-written
+    file from a dying process must not take down the fleet view."""
+    snaps, skipped = [], []
+    if os.path.isdir(root):
+        for name in sorted(os.listdir(root)):
+            path = os.path.join(root, name, "metrics.json")
+            if not os.path.isfile(path):
+                continue
+            try:
+                snaps.append(load_snapshot(path))
+            except (OSError, ValueError) as err:
+                skipped.append(
+                    f"{path}: {type(err).__name__}: {err}"[:200]
+                )
+    merged = merge_snapshots(snaps)
+    merged["snapshots"] = len(snaps)
+    merged["skipped"] = skipped
+    return merged
+
+
+# --------------------------------------------------------------------- #
+# Replay adapter: --metrics JSONL stream -> the same registry
+# --------------------------------------------------------------------- #
+def registry_from_events(events: Iterable[dict],
+                         proc: str = "replay") -> MetricsRegistry:
+    """Derive the serving/scheduler instruments from an event stream.
+
+    The adapter reads the SAME emission sites the live instruments
+    hang off (``req:*`` / ``serve:*`` / ``sched:*`` / ``job:*`` /
+    ``summary`` / ``mem:watermark``), so a replay-derived counter and
+    an instrumented one agree exactly-once on any stream: both count
+    one increment per emitted event. Historical ``--metrics`` files
+    become queryable with the fleet's one quantile codepath."""
+    reg = MetricsRegistry(proc=proc)
+    for ev in events:
+        kind, name = ev.get("kind"), ev.get("name")
+        if kind == "req":
+            if name == "submit":
+                reg.counter("serve_requests_received_total").inc()
+            elif name == "done":
+                reg.counter("serve_requests_done_total").inc()
+                if ev.get("seconds") is not None:
+                    reg.histogram(
+                        "serve_request_latency_seconds"
+                    ).observe(float(ev["seconds"]))
+                if ev.get("deadline_s") is not None and (
+                    ev.get("seconds") is not None
+                ):
+                    met = float(ev["seconds"]) <= float(
+                        ev["deadline_s"]
+                    )
+                    reg.counter(
+                        "serve_deadline_met_total" if met
+                        else "serve_deadline_missed_total"
+                    ).inc()
+            elif name == "failed":
+                reg.counter("serve_requests_failed_total").inc()
+            elif name == "state" and ev.get("to") == "requeued":
+                reg.counter("serve_requests_requeued_total").inc()
+        elif kind == "serve":
+            if name == "admit":
+                reg.counter("serve_requests_admitted_total").inc()
+            elif name == "shed":
+                reg.counter("serve_requests_shed_total").inc()
+            elif name == "batch":
+                reg.counter("serve_batches_formed_total").inc()
+            elif name == "slice":
+                reg.counter("serve_slices_total").inc()
+                if ev.get("seconds") is not None:
+                    reg.histogram("serve_slice_seconds").observe(
+                        float(ev["seconds"])
+                    )
+                if ev.get("occupancy") is not None:
+                    reg.histogram("serve_batch_occupancy").observe(
+                        float(ev["occupancy"])
+                    )
+        elif kind == "sched":
+            if name == "admit":
+                reg.counter("sched_jobs_admitted_total").inc()
+            elif name == "retry":
+                reg.counter("sched_retries_total").inc()
+            elif name == "preempt":
+                reg.counter("sched_preemptions_total").inc()
+        elif kind == "job":
+            if name == "submit":
+                reg.counter("sched_jobs_submitted_total").inc()
+            elif name == "exit":
+                reg.counter("sched_job_exits_total").inc()
+                if ev.get("seconds") is not None:
+                    reg.histogram("sched_job_seconds").observe(
+                        float(ev["seconds"])
+                    )
+        elif kind == "summary":
+            # per-rung MLUPS gauge family from the run summaries that
+            # already ride every --metrics stream
+            if ev.get("mlups") is not None:
+                reg.gauge("run_mlups").set(float(ev["mlups"]))
+            if ev.get("seconds") is not None:
+                reg.histogram("run_seconds").observe(
+                    float(ev["seconds"])
+                )
+        elif kind == "mem" and name == "watermark":
+            if ev.get("bytes_in_use") is not None:
+                reg.gauge("mem_bytes_in_use").set(
+                    float(ev["bytes_in_use"])
+                )
+            if ev.get("peak_bytes") is not None:
+                reg.gauge("mem_peak_bytes").set(
+                    float(ev["peak_bytes"])
+                )
+        elif kind == "io" and name in (
+            "checkpoint_write", "snapshot_write", "binary_write"
+        ):
+            if ev.get("seconds") is not None:
+                reg.histogram("io_write_seconds").observe(
+                    float(ev["seconds"])
+                )
+    return reg
+
+
+def registry_from_streams(paths: Sequence[str],
+                          proc: str = "replay") -> MetricsRegistry:
+    """Replay adapter over files/dirs/service roots — the stream
+    discovery is :func:`telemetry.analyze.load_streams`' (daemon +
+    per-job + server streams, rotated segments riding along)."""
+    from multigpu_advectiondiffusion_tpu.telemetry.analyze import (
+        load_streams,
+    )
+
+    reg = MetricsRegistry(proc=proc)
+    for stream in load_streams(paths):
+        other = registry_from_events(stream.events, proc=proc)
+        for name, c in other.counters.items():
+            reg.counter(name).inc(c.value)
+        for name, g in other.gauges.items():
+            if g.value is not None:
+                reg.gauge(name).set(g.value)
+            if g.max is not None:
+                gg = reg.gauge(name)
+                if gg.max is None or g.max > gg.max:
+                    gg.max = g.max
+        for name, h in other.histograms.items():
+            reg.histogram(name).merge(h)
+    return reg
+
+
+# --------------------------------------------------------------------- #
+# SLO engine: multi-window burn-rate alerting
+# --------------------------------------------------------------------- #
+#: default multi-window burn-rate policy (the SRE-workbook pairing,
+#: scaled to serving cadence): (window seconds, burn-rate threshold,
+#: minimum observations before the window may fire). A short window
+#: catches a cliff within seconds; the long window catches a smolder
+#: a cliff-sized window would alias away.
+DEFAULT_SLO_WINDOWS = (
+    (60.0, 14.4, 4),
+    (600.0, 6.0, 8),
+)
+
+
+class SloTracker:
+    """Deadline-SLO burn-rate evaluation over a sliding observation
+    log.
+
+    ``objective`` is the target good fraction (0.99 = 1% error
+    budget). Each window's *burn rate* is
+    ``(bad/total in window) / (1 - objective)`` — the rate the error
+    budget is being spent at, 1.0 = exactly on budget. A window whose
+    burn rate crosses its threshold (with at least ``min_count``
+    observations, so one early miss cannot page) raises the alert; the
+    alert resolves only when EVERY window is back under threshold.
+    Alerts/resolves surface through the ``emit`` callback as
+    ``slo:alert`` / ``slo:resolve`` payloads."""
+
+    def __init__(self, name: str = "request_deadline",
+                 objective: float = 0.99,
+                 windows=DEFAULT_SLO_WINDOWS,
+                 emit: Optional[Callable[[str, dict], None]] = None):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0,1): {objective}")
+        self.name = name
+        self.objective = float(objective)
+        self.windows = tuple(
+            (float(w), float(thr), int(mc)) for w, thr, mc in windows
+        )
+        self.emit = emit
+        self._obs: List[tuple] = []  # (wall, ok) — pruned to max window
+        self.firing = False
+        self.alerts: List[dict] = []
+
+    # ------------------------------------------------------------------ #
+    def observe(self, ok: bool, wall: Optional[float] = None) -> None:
+        wall = time.time() if wall is None else float(wall)
+        self._obs.append((wall, bool(ok)))
+        horizon = wall - max(w for w, _, _ in self.windows)
+        while self._obs and self._obs[0][0] < horizon:
+            self._obs.pop(0)
+
+    def burn_rates(self, now: Optional[float] = None) -> List[dict]:
+        """Per-window burn rates at ``now`` (diagnostics + the
+        evaluation's input)."""
+        now = time.time() if now is None else float(now)
+        budget = 1.0 - self.objective
+        out = []
+        for window, threshold, min_count in self.windows:
+            lo = now - window
+            total = bad = 0
+            for wall, ok in self._obs:
+                if wall >= lo:
+                    total += 1
+                    if not ok:
+                        bad += 1
+            rate = ((bad / total) / budget) if total else 0.0
+            out.append({
+                "window_s": window,
+                "threshold": threshold,
+                "min_count": min_count,
+                "total": total,
+                "bad": bad,
+                "burn_rate": round(rate, 4),
+                "firing": total >= min_count and rate > threshold,
+            })
+        return out
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Run the multi-window evaluation; returns (and records) the
+        alert/resolve payloads this call produced. Hysteresis: one
+        alert per excursion, one resolve when every window clears."""
+        rates = self.burn_rates(now)
+        fired = [r for r in rates if r["firing"]]
+        produced: List[dict] = []
+        if fired and not self.firing:
+            self.firing = True
+            worst = max(fired, key=lambda r: r["burn_rate"])
+            payload = {
+                "slo": self.name,
+                "objective": self.objective,
+                "window_s": worst["window_s"],
+                "burn_rate": worst["burn_rate"],
+                "threshold": worst["threshold"],
+                "bad": worst["bad"],
+                "total": worst["total"],
+            }
+            self.alerts.append(payload)
+            produced.append({"name": "alert", **payload})
+            if self.emit is not None:
+                self.emit("alert", payload)
+        elif self.firing and not fired:
+            self.firing = False
+            payload = {
+                "slo": self.name,
+                "objective": self.objective,
+                "burn_rate": max(
+                    (r["burn_rate"] for r in rates), default=0.0
+                ),
+            }
+            produced.append({"name": "resolve", **payload})
+            if self.emit is not None:
+                self.emit("resolve", payload)
+        return produced
+
+
+def evaluate_slo_stream(events: Iterable[dict],
+                        name: str = "request_deadline",
+                        objective: float = 0.99,
+                        windows=DEFAULT_SLO_WINDOWS) -> dict:
+    """Offline SLO evaluation of a serving event stream: feed every
+    deadline-carrying ``req:done`` / ``req:failed`` verdict through
+    the SAME tracker the live server runs, evaluating after each
+    observation (so an alert fires exactly where it would have live).
+    Returns the tracker's verdict: alerts raised, final burn rates."""
+    tracker = SloTracker(name=name, objective=objective,
+                         windows=windows)
+    last_wall = None
+    for ev in events:
+        kind, evname = ev.get("kind"), ev.get("name")
+        if kind != "req" or evname not in ("done", "failed"):
+            continue
+        if ev.get("deadline_s") is None:
+            continue
+        wall = ev.get("wall")
+        if wall is None:
+            # sink events carry monotonic t, not wall; use t as the
+            # clock — windows only need relative spacing
+            wall = float(ev.get("t", 0.0))
+        last_wall = float(wall)
+        if evname == "failed":
+            ok = False
+        else:
+            seconds = ev.get("seconds")
+            ok = seconds is not None and (
+                float(seconds) <= float(ev["deadline_s"])
+            )
+        tracker.observe(ok, wall=last_wall)
+        tracker.evaluate(now=last_wall)
+    return {
+        "slo": name,
+        "objective": objective,
+        "alerts": tracker.alerts,
+        "firing": tracker.firing,
+        "burn_rates": (
+            tracker.burn_rates(now=last_wall) if last_wall is not None
+            else tracker.burn_rates()
+        ),
+    }

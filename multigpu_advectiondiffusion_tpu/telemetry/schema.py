@@ -187,7 +187,11 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     },
     # per-request lifecycle in the server's stream: every journal
     # transition is mirrored as a req:state event so tpucfd-trace can
-    # render the request timeline without reading the journal
+    # render the request timeline without reading the journal.
+    # req:done/req:failed additionally carry deadline_s (optional —
+    # only when the request declared one) so the metrics replay
+    # adapter and offline SLO evaluation see the same verdicts the
+    # live SloTracker saw
     "req": {
         "submit": {"job", "priority"},
         "state": {"job", "from", "to"},
@@ -204,6 +208,26 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "start": {"job", "attempt"},
         "exit": {"job", "rc", "seconds"},
     },
+    # fleet metrics (telemetry/metrics.py, ISSUE 18): periodic atomic
+    # registry snapshots (JSON + Prometheus text under a per-process
+    # snapshot dir) and the server's read-only /metrics HTTP endpoint
+    "metrics": {
+        "snapshot": {"dir", "counters", "gauges", "histograms"},
+        "serve": {"port"},
+    },
+    # SLO burn-rate engine (telemetry/metrics.SloTracker): multi-window
+    # deadline-SLO evaluation over req:done/req:failed verdicts — an
+    # alert on crossing a window's burn-rate threshold, a resolve when
+    # every window clears; the request server also journals both as
+    # note records so they survive the process
+    "slo": {
+        "alert": {"slo", "objective", "window_s", "burn_rate",
+                  "threshold", "bad", "total"},
+        "resolve": {"slo", "objective", "burn_rate"},
+    },
+    # tpucfd-status dashboard (cli/status.py): one event per rendered
+    # frame when the status verb itself runs with --metrics
+    "status": {"render": {"root", "requests", "jobs"}},
     "crash": {None: {"message"}},
 }
 
@@ -240,6 +264,27 @@ COUNTER_NAMES: Set[str] = {
     # in-kernel remote-DMA bytes (halo.record_remote_dma): the dma
     # rung's ICI payload per compiled execution, blocks folded in
     "halo.dma_bytes_per_execution",
+    # fleet-metrics monotonic counters (telemetry/metrics.py, ISSUE
+    # 18): the MetricsRegistry vocabulary the serving/scheduler hot
+    # paths increment and the replay adapter re-derives — registered
+    # here so the same drift guard covers both emission surfaces
+    "serve_requests_received_total",
+    "serve_requests_admitted_total",
+    "serve_requests_done_total",
+    "serve_requests_failed_total",
+    "serve_requests_shed_total",
+    "serve_requests_requeued_total",
+    "serve_batches_formed_total",
+    "serve_slices_total",
+    "serve_deadline_met_total",
+    "serve_deadline_missed_total",
+    "serve_slo_alerts_total",
+    "serve_slo_resolves_total",
+    "sched_jobs_submitted_total",
+    "sched_jobs_admitted_total",
+    "sched_job_exits_total",
+    "sched_retries_total",
+    "sched_preemptions_total",
 }
 
 def scan_emitted(

@@ -1,0 +1,238 @@
+#!/usr/bin/env bash
+# Fleet-metrics gate (ISSUE 18): the observability layer's end-to-end
+# chaos proof, runnable in CI.
+#
+# 1. Kill/replay gate: submit 4 requests (one with an SLO deadline),
+#    start the server with frequent metric exports, SIGKILL it after
+#    it has BOTH marched a slice and published a snapshot, then
+#    restart it --until-idle and assert:
+#      (a) the pre-kill snapshot is still parseable (atomic publish —
+#          a SIGKILL between writes can never tear it),
+#      (b) the merged union across BOTH incarnation snapshot dirs
+#          reports every request exactly once: the request-lifecycle
+#          counters (received/done/failed/shed/requeued) reconcile
+#          bit-for-bit against the counters the replay adapter
+#          derives from the journal + event stream, and the latency
+#          histogram is bucket-identical between the two feeds,
+#      (c) every metrics.prom parses as Prometheus text and the
+#          done_total samples sum to the journal's done count,
+#      (d) `tpucfd-status --once --json` renders a populated frame.
+#    Slice/occupancy counters are deliberately NOT reconciled across
+#    a SIGKILL: increments between the dead life's last export and
+#    the kill are correctly absent from its final snapshot.
+# 2. `--selftest`: proves the gate's assertions have teeth — after a
+#    healthy round passes the check, a corrupted metrics.json, a
+#    stale snapshot (wall_time rewound past the freshness bound) and
+#    a missing snapshot dir must each trip it nonzero.
+#
+#   ./out/metrics_gate.sh             # the kill/replay gate
+#   ./out/metrics_gate.sh --selftest  # corrupt/stale/missing proofs
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+CLI=(python -m multigpu_advectiondiffusion_tpu.cli)
+REQ=(request --model diffusion --n 12 12 --ic gaussian)
+
+# The gate's core assertion, shared with --selftest: the merged
+# snapshot union must be fresh, complete and bit-for-bit consistent
+# with what the replay adapter derives from the journalled streams.
+check_root() {
+    python - "$1" <<'PY'
+import json, os, sys, time
+
+from multigpu_advectiondiffusion_tpu.telemetry import metrics as M
+
+root = sys.argv[1]
+merged = M.merge_snapshot_dirs(os.path.join(root, "metrics"))
+assert not merged["skipped"], \
+    f"corrupted snapshot(s) skipped: {merged['skipped']}"
+assert merged["snapshots"] >= 1, "no metrics snapshots published"
+age = time.time() - merged["wall_time"]
+assert age < 600.0, f"stale snapshot: newest is {age:.0f}s old"
+
+records = [json.loads(l) for l in open(os.path.join(
+    root, "journal.jsonl")) if l.strip()]
+recs = [r.get("record", r) for r in records]
+journal_done = {r["job"] for r in recs if r.get("type") == "state"
+                and r.get("to") == "done"}
+
+replay = M.registry_from_streams([root])
+derived = {k: c.value for k, c in replay.counters.items()}
+lifecycle = ("serve_requests_received_total",
+             "serve_requests_done_total",
+             "serve_requests_failed_total",
+             "serve_requests_shed_total",
+             "serve_requests_requeued_total")
+for key in lifecycle:
+    live = merged["counters"].get(key, 0)
+    rep = derived.get(key, 0)
+    assert live == rep, f"{key}: merged snapshot {live} != replayed {rep}"
+assert merged["counters"].get("serve_requests_done_total", 0) \
+    == len(journal_done), \
+    f"done counter {merged['counters'].get('serve_requests_done_total')}" \
+    f" != journal's {len(journal_done)} done requests"
+
+lat = M.snapshot_histogram(merged, "serve_request_latency_seconds")
+rep_lat = replay.histograms.get("serve_request_latency_seconds")
+assert lat is not None and rep_lat is not None, "no latency histogram"
+assert lat.counts == rep_lat.counts, \
+    "latency histogram buckets diverge between snapshot and replay"
+
+prom_done = 0.0
+for proc in sorted(os.listdir(os.path.join(root, "metrics"))):
+    text = open(os.path.join(root, "metrics", proc,
+                             "metrics.prom")).read()
+    samples = M.parse_prometheus(text)
+    prom_done += samples.get("tpucfd_serve_requests_done_total", 0.0)
+assert prom_done == len(journal_done), \
+    f"prometheus done samples sum to {prom_done}, " \
+    f"journal says {len(journal_done)}"
+print(f"metrics_gate: check OK — {merged['snapshots']} snapshots, "
+      f"{len(journal_done)} requests counted exactly once")
+PY
+}
+
+if [[ "${1:-}" == "--selftest" ]]; then
+    echo "metrics_gate: selftest — a healthy round must pass first"
+    ROOT="$TMP/self"
+    "${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id s1 --t-end 0.15
+    "${CLI[@]}" serve-requests --root "$ROOT" --until-idle \
+        --max-batch 2 --slice-steps 4 --poll 0.02 --metrics-every 0.01
+    check_root "$ROOT"
+    SNAP="$(ls -d "$ROOT"/metrics/server-*)"
+    cp "$SNAP/metrics.json" "$TMP/metrics.json.good"
+
+    echo "metrics_gate: selftest 1 — a corrupted snapshot must trip"
+    head -c 40 "$TMP/metrics.json.good" > "$SNAP/metrics.json"
+    if check_root "$ROOT" > "$TMP/corrupt.out" 2>&1; then
+        echo "metrics_gate: SELFTEST FAILED — corrupted metrics.json" \
+             "passed the gate" >&2
+        exit 1
+    fi
+    grep -qi "corrupt" "$TMP/corrupt.out" || {
+        echo "metrics_gate: SELFTEST FAILED — wrong trip reason:" >&2
+        cat "$TMP/corrupt.out" >&2
+        exit 1
+    }
+    echo "metrics_gate: selftest 1 OK — corruption tripped the gate"
+
+    echo "metrics_gate: selftest 2 — a stale snapshot must trip"
+    python - "$TMP/metrics.json.good" "$SNAP/metrics.json" <<'PY'
+import json, sys
+snap = json.load(open(sys.argv[1]))
+snap["wall_time"] -= 1.0e6  # rewind past the freshness bound
+open(sys.argv[2], "w").write(json.dumps(snap))
+PY
+    if check_root "$ROOT" > "$TMP/stale.out" 2>&1; then
+        echo "metrics_gate: SELFTEST FAILED — stale snapshot passed" \
+             "the gate" >&2
+        exit 1
+    fi
+    grep -qi "stale" "$TMP/stale.out" || {
+        echo "metrics_gate: SELFTEST FAILED — wrong trip reason:" >&2
+        cat "$TMP/stale.out" >&2
+        exit 1
+    }
+    echo "metrics_gate: selftest 2 OK — staleness tripped the gate"
+
+    echo "metrics_gate: selftest 3 — a missing snapshot dir must trip"
+    rm -rf "$ROOT/metrics"
+    if check_root "$ROOT" > "$TMP/missing.out" 2>&1; then
+        echo "metrics_gate: SELFTEST FAILED — missing snapshots" \
+             "passed the gate" >&2
+        exit 1
+    fi
+    grep -qi "no metrics snapshots" "$TMP/missing.out" || {
+        echo "metrics_gate: SELFTEST FAILED — wrong trip reason:" >&2
+        cat "$TMP/missing.out" >&2
+        exit 1
+    }
+    echo "metrics_gate: selftest 3 OK — missing snapshots tripped" \
+         "the gate"
+    echo "metrics_gate: selftest PASS"
+    exit 0
+fi
+
+ROOT="$TMP/root"
+echo "metrics_gate: submitting 4 requests (one with an SLO deadline)"
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r1 --t-end 0.5 \
+    --ic-param width=0.08
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r2 --t-end 0.5 \
+    --ic-param width=0.10
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r3 --t-end 0.4 \
+    --priority 5
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id r4 --t-end 0.45 \
+    --deadline 300
+
+echo "metrics_gate: server up; waiting for a marched slice AND a" \
+     "published snapshot"
+"${CLI[@]}" serve-requests --root "$ROOT" --until-idle --max-batch 4 \
+    --slice-steps 2 --poll 0.02 --metrics-every 0.05 \
+    > "$TMP/server1.out" 2>&1 &
+SERVER=$!
+for _ in $(seq 1 2400); do
+    if grep -q '"slice"' "$ROOT/serve_events.jsonl" 2> /dev/null \
+        && ls "$ROOT"/metrics/server-*/metrics.json > /dev/null 2>&1
+    then
+        break
+    fi
+    if ! kill -0 "$SERVER" 2> /dev/null; then
+        echo "metrics_gate: server exited before the kill window:" >&2
+        cat "$TMP/server1.out" >&2
+        exit 1
+    fi
+    sleep 0.05
+done
+ls "$ROOT"/metrics/server-*/metrics.json > /dev/null 2>&1 || {
+    echo "metrics_gate: server never published a snapshot" >&2
+    exit 1
+}
+
+echo "metrics_gate: SIGKILL the server mid-batch (pid $SERVER)"
+kill -9 "$SERVER"
+wait "$SERVER" 2> /dev/null || true
+
+echo "metrics_gate: the pre-kill snapshot must still parse"
+python - "$ROOT" <<'PY'
+import glob, os, sys
+
+from multigpu_advectiondiffusion_tpu.telemetry import metrics as M
+
+root = sys.argv[1]
+snaps = sorted(glob.glob(os.path.join(root, "metrics", "server-*")))
+assert len(snaps) == 1, f"want 1 pre-kill incarnation dir, got {snaps}"
+snap = M.load_snapshot(os.path.join(snaps[0], "metrics.json"))
+samples = M.parse_prometheus(
+    open(os.path.join(snaps[0], "metrics.prom")).read())
+assert snap["counters"].get("serve_requests_received_total") == 4
+assert samples["tpucfd_serve_requests_received_total"] == 4
+print("metrics_gate: pre-kill snapshot parses — "
+      f"{len(snap['counters'])} counters intact")
+PY
+
+echo "metrics_gate: restart — the union across both lives must" \
+     "reconcile"
+"${CLI[@]}" serve-requests --root "$ROOT" --until-idle --max-batch 4 \
+    --slice-steps 2 --poll 0.02 --metrics-every 0.05
+"${CLI[@]}" serve-requests --root "$ROOT" --verify --require-complete
+
+check_root "$ROOT"
+
+echo "metrics_gate: tpucfd-status --once --json must be populated"
+"${CLI[@]}" status --root "$ROOT" --once --json > "$TMP/status.json"
+python - "$TMP/status.json" <<'PY'
+import json, sys
+
+frame = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert frame["requests"].get("done") == 4, frame["requests"]
+assert frame["metrics"]["snapshots"] >= 2, \
+    f"want snapshots from both lives: {frame['metrics']['snapshots']}"
+assert frame["metrics"]["counters"]["serve_requests_done_total"] == 4
+assert "serve_request_latency_seconds" in frame["quantiles"]
+print("metrics_gate: status frame populated — "
+      f"{frame['metrics']['snapshots']} snapshots, 4 done")
+PY
+echo "metrics_gate: PASS"

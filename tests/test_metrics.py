@@ -1,0 +1,384 @@
+"""Fleet metrics, SLO burn-rate alerting, and the status surface
+(ISSUE 18).
+
+The properties the tentpole rests on, each held directly:
+
+* fixed log-boundary histograms merge EXACTLY (elementwise bucket
+  adds; merged == one histogram that saw everything), and the
+  quantile estimator honors its documented worst-case relative error
+  bound (one bucket's width);
+* two processes' snapshots union into one fleet view — counters and
+  buckets add, gauges take newest value + running max;
+* a snapshot directory survives a SIGKILL between writes: the
+  previously published file stays parseable (atomic replace) and a
+  corrupt sibling snapshot is skipped, never fatal;
+* the SLO burn-rate engine fires on a synthetic deadline-miss stream
+  and stays silent on a healthy one, with alert/resolve hysteresis;
+* the replay adapter derives EXACTLY the counters the live
+  instruments counted, from the server's own event stream — the
+  exactly-once reconciliation the metrics gate automates end to end;
+* the discovery fix: a service root's per-job streams under
+  ``jobs/<id>/`` are found by ``load_streams``;
+* ``tpucfd-status --once --json`` reports a populated frame.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import random
+
+import pytest
+
+from multigpu_advectiondiffusion_tpu.telemetry import metrics as M
+
+
+# --------------------------------------------------------------------- #
+# Histogram: exact merge + bounded quantile error
+# --------------------------------------------------------------------- #
+def test_histogram_bucket_merge_is_exact():
+    random.seed(7)
+    xs = [random.lognormvariate(-4.0, 2.5) for _ in range(4000)]
+    parts = [M.Histogram("h") for _ in range(3)]
+    union = M.Histogram("h")
+    for i, x in enumerate(xs):
+        parts[i % 3].observe(x)
+        union.observe(x)
+    merged = M.Histogram("h")
+    for p in parts:
+        merged.merge(p)
+    # bucket-level identity, not approximate agreement
+    assert merged.counts == union.counts
+    assert merged.count == union.count == len(xs)
+    assert math.isclose(merged.sum, union.sum, rel_tol=1e-12)
+    assert merged.min == union.min and merged.max == union.max
+
+
+def test_histogram_merge_is_order_independent():
+    a, b = M.Histogram("h"), M.Histogram("h")
+    for x in (0.001, 0.5, 30.0):
+        a.observe(x)
+    for x in (0.002, 7.0):
+        b.observe(x)
+    ab, ba = M.Histogram("h"), M.Histogram("h")
+    ab.merge(a), ab.merge(b)
+    ba.merge(b), ba.merge(a)
+    assert ab.counts == ba.counts and ab.sum == ba.sum
+
+
+def test_histogram_refuses_incompatible_bounds():
+    a = M.Histogram("h")
+    b = M.Histogram("h", bounds=(1.0, 2.0, 4.0))
+    with pytest.raises(ValueError, match="incompatible"):
+        a.merge(b)
+
+
+def test_quantile_honors_documented_error_bound():
+    random.seed(11)
+    xs = sorted(random.lognormvariate(-2.0, 1.7) for _ in range(6000))
+    h = M.Histogram("h")
+    for x in xs:
+        h.observe(x)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 0.999):
+        exact = xs[int(q * (len(xs) - 1))]
+        est = h.quantile(q)
+        rel = abs(est - exact) / exact
+        assert rel <= M.QUANTILE_REL_ERROR + 1e-9, (q, est, exact, rel)
+
+
+def test_quantile_edge_cases():
+    h = M.Histogram("h")
+    assert h.quantile(0.5) is None and h.mean() is None
+    h.observe(0.25)
+    # one observation: every quantile is clamped to [min, max] = it
+    assert h.quantile(0.0) == h.quantile(0.5) == h.quantile(1.0) == 0.25
+    h.observe(float("nan"))  # refused, not bucketed
+    assert h.count == 1
+
+
+def test_overflow_and_underflow_buckets():
+    h = M.Histogram("h")
+    h.observe(1e-9)   # below the lowest bound
+    h.observe(1e9)    # above the highest
+    assert h.counts[0] == 1 and h.counts[-1] == 1
+    assert h.count == 2
+    # quantiles stay inside the observed range even out-of-bounds
+    assert 1e-9 <= h.quantile(0.0) <= 1e9
+    assert 1e-9 <= h.quantile(1.0) <= 1e9
+
+
+# --------------------------------------------------------------------- #
+# Snapshots: two-process merge, Prometheus text, crash safety
+# --------------------------------------------------------------------- #
+def _two_registries():
+    r1 = M.MetricsRegistry(proc="rank0")
+    r2 = M.MetricsRegistry(proc="rank1")
+    for r, k in ((r1, 3), (r2, 4)):
+        r.counter("reqs_total").inc(k)
+        for i in range(k):
+            r.histogram("lat_seconds").observe(0.01 * (i + 1))
+    r1.gauge("depth").set(5)
+    r2.gauge("depth").set(2)
+    return r1, r2
+
+
+def test_two_process_snapshot_merge(tmp_path):
+    r1, r2 = _two_registries()
+    s1 = r1.snapshot()
+    s2 = r2.snapshot()
+    s2["wall_time"] = s1["wall_time"] + 10.0  # rank1 published later
+    merged = M.merge_snapshots([s1, s2])
+    assert merged["counters"]["reqs_total"] == 7
+    hist = M.snapshot_histogram(merged, "lat_seconds")
+    assert hist.count == 7
+    # gauge: newest value wins, max is the max across processes
+    assert merged["gauges"]["depth"]["value"] == 2
+    assert merged["gauges"]["depth"]["max"] == 5
+    assert sorted(merged["merged_procs"]) == ["rank0", "rank1"]
+
+
+def test_merge_snapshot_dirs_unions_processes(tmp_path):
+    root = str(tmp_path / "metrics")
+    r1, r2 = _two_registries()
+    r1.write_snapshot(os.path.join(root, r1.proc))
+    r2.write_snapshot(os.path.join(root, r2.proc))
+    merged = M.merge_snapshot_dirs(root)
+    assert merged["snapshots"] == 2 and not merged["skipped"]
+    assert merged["counters"]["reqs_total"] == 7
+
+
+def test_prometheus_text_parses_and_is_cumulative(tmp_path):
+    r1, _ = _two_registries()
+    d = str(tmp_path / "m")
+    r1.write_snapshot(d)
+    text = open(os.path.join(d, "metrics.prom")).read()
+    samples = M.parse_prometheus(text)
+    assert samples["tpucfd_reqs_total"] == 3
+    assert samples["tpucfd_lat_seconds_count"] == 3
+    # bucket samples are cumulative and end at +Inf == count
+    buckets = [v for k, v in samples.items()
+               if k.startswith("tpucfd_lat_seconds_bucket")]
+    assert buckets == sorted(buckets)
+    assert samples['tpucfd_lat_seconds_bucket{le="+Inf"}'] == 3
+
+
+def test_kill_between_snapshot_writes_leaves_last_valid(tmp_path):
+    """The SIGKILL-between-writes contract: write_snapshot goes through
+    atomic_write_text, so a death mid-publish leaves (a) the previous
+    metrics.json intact and (b) at worst an orphan ``.tmp`` — and a
+    snapshot file that IS half-written (simulated corruption) is
+    skipped by the merge, never fatal."""
+    root = str(tmp_path / "metrics")
+    r = M.MetricsRegistry(proc="server-1")
+    r.counter("reqs_total").inc(2)
+    d = os.path.join(root, r.proc)
+    r.write_snapshot(d)
+    before = open(os.path.join(d, "metrics.json")).read()
+    # a dying process's orphan temp file next to the published snapshot
+    with open(os.path.join(d, ".metrics.json.killed.tmp"), "w") as f:
+        f.write('{"schema": 1, "counters": {"reqs_tot')
+    # previous snapshot still parses bit-for-bit
+    assert json.loads(before)["counters"]["reqs_total"] == 2
+    merged = M.merge_snapshot_dirs(root)
+    assert merged["counters"]["reqs_total"] == 2
+    # a sibling incarnation died INSIDE os.replace's window leaving a
+    # truncated metrics.json: skipped + reported, not fatal
+    bad = os.path.join(root, "server-2")
+    os.makedirs(bad)
+    with open(os.path.join(bad, "metrics.json"), "w") as f:
+        f.write('{"counters": {"reqs_total": 99')
+    merged = M.merge_snapshot_dirs(root)
+    assert merged["counters"]["reqs_total"] == 2
+    assert merged["snapshots"] == 1 and len(merged["skipped"]) == 1
+
+
+def test_corrupt_snapshot_raises_on_direct_load(tmp_path):
+    p = str(tmp_path / "metrics.json")
+    with open(p, "w") as f:
+        f.write("{not json")
+    with pytest.raises(ValueError):
+        M.load_snapshot(p)
+    with open(p, "w") as f:
+        f.write('{"no_counters": 1}')
+    with pytest.raises(ValueError, match="not a metrics snapshot"):
+        M.load_snapshot(p)
+
+
+# --------------------------------------------------------------------- #
+# SLO burn-rate engine
+# --------------------------------------------------------------------- #
+WINDOWS = ((60.0, 2.0, 4), (600.0, 1.0, 8))
+
+
+def _verdict_stream(n, miss, t0=1000.0):
+    out = []
+    for i in range(n):
+        ok_seconds = 5.0 if miss else 0.01
+        out.append({
+            "kind": "req", "name": "done", "job": f"r{i}",
+            "seconds": ok_seconds, "deadline_s": 1.0,
+            "slices": 1, "t": t0 + float(i),
+        })
+    return out
+
+
+def test_slo_alert_fires_on_deadline_miss_stream():
+    verdict = M.evaluate_slo_stream(
+        _verdict_stream(12, miss=True), objective=0.99, windows=WINDOWS
+    )
+    assert verdict["alerts"], verdict
+    alert = verdict["alerts"][0]
+    assert alert["burn_rate"] > alert["threshold"]
+    assert verdict["firing"]
+
+
+def test_slo_silent_on_healthy_stream():
+    verdict = M.evaluate_slo_stream(
+        _verdict_stream(12, miss=False), objective=0.99,
+        windows=WINDOWS,
+    )
+    assert not verdict["alerts"]
+    assert not verdict["firing"]
+
+
+def test_slo_hysteresis_one_alert_then_resolve():
+    emitted = []
+    t = M.SloTracker(objective=0.99, windows=((60.0, 2.0, 4),),
+                     emit=lambda name, p: emitted.append(name))
+    now = 5000.0
+    for i in range(10):  # sustained misses: exactly ONE alert
+        t.observe(False, wall=now + i)
+        t.evaluate(now=now + i)
+    assert emitted == ["alert"]
+    # the window drains with time alone -> one resolve
+    t.evaluate(now=now + 500.0)
+    assert emitted == ["alert", "resolve"]
+
+
+def test_slo_min_count_suppresses_single_early_miss():
+    t = M.SloTracker(objective=0.99, windows=((60.0, 2.0, 4),))
+    t.observe(False, wall=100.0)
+    assert t.evaluate(now=100.0) == []
+    assert not t.firing
+
+
+# --------------------------------------------------------------------- #
+# Replay adapter: exactly-once vs the live instruments
+# --------------------------------------------------------------------- #
+def _serve_round(root, rids, deadline=None):
+    from multigpu_advectiondiffusion_tpu.service.requests import (
+        RequestSpec,
+        submit_request_to_spool,
+    )
+    from multigpu_advectiondiffusion_tpu.service.server import (
+        RequestServer,
+    )
+
+    for i, rid in enumerate(rids):
+        submit_request_to_spool(root, RequestSpec(
+            request_id=rid, model="diffusion", n=[12, 12],
+            t_end=0.18, ic="gaussian",
+            ic_params={"width": 0.08 + 0.01 * i},
+            deadline_s=deadline,
+        ))
+    srv = RequestServer(root, max_batch=4, slice_steps=4, fsync=False,
+                        metrics_every_s=0.0)
+    srv.serve(until_idle=True, poll_seconds=0.001)
+    srv.close()
+    return srv
+
+
+def test_replay_counters_match_instrumented_exactly_once(tmp_path):
+    root = str(tmp_path / "serve")
+    srv = _serve_round(root, ["a", "b", "c"], deadline=300.0)
+    live = {k: c.value for k, c in srv.metrics.counters.items()}
+    # replay the server's own stream through the adapter
+    replayed = M.registry_from_streams([root])
+    derived = {k: c.value for k, c in replayed.counters.items()}
+    shared = set(live) & set(derived)
+    assert "serve_requests_done_total" in shared
+    assert "serve_requests_received_total" in shared
+    for key in sorted(shared):
+        assert derived[key] == live[key], (key, derived, live)
+    assert derived["serve_requests_done_total"] == 3
+    assert derived["serve_deadline_met_total"] == 3
+    # and the published snapshot dir agrees with both
+    merged = M.merge_snapshot_dirs(os.path.join(root, "metrics"))
+    for key in sorted(shared):
+        assert merged["counters"].get(key, 0) == live[key]
+    # latency histogram: replay observed the same events
+    lat = M.snapshot_histogram(merged, "serve_request_latency_seconds")
+    assert lat.count == 3
+    assert replayed.histograms[
+        "serve_request_latency_seconds"
+    ].counts == lat.counts
+
+
+def test_status_once_json_populated(tmp_path, capsys):
+    from multigpu_advectiondiffusion_tpu.cli import status as status_cli
+
+    root = str(tmp_path / "serve")
+    _serve_round(root, ["a", "b"], deadline=300.0)
+    status_cli.main(["--root", root, "--once", "--json"])
+    frame = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert frame["requests"].get("done") == 2
+    assert frame["metrics"]["snapshots"] >= 1
+    counters = frame["metrics"]["counters"]
+    assert counters["serve_requests_done_total"] == 2
+    assert "serve_request_latency_seconds" in frame["quantiles"]
+    assert not frame["slo"]["firing"]
+
+
+def test_status_render_text_lines(tmp_path):
+    from multigpu_advectiondiffusion_tpu.cli import status as status_cli
+
+    # a bare root (no journal, no snapshots) still renders a frame
+    frame = status_cli.collect_status(str(tmp_path))
+    lines = status_cli.render_text(frame)
+    assert any("tpucfd-status" in ln for ln in lines)
+    assert any("slo" in ln for ln in lines)
+
+
+# --------------------------------------------------------------------- #
+# Stream discovery (satellite: analyze.py service roots)
+# --------------------------------------------------------------------- #
+def test_load_streams_discovers_per_job_streams(tmp_path):
+    from multigpu_advectiondiffusion_tpu.telemetry.analyze import (
+        discover_streams,
+        load_streams,
+    )
+
+    root = str(tmp_path)
+    ev = {"t": 0.1, "proc": 0, "kind": "progress", "name": "chunk",
+          "step": 1, "steps_done": 1, "step_seconds": 0.1}
+
+    def _write(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json.dumps(ev) + "\n")
+
+    _write(os.path.join(root, "sched_events.jsonl"))
+    _write(os.path.join(root, "jobs", "j1", "events.jsonl"))
+    _write(os.path.join(root, "jobs", "j2", "events.jsonl"))
+    # a rotated segment must ride along, not appear as its own stream
+    _write(os.path.join(root, "jobs", "j1", "events.jsonl.1"))
+    found = discover_streams(root)
+    assert len(found) == 3
+    streams = load_streams([root])
+    assert len(streams) == 3
+    j1 = [s for s in streams if os.sep + "j1" + os.sep in s.path]
+    assert len(j1) == 1 and len(j1[0].events) == 2  # .1 prepended
+
+
+def test_journal_commit_timing_hook(tmp_path):
+    from multigpu_advectiondiffusion_tpu.service.journal import Journal
+
+    j = Journal(str(tmp_path / "j.jsonl"), fsync=True)
+    h = M.Histogram("fsync")
+    j.on_commit_seconds = h.observe
+    j.append("note", note="x")
+    j.append("note", note="y")
+    j.close()
+    assert h.count == 2
+    assert j.last_commit_seconds is not None
