@@ -782,6 +782,156 @@ def _serving_rows(on_tpu: bool):
     return [(row, ok)]
 
 
+def _serving_pipelined_rows(on_tpu: bool):
+    """Zero-copy pipelined serving rows (ISSUE 19): the SAME B=8
+    mixed-width diffusion request set served twice by the coalesced
+    server — once synchronous (``pipeline=False``, the ISSUE 17 loop)
+    and once pipelined (``pipeline=True``: donated state buffers,
+    dispatch-ahead depth 2, non-blocking finished-lane publish) — one
+    row per mode with req/s, p50/p99 latency and the measured
+    device-idle fraction (``serve_device_idle_fraction``, 1 - busy/wall
+    per dissolved batch). Both rounds run warm and without fsync. On
+    CPU this is a mechanics-grade number (the overlap hides *host*
+    work — publish, journal, health-stat collection — behind dispatch;
+    there is no device to keep busy), so the guard checks engagement
+    (every request answered in both modes, the pipelined round actually
+    dispatched ahead), not a speedup ratio — the on/off perf regression
+    gate is ``out/serving_perf_gate.sh``."""
+    import os
+    import shutil
+    import tempfile
+    import time
+
+    from multigpu_advectiondiffusion_tpu.service.requests import (
+        RequestSpec,
+        submit_request_to_spool,
+    )
+    from multigpu_advectiondiffusion_tpu.service.server import (
+        RequestServer,
+    )
+
+    B = 8
+    n = [64, 64] if on_tpu else [16, 16]
+    from multigpu_advectiondiffusion_tpu import (
+        DiffusionConfig as _DCfg,
+        DiffusionSolver as _DSolver,
+        Grid as _Grid,
+    )
+
+    _probe_cfg = _DCfg(grid=_Grid.make(*n), dtype="float32", impl="xla")
+    t_end = float(_probe_cfg.t0) + 24 * float(_DSolver(_probe_cfg).dt)
+
+    def _round(root, pipeline):
+        os.makedirs(root, exist_ok=True)
+        rids = []
+        for i in range(B):
+            rid = f"bench-pl{int(pipeline)}-{i}"
+            submit_request_to_spool(root, RequestSpec(
+                request_id=rid, model="diffusion", n=list(n),
+                t_end=t_end, dtype="float32", ic="gaussian",
+                ic_params={"width": 0.08 + 0.01 * i},
+            ))
+            rids.append(rid)
+        srv = RequestServer(root, max_batch=B, slice_steps=8,
+                            fsync=False, pipeline=pipeline,
+                            pipeline_depth=2)
+        t0 = time.perf_counter()
+        out = srv.serve(until_idle=True, poll_seconds=0.001)
+        wall = time.perf_counter() - t0
+        srv.close()
+        lat = []
+        for rid in rids:
+            p = os.path.join(root, "requests", rid, "result.json")
+            if os.path.exists(p):
+                with open(p) as fh:
+                    s = json.load(fh)
+                if s.get("seconds") is not None:
+                    lat.append(s["seconds"] * 1000.0)
+        idle = srv.metrics.histograms.get("serve_device_idle_fraction")
+        idle_frac = (round(idle.mean(), 4)
+                     if idle is not None and idle.count else None)
+        stall = srv.metrics.histograms.get(
+            "serve_pipeline_stall_seconds"
+        )
+        overlap = srv.metrics.histograms.get(
+            "serve_pipeline_overlap_fraction"
+        )
+        disp = srv.metrics.counters.get(
+            "serve_pipeline_dispatches_total"
+        )
+        done = (out.get("states") or {}).get("done", 0)
+        return {
+            "wall": wall,
+            "lat": sorted(lat),
+            "done": done,
+            "idle_frac": idle_frac,
+            "stall_s": (round(stall.sum, 5)
+                        if stall is not None and stall.count else None),
+            "overlap": (round(overlap.mean(), 4)
+                        if overlap is not None and overlap.count
+                        else None),
+            "dispatches": disp.value if disp is not None else 0,
+        }
+
+    work = tempfile.mkdtemp(prefix="tpucfd_bench_pipe_")
+    try:
+        # warm round per mode: pays the B=8 compile (donated and
+        # undonated executables key separately in the dispatch cache)
+        _round(os.path.join(work, "warm_sync"), False)
+        _round(os.path.join(work, "warm_pipe"), True)
+        sync = _round(os.path.join(work, "sync"), False)
+        pipe = _round(os.path.join(work, "pipelined"), True)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    from multigpu_advectiondiffusion_tpu.telemetry.metrics import (
+        Histogram,
+    )
+
+    def _pct(ms_values, q):
+        h = Histogram("bench_latency_ms")
+        for v in ms_values:
+            h.observe(v)
+        est = h.quantile(q)
+        return round(est, 3) if est is not None else None
+
+    rows = []
+    for mode, r in (("sync", sync), ("pipelined", pipe)):
+        row = {
+            "metric": f"serving_diffusion2d_b{B}_{mode}_rps",
+            "value": (round(B / r["wall"], 2)
+                      if r["wall"] > 0 else None),
+            "unit": "req/s",
+            "requests": B,
+            "seconds": round(r["wall"], 5),
+            "p50_ms": _pct(r["lat"], 0.50),
+            "p99_ms": _pct(r["lat"], 0.99),
+            "device_idle_frac": r["idle_frac"],
+            "pipeline": mode == "pipelined",
+            "ensemble": B,
+        }
+        if mode == "pipelined":
+            row["pipeline_depth"] = 2
+            row["stall_seconds"] = r["stall_s"]
+            row["overlap_fraction"] = r["overlap"]
+            row["vs_sync"] = (round(sync["wall"] / r["wall"], 3)
+                              if r["wall"] > 0 else None)
+        ok = r["done"] == B
+        if not ok:
+            row["engagement_error"] = {
+                "unanswered": {"done": r["done"], "expected": B}
+            }
+        elif mode == "pipelined" and r["dispatches"] <= 0:
+            # a "pipelined" row whose loop never dispatched ahead is a
+            # mislabeled synchronous row
+            row["engagement_error"] = {"pipeline_never_engaged": {
+                "dispatches": r["dispatches"],
+            }}
+            ok = False
+        rows.append((row, ok))
+    return rows
+
+
 def main() -> None:
     import os
     import sys
@@ -1011,6 +1161,16 @@ def main() -> None:
     # guarded on every request being answered and on coalescing
     # actually beating sequential dispatch at B=8
     for row, ok in _serving_rows(on_tpu):
+        if not ok:
+            mismatches.append(row["metric"])
+        print(json.dumps(row), flush=True)
+
+    # Pipelined-serving head-to-head (ISSUE 19): the same request set
+    # served synchronous vs pipelined (donated buffers, dispatch-ahead,
+    # async publish) — one row per mode with req/s, p50/p99 and the
+    # measured device-idle fraction; engagement-guarded on every
+    # request answered and the pipeline actually dispatching ahead
+    for row, ok in _serving_pipelined_rows(on_tpu):
         if not ok:
             mismatches.append(row["metric"])
         print(json.dumps(row), flush=True)

@@ -129,6 +129,10 @@ def collect_status(root: str) -> dict:
     quantiles = {}
     for name in ("serve_request_latency_seconds", "serve_slice_seconds",
                  "serve_batch_occupancy", "serve_journal_fsync_seconds",
+                 "serve_journal_fsync_batch_records",
+                 "serve_pipeline_stall_seconds",
+                 "serve_pipeline_overlap_fraction",
+                 "serve_device_idle_fraction",
                  "sched_job_seconds"):
         hist = snapshot_histogram(merged, name)
         if hist is None or hist.count == 0:
@@ -197,6 +201,29 @@ def render_text(status: dict) -> List[str]:
         lines.append(
             f"  slices    p50={sl['p50'] * 1e3:.1f}ms "
             f"p99={sl['p99'] * 1e3:.1f}ms (n={sl['count']})"
+        )
+    # zero-copy pipelined serving (ISSUE 19): the overlap line only
+    # appears once the pipelined loop has retired a slice
+    depth_g = gauges.get("serve_pipeline_depth") or {}
+    overlap = status["quantiles"].get("serve_pipeline_overlap_fraction")
+    idle = status["quantiles"].get("serve_device_idle_fraction")
+    if depth_g or overlap or idle:
+        parts = []
+        if depth_g:
+            parts.append(f"depth={depth_g.get('value')}"
+                         f"/max={depth_g.get('max')}")
+        if overlap:
+            parts.append(f"overlap p50={overlap['p50']:.2f} "
+                         f"mean={overlap['mean']:.2f}")
+        if idle:
+            parts.append(f"idle mean={idle['mean']:.2f}")
+        lines.append("  pipeline  " + " ".join(parts))
+    fsync = status["quantiles"].get("serve_journal_fsync_batch_records")
+    if fsync:
+        lines.append(
+            f"  fsync     batch p50={fsync['p50']:.1f} "
+            f"mean={fsync['mean']:.1f} max={fsync['max']:.0f} "
+            f"record(s)/fsync (n={fsync['count']})"
         )
     slo = status["slo"]
     verdict = "FIRING" if slo["firing"] else "ok"

@@ -54,6 +54,29 @@ from multigpu_advectiondiffusion_tpu.timestepping.integrators import INTEGRATORS
 from multigpu_advectiondiffusion_tpu.utils.ic import initial_condition
 
 
+def _consume_donated(*arrays) -> None:
+    """Enforce donation semantics on EVERY backend (ISSUE 19).
+
+    After a donated dispatch the input state is dead: XLA:TPU/GPU alias
+    its buffer into the output (the in-place HBM update donation buys),
+    but XLA:CPU implements no donation and would silently keep the
+    input alive — a reuse-after-donate bug would then pass the CPU
+    tier-1 suite and corrupt data on the accelerator. The dispatch
+    layer therefore deletes the donated operands itself, so ANY later
+    use raises jax's loud "Array has been deleted" RuntimeError
+    identically on every backend. PJRT defers the actual free until
+    in-flight computations drop their usage holds, so deleting right
+    after the (async) dispatch is safe."""
+    for arr in arrays:
+        delete = getattr(arr, "delete", None)
+        if delete is None:
+            continue  # tracer/numpy operand: nothing to consume
+        is_deleted = getattr(arr, "is_deleted", None)
+        if is_deleted is not None and is_deleted():
+            continue
+        delete()
+
+
 @dataclasses.dataclass
 class StepContext:
     """What the shard-local physics may depend on."""
@@ -548,12 +571,19 @@ class SolverBase:
             )
         )
 
-    def _compiled(self, key, builder, steps=None):
+    def _compiled(self, key, builder, steps=None, donate=False):
         """One dispatch-cache entry per program. ``steps`` is the
         iteration count the program bakes in (None for data-dependent
         trip counts, e.g. the t_end while_loop) — threaded to the
         measured-introspection layer so the executable's XLA-reported
-        bytes/FLOPs read against the per-step cost model."""
+        bytes/FLOPs read against the per-step cost model. ``donate``
+        marks a program compiled with its state operand donated (ISSUE
+        19) — a DIFFERENT executable than the undonated build, so the
+        bit separates the local cache entry and rides the AOT key."""
+        if donate:
+            key = (*key, "donated") if isinstance(key, tuple) else (
+                key, "donated"
+            )
         if key not in self._cache:
             from multigpu_advectiondiffusion_tpu import telemetry
             from multigpu_advectiondiffusion_tpu.telemetry import xprof
@@ -578,10 +608,11 @@ class SolverBase:
 
             aot_key = None
             if aot_cache.enabled():
-                aot_key = aot_cache.dispatch_key(self, key, steps=steps)
+                aot_key = aot_cache.dispatch_key(self, key, steps=steps,
+                                                 donate=donate)
             self._cache[key] = xprof.wrap_dispatch(
                 builder(), solver=self, key=str(key), steps=steps,
-                aot_key=aot_key,
+                aot_key=aot_key, donated=donate,
             )
         return self._cache[key]
 
@@ -1292,17 +1323,37 @@ class SolverBase:
         return P(MEMBER_AXIS, *spatial), P(MEMBER_AXIS)
 
     def _ensemble_wrap(self, fn, n_in_scalars: int, n_out_scalars: int,
-                       n_in_global: int = 0):
+                       n_in_global: int = 0, donate: bool = False):
         """Jit a batched block ``(us, *member_scalars, *globals) ->
         (us, *member_scalars)``. Under the armed ensemble mesh the
         block runs inside ``shard_map``: the state follows
         ``(members, *spatial)``, per-member operands follow the member
         axis, trailing globals (t_end) replicate. ``check=False``
         throughout — the bodies host vmapped while/fori loops and
-        Pallas calls, neither of which carries vma typing."""
+        Pallas calls, neither of which carries vma typing.
+
+        ``donate`` (ISSUE 19) donates the batched state operand
+        (argument 0): XLA aliases the input ``(B, *grid)`` buffer into
+        the output, so the slice march updates HBM in place instead of
+        holding two copies of the ensemble state per dispatch. Input
+        and output state share one PartitionSpec, so the alias is
+        always layout-compatible. Backends without donation support
+        (XLA:CPU) ignore the hint — the dispatch layer's
+        :func:`_consume_donated` makes the semantics uniform anyway."""
+        if donate:
+            import warnings
+
+            # XLA:CPU implements no donation and warns per dispatch;
+            # semantics stay uniform via _consume_donated, so the
+            # warning is noise on the tier-1 path
+            warnings.filterwarnings(
+                "ignore",
+                message="Some donated buffers were not usable",
+            )
+        kwargs = {"donate_argnums": (0,)} if donate else {}
         specs = self._ensemble_specs()
         if specs is None:
-            return jax.jit(fn)
+            return jax.jit(fn, **kwargs)
         uspec, mspec = specs
         return jax.jit(
             shard_map(
@@ -1312,7 +1363,8 @@ class SolverBase:
                 + (P(),) * n_in_global,
                 out_specs=(uspec,) + (mspec,) * n_out_scalars,
                 check=False,
-            )
+            ),
+            **kwargs,
         )
 
     def _ensemble_mesh_token(self):
@@ -1378,7 +1430,7 @@ class SolverBase:
         )
 
     def run_ensemble(self, estate: EnsembleState, num_iters: int,
-                     operands=None) -> EnsembleState:
+                     operands=None, donate: bool = False) -> EnsembleState:
         """Advance every member ``num_iters`` steps in ONE dispatch.
 
         Uniform-physics ensembles (no ``operands``) ``vmap`` the fused
@@ -1386,7 +1438,12 @@ class SolverBase:
         against the looped single runs (tests/test_ensemble.py);
         member-varying scalars (``{name: (B,) values}`` for the names
         in :meth:`ensemble_operands`) ride the generic stepper with the
-        scalars as batched operands."""
+        scalars as batched operands.
+
+        ``donate=True`` donates ``estate.u`` into the dispatch (in-place
+        HBM update, no second ``(B,*grid)`` buffer) and CONSUMES it:
+        ``estate`` must not be touched after this returns — any reuse
+        raises loudly on every backend (:func:`_consume_donated`)."""
         B = estate.members
         names, ops = self._ensemble_pack(operands, B)
         self._ensemble_gate(names)
@@ -1415,10 +1472,13 @@ class SolverBase:
 
                 f = self._compiled(
                     ("ens_slab_run", num_iters, B, mtok),
-                    lambda: self._ensemble_wrap(block, 1, 1),
-                    steps=int(num_iters),
+                    lambda: self._ensemble_wrap(block, 1, 1,
+                                                donate=donate),
+                    steps=int(num_iters), donate=donate,
                 )
                 u, t = f(estate.u, estate.t)
+                if donate:
+                    _consume_donated(estate.u)
                 return EnsembleState(u=u, t=t, it=estate.it + num_iters)
 
             if fused is not None:
@@ -1429,10 +1489,13 @@ class SolverBase:
 
                 f = self._compiled(
                     ("ens_fused_run", num_iters, B, mtok),
-                    lambda: self._ensemble_wrap(block, 1, 1),
-                    steps=int(num_iters),
+                    lambda: self._ensemble_wrap(block, 1, 1,
+                                                donate=donate),
+                    steps=int(num_iters), donate=donate,
                 )
                 u, t = f(estate.u, estate.t)
+                if donate:
+                    _consume_donated(estate.u)
                 return EnsembleState(u=u, t=t, it=estate.it + num_iters)
 
             def member(u, t, p):
@@ -1448,15 +1511,72 @@ class SolverBase:
 
             f = self._compiled(
                 ("ens_run", num_iters, B, names, mtok),
-                lambda: self._ensemble_wrap(block, 2, 1),
-                steps=int(num_iters),
+                lambda: self._ensemble_wrap(block, 2, 1, donate=donate),
+                steps=int(num_iters), donate=donate,
             )
             u, t = f(estate.u, estate.t, ops)
+            if donate:
+                _consume_donated(estate.u)
             return EnsembleState(u=u, t=t, it=estate.it + num_iters)
+
+    def _ensemble_advance_block(self, names, max_steps,
+                                per_member_te: bool):
+        """The ``advance_to_ensemble`` batched program, as a function —
+        shared VERBATIM between the real dispatch and
+        :meth:`prewarm_advance_to_ensemble` so a prewarmed executable
+        is bit-identical to (and cache-keyed the same as) the one the
+        live call would build."""
+        def member(u, t, p, te):
+            ov = {n: p[i] for i, n in enumerate(names)} or None
+            eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
+
+            if max_steps is not None:
+                def fbody(i, c):
+                    u, t, it = c
+                    u2, t2 = self._local_step(u, t, t_end=te,
+                                              overrides=ov)
+                    live = t < te - eps
+                    return (
+                        jnp.where(live, u2, u),
+                        jnp.where(live, t2, t),
+                        it + live.astype(jnp.int32),
+                    )
+
+                return lax.fori_loop(
+                    0, int(max_steps), fbody,
+                    (u, t, jnp.zeros((), jnp.int32)),
+                )
+
+            def cond(c):
+                return c[1] < te - eps
+
+            def body(c):
+                u, t, it = c
+                u, t = self._local_step(u, t, t_end=te, overrides=ov)
+                return (u, t, it + 1)
+
+            return lax.while_loop(
+                cond, body, (u, t, jnp.zeros((), jnp.int32))
+            )
+
+        if per_member_te:
+            # te rides the member axis like t/operands do: the vmap
+            # batches it, the ensemble mesh shards it with mspec
+            def block(us, ts, ps, tes):
+                return jax.vmap(member, in_axes=(0, 0, 0, 0))(
+                    us, ts, ps, tes
+                )
+        else:
+            def block(us, ts, ps, te):
+                return jax.vmap(member, in_axes=(0, 0, 0, None))(
+                    us, ts, ps, te
+                )
+        return block
 
     def advance_to_ensemble(self, estate: EnsembleState, t_end: float,
                             operands=None,
-                            max_steps: int | None = None) -> EnsembleState:
+                            max_steps: int | None = None,
+                            donate: bool = False) -> EnsembleState:
         """March every member to ``t_end`` in one dispatch (vmapped
         while-loop; finished members freeze while stragglers — e.g.
         smaller member dt — keep stepping). Generic rung only: the
@@ -1478,7 +1598,13 @@ class SolverBase:
         horizons ride ONE dispatch, each member freezing at its own
         ``te``. The scalar path keeps its original compiled key; the
         per-member path compiles a variant with ``te`` as a batched
-        member scalar."""
+        member scalar.
+
+        ``donate=True`` donates ``estate.u`` into the dispatch (ISSUE
+        19): XLA updates the ensemble state in place instead of holding
+        a second ``(B, *grid)`` buffer, and the input ``estate`` is
+        CONSUMED — touching ``estate.u`` afterwards is a loud
+        ``RuntimeError`` on every backend."""
         import numpy as _np
 
         B = estate.members
@@ -1497,68 +1623,90 @@ class SolverBase:
         with self._dispatch_span("advance_to_ensemble", mode="t_end",
                                  t_end=float(_np.max(te_host)),
                                  members=B):
-            def member(u, t, p, te):
-                ov = {n: p[i] for i, n in enumerate(names)} or None
-                eps = 1e-12 * jnp.maximum(1.0, jnp.abs(te))
-
-                if max_steps is not None:
-                    def fbody(i, c):
-                        u, t, it = c
-                        u2, t2 = self._local_step(u, t, t_end=te,
-                                                  overrides=ov)
-                        live = t < te - eps
-                        return (
-                            jnp.where(live, u2, u),
-                            jnp.where(live, t2, t),
-                            it + live.astype(jnp.int32),
-                        )
-
-                    return lax.fori_loop(
-                        0, int(max_steps), fbody,
-                        (u, t, jnp.zeros((), jnp.int32)),
-                    )
-
-                def cond(c):
-                    return c[1] < te - eps
-
-                def body(c):
-                    u, t, it = c
-                    u, t = self._local_step(u, t, t_end=te, overrides=ov)
-                    return (u, t, it + 1)
-
-                return lax.while_loop(
-                    cond, body, (u, t, jnp.zeros((), jnp.int32))
-                )
-
+            block = self._ensemble_advance_block(names, max_steps,
+                                                 per_member_te)
             if per_member_te:
-                # te rides the member axis like t/operands do: the vmap
-                # batches it, the ensemble mesh shards it with mspec
-                def block(us, ts, ps, tes):
-                    return jax.vmap(member, in_axes=(0, 0, 0, 0))(
-                        us, ts, ps, tes
-                    )
-
                 f = self._compiled(
                     ("ens_adv", B, names, mtok, max_steps, "vte"),
-                    lambda: self._ensemble_wrap(block, 3, 2),
+                    lambda: self._ensemble_wrap(block, 3, 2,
+                                                donate=donate),
+                    donate=donate,
                 )
                 u, t, steps = f(
                     estate.u, estate.t, ops,
                     jnp.asarray(te_host.reshape(-1), estate.t.dtype),
                 )
+                if donate:
+                    _consume_donated(estate.u)
                 return EnsembleState(u=u, t=t, it=estate.it + steps)
-
-            def block(us, ts, ps, te):
-                return jax.vmap(member, in_axes=(0, 0, 0, None))(
-                    us, ts, ps, te
-                )
 
             f = self._compiled(
                 ("ens_adv", B, names, mtok, max_steps),
-                lambda: self._ensemble_wrap(block, 2, 2, n_in_global=1),
+                lambda: self._ensemble_wrap(block, 2, 2, n_in_global=1,
+                                            donate=donate),
+                donate=donate,
             )
             u, t, steps = f(
                 estate.u, estate.t, ops,
                 jnp.asarray(t_end, estate.t.dtype),
             )
+            if donate:
+                _consume_donated(estate.u)
             return EnsembleState(u=u, t=t, it=estate.it + steps)
+
+    def prewarm_advance_to_ensemble(self, members: int,
+                                    operand_names=(),
+                                    max_steps: int | None = None,
+                                    donate: bool = False,
+                                    per_member_te: bool = True):
+        """Speculative AOT prewarm (ISSUE 19): resolve the
+        ``advance_to_ensemble`` executable for ``(members,
+        operand_names, max_steps, donate)`` from the persistent AOT
+        store WITHOUT concrete operands and WITHOUT ever compiling —
+        ``jax.ShapeDtypeStruct`` avals fingerprint identically to the
+        concrete arrays the live call will pass, so a deserialized hit
+        is the executable the next batch dispatches.
+
+        Returns ``"hit"`` (deserialized and resident), ``"resident"``
+        (already compiled/loaded in this process), ``"miss"`` (no
+        store entry — the live call will pay the compile), or ``None``
+        (prewarm unavailable: xprof/AOT cache disabled). Never
+        compiles cold, never raises on a cache problem.
+
+        The block builder is :meth:`_ensemble_advance_block` — the
+        SAME function the live dispatch uses — so even on a miss the
+        jit function parked in the dispatch cache is exactly the one
+        the live call would have built."""
+        B = int(members)
+        names = tuple(sorted(operand_names)) if operand_names else ()
+        self._ensemble_gate(names)
+        mtok = self._ensemble_mesh_token()
+        block = self._ensemble_advance_block(names, max_steps,
+                                             per_member_te)
+        if per_member_te:
+            f = self._compiled(
+                ("ens_adv", B, names, mtok, max_steps, "vte"),
+                lambda: self._ensemble_wrap(block, 3, 2,
+                                            donate=donate),
+                donate=donate,
+            )
+        else:
+            f = self._compiled(
+                ("ens_adv", B, names, mtok, max_steps),
+                lambda: self._ensemble_wrap(block, 2, 2, n_in_global=1,
+                                            donate=donate),
+                donate=donate,
+            )
+        prewarm = getattr(f, "prewarm", None)
+        if prewarm is None:
+            return None  # introspection wrapper absent: no AOT path
+        rdt = (jnp.float64 if self.dtype == jnp.dtype(jnp.float64)
+               else jnp.float32)
+        te_shape = (B,) if per_member_te else ()
+        shaped = (
+            jax.ShapeDtypeStruct((B, *self.grid.shape), self.dtype),
+            jax.ShapeDtypeStruct((B,), rdt),
+            jax.ShapeDtypeStruct((B, len(names)), jnp.float32),
+            jax.ShapeDtypeStruct(te_shape, rdt),
+        )
+        return prewarm(shaped)

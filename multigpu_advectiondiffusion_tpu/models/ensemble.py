@@ -183,6 +183,7 @@ class EnsembleSolver:
                   if k in supported)
         )
         self._probe = None
+        self._probe_parts = None
         self._baseline = None
 
     # ------------------------------------------------------------------ #
@@ -248,16 +249,34 @@ class EnsembleSolver:
     # ------------------------------------------------------------------ #
     # Execution
     # ------------------------------------------------------------------ #
-    def run(self, estate: EnsembleState, num_iters: int) -> EnsembleState:
+    def run(self, estate: EnsembleState, num_iters: int,
+            donate: bool = False) -> EnsembleState:
         return self.solver.run_ensemble(
-            estate, num_iters, operands=self.operands()
+            estate, num_iters, operands=self.operands(), donate=donate,
         )
 
     def advance_to(self, estate: EnsembleState, t_end: float,
-                   max_steps: Optional[int] = None) -> EnsembleState:
+                   max_steps: Optional[int] = None,
+                   donate: bool = False) -> EnsembleState:
+        """``donate=True`` consumes ``estate`` (its ``u`` buffer is
+        donated into the dispatch and deleted after — ISSUE 19); use
+        the returned state only."""
         return self.solver.advance_to_ensemble(
             estate, t_end, operands=self.operands(),
-            max_steps=max_steps,
+            max_steps=max_steps, donate=donate,
+        )
+
+    def prewarm(self, max_steps: Optional[int] = None,
+                donate: bool = False, per_member_te: bool = True):
+        """Speculative AOT prewarm of the :meth:`advance_to`
+        executable — deserializes a stored blob, never compiles cold.
+        Returns the solver's prewarm status string (or ``None`` when
+        the AOT path is unavailable)."""
+        ops = self.operands() or {}
+        return self.solver.prewarm_advance_to_ensemble(
+            self.members, operand_names=tuple(sorted(ops)),
+            max_steps=max_steps, donate=donate,
+            per_member_te=per_member_te,
         )
 
     def engaged_path(self) -> dict:
@@ -299,14 +318,28 @@ class EnsembleSolver:
     # ------------------------------------------------------------------ #
     # Per-member health + summaries
     # ------------------------------------------------------------------ #
-    def _get_probe(self):
-        if self._probe is None:
+    def _get_probe_parts(self):
+        if self._probe_parts is None:
             from multigpu_advectiondiffusion_tpu.resilience.sentinel import (
-                make_ensemble_probe,
+                make_ensemble_probe_parts,
             )
 
-            self._probe = make_ensemble_probe(self.solver)
+            self._probe_parts = make_ensemble_probe_parts(self.solver)
+        return self._probe_parts
+
+    def _get_probe(self):
+        if self._probe is None:
+            launch, collect = self._get_probe_parts()
+            self._probe = lambda estate: collect(launch(estate))
         return self._probe
+
+    def probe_launch(self, estate: EnsembleState):
+        """Enqueue the per-member health reduction on-device WITHOUT
+        blocking (JAX async dispatch). The pipelined server calls this
+        right after a slice dispatch — before the slice's output buffer
+        is donated into the next slice — and judges the result later
+        via :meth:`check_health_launched`."""
+        return self._get_probe_parts()[0](estate)
 
     def arm(self, estate: EnsembleState) -> None:
         """Record the per-member healthy baseline (mass integrals and
@@ -325,14 +358,10 @@ class EnsembleSolver:
             )
         self._baseline = stats
 
-    def check_health(self, estate: EnsembleState,
-                     growth: float = 1e3) -> dict:
-        """Per-member divergence check: non-finite members (or members
-        whose norm grew past ``growth * max(1, |u0|)``) raise
-        :class:`EnsembleMemberDivergedError` naming their indices —
-        the rest of the batch stays valid. Returns the per-member
-        stats dict on health."""
-        stats = self._get_probe()(estate)
+    def _judge_stats(self, stats: dict, step: int, t: float,
+                     growth: float) -> dict:
+        """The divergence verdict over collected probe stats — shared
+        by the blocking and launched health checks."""
         norms = stats["max_abs"]
         bad, why = [], None
         for i, m in enumerate(norms):
@@ -347,11 +376,34 @@ class EnsembleSolver:
                     why = f"norm grew past the growth bound ({growth:g})"
         if bad:
             raise EnsembleMemberDivergedError(
-                int(np.max(np.asarray(estate.it))),
-                float(np.max(np.asarray(estate.t))),
+                int(step), float(t),
                 bad, [norms[i] for i in bad], reason=why,
             )
         return stats
+
+    def check_health(self, estate: EnsembleState,
+                     growth: float = 1e3) -> dict:
+        """Per-member divergence check: non-finite members (or members
+        whose norm grew past ``growth * max(1, |u0|)``) raise
+        :class:`EnsembleMemberDivergedError` naming their indices —
+        the rest of the batch stays valid. Returns the per-member
+        stats dict on health."""
+        stats = self._get_probe()(estate)
+        return self._judge_stats(
+            stats,
+            step=int(np.max(np.asarray(estate.it))),
+            t=float(np.max(np.asarray(estate.t))),
+            growth=growth,
+        )
+
+    def check_health_launched(self, launched, step: int = 0,
+                              t: float = 0.0,
+                              growth: float = 1e3) -> dict:
+        """:meth:`check_health` against a :meth:`probe_launch` handle:
+        blocks only on the tiny per-member stat arrays — never on the
+        full state, which may already be donated into a later slice."""
+        stats = self._get_probe_parts()[1](launched)
+        return self._judge_stats(stats, step=step, t=t, growth=growth)
 
     def member_summaries(self, estate: EnsembleState) -> list:
         """One dict per member (max|u|, min/max, l2, mass, mass drift

@@ -121,15 +121,15 @@ def make_health_probe(solver, diagnostics: bool = False):
     return probe
 
 
-def make_ensemble_probe(solver):
-    """Per-member health/physics probe for batched ensemble states:
-    ``EnsembleState -> {key: (B,) list}`` of ``max_abs`` (non-finite
-    mapped to +inf, like the single-run probe), ``min``, ``max``,
-    ``l2`` and ``mass`` — ONE jitted vmapped reduction pass, reduced
-    along each member's own axes only, so one diverging member reports
-    its index instead of poisoning the batch (the member analog of the
-    mesh-aware probe above). Ensemble runs are single-device per
-    member, so no mesh reduction applies."""
+def make_ensemble_probe_parts(solver):
+    """The ensemble probe split at its device/host seam:
+    ``(launch, collect)``. ``launch(estate)`` enqueues the jitted
+    vmapped reduction and returns DEVICE arrays without blocking (JAX
+    async dispatch); ``collect(launched)`` pulls the tiny per-member
+    stats to host floats. The pipelined server (ISSUE 19) launches at
+    dispatch time — before the state buffer is donated into the next
+    slice — and collects at retirement, so the health check never
+    needs a live ``u`` and never stalls the pipeline."""
     import jax
 
     vol = math.prod(solver.grid.spacing)
@@ -145,8 +145,13 @@ def make_ensemble_probe(solver):
 
     f = jax.jit(jax.vmap(one))
 
-    def probe(estate) -> dict:
-        m, umin, umax, s2, s = (list(map(float, v)) for v in f(estate.u))
+    def launch(estate):
+        return f(estate.u)
+
+    def collect(launched) -> dict:
+        m, umin, umax, s2, s = (
+            list(map(float, v)) for v in launched
+        )
         return {
             "max_abs": m,
             "min": umin,
@@ -157,6 +162,23 @@ def make_ensemble_probe(solver):
             ],
             "mass": [vol * x for x in s],
         }
+
+    return launch, collect
+
+
+def make_ensemble_probe(solver):
+    """Per-member health/physics probe for batched ensemble states:
+    ``EnsembleState -> {key: (B,) list}`` of ``max_abs`` (non-finite
+    mapped to +inf, like the single-run probe), ``min``, ``max``,
+    ``l2`` and ``mass`` — ONE jitted vmapped reduction pass, reduced
+    along each member's own axes only, so one diverging member reports
+    its index instead of poisoning the batch (the member analog of the
+    mesh-aware probe above). Ensemble runs are single-device per
+    member, so no mesh reduction applies."""
+    launch, collect = make_ensemble_probe_parts(solver)
+
+    def probe(estate) -> dict:
+        return collect(launch(estate))
 
     return probe
 

@@ -196,6 +196,40 @@ def configure_serve_requests(p: argparse.ArgumentParser) -> None:
                    metavar="F",
                    help="deadline-SLO good-fraction target driving "
                         "the burn-rate alerts (default 0.99)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="pipelined slice loop (ISSUE 19): keep "
+                        "--pipeline-depth slices in flight, donate the "
+                        "state buffer into each dispatch, gather only "
+                        "finished lanes, and overlap all host/IO work "
+                        "with device compute")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   metavar="D",
+                   help="in-flight slice bound for --pipeline "
+                        "(default 2)")
+    p.add_argument("--donate", dest="donate", default=None,
+                   action="store_true",
+                   help="donate the ensemble state operand (in-place "
+                        "HBM update, no second (B,*grid) buffer); "
+                        "default: on with --pipeline, off without")
+    p.add_argument("--no-donate", dest="donate", action="store_false",
+                   help="force the undonated dispatch (the "
+                        "bit-exactness reference)")
+    p.add_argument("--group-commit-ms", type=float, default=0.0,
+                   metavar="MS",
+                   help="journal group commit: batch records per fsync "
+                        "under this latency window; acks wait for the "
+                        "commit barrier (0 = fsync per record, the "
+                        "default)")
+    p.add_argument("--no-prewarm", dest="prewarm",
+                   action="store_false", default=True,
+                   help="disable the speculative AOT prewarm of the "
+                        "likely next coalesce key")
+    p.add_argument("--http-port", type=int, default=None,
+                   metavar="PORT",
+                   help="HTTP ingestion adapter on loopback: POST "
+                        "/requests submits via the spool protocol, GET "
+                        "/requests/<id>[/result[.bin]] reads status/"
+                        "results (0 = ephemeral; off by default)")
     p.add_argument("--verify", action="store_true",
                    help="no daemon: replay the request journal, print "
                         "the state table, and exit nonzero when it "
@@ -328,10 +362,19 @@ def run_serve_requests(args) -> None:
         metrics_port=args.metrics_port,
         metrics_every_s=args.metrics_every,
         slo_objective=args.slo_objective,
+        pipeline=args.pipeline,
+        pipeline_depth=args.pipeline_depth,
+        donate=args.donate,
+        group_commit_s=args.group_commit_ms / 1000.0,
+        prewarm=args.prewarm,
+        http_port=args.http_port,
     )
     if server.metrics_port is not None:
         print(f"-- metrics endpoint: "
               f"http://127.0.0.1:{server.metrics_port}/metrics")
+    if server.http_port is not None:
+        print(f"-- request endpoint: "
+              f"http://127.0.0.1:{server.http_port}/requests")
     try:
         outcome = server.serve(
             until_idle=args.until_idle,

@@ -1,0 +1,160 @@
+"""Minimal stdlib HTTP ingestion adapter for the request server.
+
+Closes ROADMAP item 1's open transport debt without a new dependency:
+the daemon already speaks two fronts (the atomic spool mailbox and the
+optional unix-datagram RPC), and both funnel through the journal-first
+spool ingest. This adapter is the third front, and deliberately the
+thinnest possible one — every POST is written into the SAME spool
+mailbox (``submit_request_to_spool``), so HTTP submissions inherit the
+whole crash-safety story (journal-first, CRC-sealed records, SIGKILL
+replay) with zero new code paths; GETs only ever READ the published
+artifacts (verdict/result JSON written atomically by the server), so a
+reader can never observe a torn result.
+
+Verbs::
+
+    POST /requests            body = RequestSpec JSON -> 202 {request_id}
+    GET  /requests/<id>       verdict.json if published, else the live
+                              queue state ({"status": "pending", ...})
+    GET  /requests/<id>/result        result.json (summary)
+    GET  /requests/<id>/result.bin    raw field bytes (octet-stream)
+    GET  /healthz             liveness + queue depth
+
+The server binds loopback only — this is an ingestion adapter for
+co-located producers, not an internet-facing API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional, Tuple
+
+from multigpu_advectiondiffusion_tpu.service.requests import (
+    RequestSpec,
+    submit_request_to_spool,
+)
+
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+
+def _request_paths(root: str, request_id: str) -> Optional[str]:
+    """The request's artifact directory, or None for an id that could
+    escape ``root`` (path traversal is a 400, never a read)."""
+    if not _ID_RE.match(request_id):
+        return None
+    return os.path.join(root, "requests", request_id)
+
+
+def start_ingest_http(server, port: int) -> Tuple[object, int]:
+    """Start the ingestion endpoint on ``127.0.0.1:port`` (0 picks a
+    free port) in a daemon thread; returns ``(httpd, bound_port)``.
+    ``server`` is the live :class:`RequestServer` — used for the root
+    path, the telemetry sink, and the live queue state on status GETs.
+    """
+    import http.server
+    import threading
+
+    root = server.root
+    sink = server._sink
+    queue = server.queue
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, payload: dict) -> None:
+            self._send(code, json.dumps(payload, sort_keys=True).encode())
+
+        def _send_file(self, path: str, ctype: str) -> None:
+            try:
+                with open(path, "rb") as f:
+                    body = f.read()
+            except FileNotFoundError:
+                self._send_json(404, {"error": "not found"})
+                return
+            self._send(200, body, ctype)
+
+        def do_POST(self):  # noqa: N802 — stdlib contract
+            if self.path.split("?")[0] not in ("/requests", "/submit"):
+                self._send_json(404, {"error": "POST /requests"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length).decode())
+                if not isinstance(payload, dict):
+                    raise ValueError("request body is not a JSON object")
+                spec = RequestSpec.from_json(payload)
+                # the spool write IS the submission: the daemon's next
+                # ingest journals it first, exactly like file/socket
+                submit_request_to_spool(root, spec)
+            except (ValueError, TypeError, KeyError) as err:
+                sink.event(
+                    "serve", "spool_skip", file="<http>",
+                    error=f"{type(err).__name__}: {err}"[:200],
+                )
+                self._send_json(400, {
+                    "error": f"{type(err).__name__}: {err}"[:300],
+                })
+                return
+            self._send_json(202, {
+                "request_id": spec.request_id,
+                "status": "spooled",
+            })
+
+        def do_GET(self):  # noqa: N802 — stdlib contract
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                self._send_json(200, {
+                    "status": "ok",
+                    "open_requests": len(queue.open_requests()),
+                })
+                return
+            m = re.match(
+                r"^/requests/([^/]+)(?:/(result|result\.bin))?$", path
+            )
+            if not m:
+                self._send_json(404, {"error": "not found"})
+                return
+            rid, sub = m.group(1), m.group(2)
+            d = _request_paths(root, rid)
+            if d is None:
+                self._send_json(400, {"error": "bad request id"})
+                return
+            if sub == "result":
+                self._send_file(os.path.join(d, "result.json"),
+                                "application/json")
+                return
+            if sub == "result.bin":
+                self._send_file(os.path.join(d, "result.bin"),
+                                "application/octet-stream")
+                return
+            verdict = os.path.join(d, "verdict.json")
+            if os.path.exists(verdict):
+                self._send_file(verdict, "application/json")
+                return
+            rec = queue.requests.get(rid)
+            if rec is None:
+                self._send_json(404, {"error": "unknown request"})
+                return
+            self._send_json(200, {
+                "status": "pending",
+                "state": rec.state,
+                "attempts": rec.attempts,
+            })
+
+        def log_message(self, *args):  # quiet by design
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                            _Handler)
+    bound = int(httpd.server_address[1])
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    sink.event("serve", "http", port=bound)
+    return httpd, bound

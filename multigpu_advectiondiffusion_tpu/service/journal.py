@@ -18,6 +18,19 @@ Failure containment mirrors the rest of the repo:
   the daemon; the next successful append drains the buffer in order,
   so a freed disk heals the journal without losing sequencing.
 
+**Group commit** (ISSUE 19): ``group_commit_s > 0`` batches records
+per fsync under a bounded-latency window. Every append still writes
+and flushes its line immediately (the torn-tail/CRC discipline is
+unchanged — the bytes reach the OS before ``append`` returns), but the
+fsync is deferred until the window since the first unsynced record
+elapses, or until the caller demands a barrier with :meth:`commit`.
+The crash-safety contract is the caller's to keep and the API makes it
+cheap: a record's ``durable`` key is True only once ITS fsync ran, and
+the server acks/publishes nothing until ``commit()`` returns — one
+fsync then covers every record of the boundary instead of one fsync
+per transition. ``group_commit_s=0`` (the default) is byte- and
+syscall-identical to the pre-group-commit journal.
+
 Records are dicts with an envelope of ``seq`` (strictly increasing),
 ``wall`` (epoch seconds), ``type`` (``submit``/``state``/``note``) and
 the caller's fields; the ``crc`` field commits the rest.
@@ -57,18 +70,34 @@ class Journal:
     """Append-side handle. Replay is a classmethod so readers never
     need (or take) the writer's file handle."""
 
-    def __init__(self, path: str, fsync: bool = True):
+    def __init__(self, path: str, fsync: bool = True,
+                 group_commit_s: float = 0.0):
         self.path = path
         self._fsync = bool(fsync)
+        # the group-commit window only means anything when fsync is on
+        # (fsync=False already defers durability to the OS entirely)
+        self.group_commit_s = (
+            max(0.0, float(group_commit_s or 0.0)) if self._fsync
+            else 0.0
+        )
         self._f = None
         self.degraded = False
         self._pending: List[str] = []
+        # group-commit accounting: records written+flushed but not yet
+        # fsynced, and the wall the oldest of them was written at (the
+        # bounded-latency deadline reads against it)
+        self._unsynced = 0
+        self._first_unsynced: Optional[float] = None
         # durable-commit latency observer: the metrics layer sets this
         # to Histogram.observe so every fsync'd commit lands in
         # serve_journal_fsync_seconds without the journal importing
         # telemetry
         self.on_commit_seconds = None
         self.last_commit_seconds = None
+        # group-commit batch-size observer (records per fsync) — the
+        # fsync amortization the dashboard/bench rows report
+        self.on_commit_batch = None
+        self.last_commit_batch = 0
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -79,9 +108,10 @@ class Journal:
 
     # ------------------------------------------------------------------ #
     def append(self, rtype: str, **fields) -> dict:
-        """Journal one commit record; returns the record (its
-        ``durable`` key is False only while the journal is degraded and
-        the record sits in the pending buffer)."""
+        """Journal one commit record; returns the record. ``durable``
+        is False while the journal is degraded (the record sits in the
+        pending buffer) or — under group commit — until the record's
+        fsync ran (:meth:`commit` is the barrier that makes it True)."""
         self._seq += 1
         rec = {
             "seq": self._seq,
@@ -96,17 +126,27 @@ class Journal:
 
     def _commit(self, line: str) -> bool:
         """Drain any pending records, then write ``line``; one retry on
-        an OSError (ENOSPC and friends), then degrade instead of raise."""
+        an OSError (ENOSPC and friends), then degrade instead of raise.
+        Under group commit the write flushes but the fsync is deferred:
+        returns True only when the record is fsynced-durable NOW."""
         backlog = self._pending + [line]
         for attempt in (0, 1):
             try:
                 t0 = time.monotonic()
                 self._write("\n".join(backlog) + "\n")
+                self._pending = []
+                self.degraded = False
+                if self.group_commit_s > 0.0:
+                    self._unsynced += len(backlog)
+                    now = time.monotonic()
+                    if self._first_unsynced is None:
+                        self._first_unsynced = now
+                    if now - self._first_unsynced >= self.group_commit_s:
+                        return self.commit() > 0
+                    return False  # flushed; fsync pending in-window
                 self.last_commit_seconds = time.monotonic() - t0
                 if self.on_commit_seconds is not None:
                     self.on_commit_seconds(self.last_commit_seconds)
-                self._pending = []
-                self.degraded = False
                 return True
             except OSError:
                 # a failed write leaves the handle in an unknown state;
@@ -119,13 +159,68 @@ class Journal:
                 return False
         return False  # unreachable
 
+    # ------------------------------------------------------------------ #
+    # Group commit
+    # ------------------------------------------------------------------ #
+    @property
+    def unsynced(self) -> int:
+        """Records written+flushed whose fsync has not yet run."""
+        return self._unsynced
+
+    def commit_due(self) -> bool:
+        """True when the bounded-latency window has elapsed for the
+        oldest unsynced record (the loop's cue to call commit)."""
+        return (
+            self._unsynced > 0
+            and self._first_unsynced is not None
+            and time.monotonic() - self._first_unsynced
+            >= self.group_commit_s
+        )
+
+    def commit(self) -> int:
+        """The group-commit barrier: fsync every record written since
+        the last fsync. Returns the batch size (0 = nothing pending).
+        The caller acks/publishes only after this returns — that is the
+        whole crash-safety contract under group commit."""
+        if self._unsynced <= 0:
+            return 0
+        if self._f is None or self._f.closed:
+            # the records were flushed through a handle that is gone
+            # (ENOSPC reopen path); nothing to fsync against
+            self._unsynced = 0
+            self._first_unsynced = None
+            return 0
+        t0 = time.monotonic()
+        try:
+            os.fsync(self._f.fileno())
+        except OSError:
+            self._close_handle()
+            self.degraded = True
+            return 0
+        self.last_commit_seconds = time.monotonic() - t0
+        n, self._unsynced = self._unsynced, 0
+        self._first_unsynced = None
+        self.last_commit_batch = n
+        if self.on_commit_seconds is not None:
+            self.on_commit_seconds(self.last_commit_seconds)
+        if self.on_commit_batch is not None:
+            self.on_commit_batch(n)
+        return n
+
+    def maybe_commit(self) -> int:
+        """Fsync only when the latency window has elapsed — the serving
+        loop's per-tick call, bounding how stale an unsynced record can
+        get even when no ack forces a barrier."""
+        return self.commit() if self.commit_due() else 0
+
     def _write(self, text: str) -> None:
-        """The raw durable write (patched by ``faults.disk_full``)."""
+        """The raw durable write (patched by ``faults.disk_full``).
+        Under group commit the fsync is deferred to :meth:`commit`."""
         if self._f is None or self._f.closed:
             self._f = open(self.path, "a")
         self._f.write(text)
         self._f.flush()
-        if self._fsync:
+        if self._fsync and self.group_commit_s <= 0.0:
             os.fsync(self._f.fileno())
 
     def _close_handle(self) -> None:
@@ -140,6 +235,7 @@ class Journal:
         if self._pending:
             # last chance for parked records (disk may have freed up)
             self._commit_pending_best_effort()
+        self.commit()  # group commit: no unsynced tail left behind
         self._close_handle()
 
     def _commit_pending_best_effort(self) -> None:

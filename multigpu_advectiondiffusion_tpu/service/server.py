@@ -104,6 +104,17 @@ class _Batch:
         self.started = False
         self.slices = 0
         self.prev_it = np.asarray(estate.it).copy()
+        # pipelined serving (ISSUE 19): dispatched-but-unretired slices,
+        # oldest first. Each entry keeps the slice's estate (t/it stay
+        # readable — only u is donated), its launched health stats, the
+        # PREVIOUS slice's it (frozen-lane test), and the dispatch wall.
+        self.inflight: List[dict] = []
+        # device-busy accounting (mechanics-grade: busy is measured
+        # dispatch -> first-blocking-pull, so pipelined overlap shows
+        # as contiguous busy intervals)
+        self.t_formed = time.monotonic()
+        self.busy_s = 0.0
+        self.last_ready = self.t_formed
 
     def active(self) -> List[RequestRecord]:
         return [r for r in self.reqs if r is not None
@@ -137,7 +148,13 @@ class RequestServer:
                  metrics_port: Optional[int] = None,
                  metrics_every_s: float = 2.0,
                  slo_objective: float = 0.99,
-                 slo_windows=None):
+                 slo_windows=None,
+                 pipeline: bool = False,
+                 pipeline_depth: int = 2,
+                 donate: Optional[bool] = None,
+                 group_commit_s: float = 0.0,
+                 prewarm: bool = True,
+                 http_port: Optional[int] = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         os.makedirs(os.path.join(self.root, "requests"), exist_ok=True)
@@ -171,11 +188,30 @@ class RequestServer:
             windows=slo_windows or DEFAULT_SLO_WINDOWS,
             emit=self._emit_slo,
         )
+        # zero-copy pipelined serving knobs (ISSUE 19)
+        self.pipeline = bool(pipeline)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self.donate = bool(pipeline if donate is None else donate)
+        self.prewarm_enabled = bool(prewarm)
+        self._prewarmed: set = set()
+        self._pending_acks: List[tuple] = []
+        # fault injection for out/serving_perf_gate.sh --selftest: ack
+        # a request's verdict BEFORE its journal record is durable
+        # (and drop the record, simulating the power-loss window group
+        # commit must never expose) — the gate's consistency check
+        # must trip on this
+        self._fault_ack_before_fsync = os.environ.get(
+            "TPUCFD_FAULT_ACK_BEFORE_FSYNC", ""
+        ) not in ("", "0")
         self.journal = Journal(
-            os.path.join(self.root, "journal.jsonl"), fsync=fsync
+            os.path.join(self.root, "journal.jsonl"), fsync=fsync,
+            group_commit_s=group_commit_s,
         )
         self.journal.on_commit_seconds = self.metrics.histogram(
             "serve_journal_fsync_seconds"
+        ).observe
+        self.journal.on_commit_batch = self.metrics.histogram(
+            "serve_journal_fsync_batch_records"
         ).observe
         self.queue, self.replay_report = RequestQueue.replay(self.journal)
         self.max_batch = max(1, int(max_batch))
@@ -199,6 +235,18 @@ class RequestServer:
         self.metrics_port: Optional[int] = None
         if metrics_port is not None:
             self._start_metrics_http(int(metrics_port))
+        # stdlib HTTP ingestion front (ISSUE 19 satellite): POST maps
+        # onto the spool protocol, GET reads verdict/result artifacts
+        self._ingest_http = None
+        self.http_port: Optional[int] = None
+        if http_port is not None:
+            from multigpu_advectiondiffusion_tpu.service.http import (
+                start_ingest_http,
+            )
+
+            self._ingest_http, self.http_port = start_ingest_http(
+                self, int(http_port)
+            )
 
     # ------------------------------------------------------------------ #
     # Plumbing
@@ -246,6 +294,35 @@ class RequestServer:
             os.path.join(d, "verdict.json"),
             json.dumps(verdict, sort_keys=True, indent=1),
         )
+
+    def _ack(self, request_id: str, verdict: dict) -> None:
+        """Write the externally visible verdict — the ack. Under group
+        commit the write is DEFERRED to the next :meth:`_flush_acks`
+        barrier, so no submitter ever observes an ack whose journal
+        record is not yet fsync-durable (the ISSUE 19 crash-safety
+        contract). With ``group_commit_s=0`` every append fsyncs
+        inline, so the ack writes immediately — the pre-group-commit
+        behavior, byte for byte."""
+        if self.journal.group_commit_s > 0.0:
+            self._pending_acks.append((request_id, verdict))
+        else:
+            self._write_verdict(request_id, verdict)
+
+    def _flush_acks(self) -> None:
+        """The group-commit barrier of the serving loop: fsync every
+        buffered journal record, then release the verdict writes that
+        were waiting on durability. Called once per tick (and at
+        close), so ack latency is bounded by the tick cadence plus the
+        journal's latency window."""
+        if self._pending_acks:
+            self.journal.commit()
+            for rid, verdict in self._pending_acks:
+                self._write_verdict(rid, verdict)
+            self._pending_acks.clear()
+        else:
+            # bound staleness of unacked records (e.g. slice
+            # checkpoints) even when nothing is waiting on an ack
+            self.journal.maybe_commit()
 
     def _member_bytes(self, spec: RequestSpec) -> int:
         cells = int(math.prod(int(v) for v in spec.n))
@@ -451,7 +528,7 @@ class RequestServer:
         rid = rec.request_id
         self._transition(rid, "shed", reason="queue_bound",
                          retry_after_s=self.retry_after_s)
-        self._write_verdict(rid, {
+        self._ack(rid, {
             "status": "shed",
             "reason": "queue_bound",
             "retry_after_s": self.retry_after_s,
@@ -595,6 +672,19 @@ class RequestServer:
     # ------------------------------------------------------------------ #
     # Batch formation
     # ------------------------------------------------------------------ #
+    def _batch_cap(self, spec: RequestSpec) -> int:
+        """Batch width cap for a coalesce group led by ``spec`` — the
+        max-batch knob tightened by the memory-budget admission
+        estimate. Shared by formation and the speculative prewarm so
+        the prewarmed executable's B matches the batch that forms."""
+        cap = self.max_batch
+        if self.mem_budget_bytes:
+            per = self._member_bytes(spec)
+            cap = min(cap, max(
+                1, self.mem_budget_bytes // max(1, per)
+            ))
+        return int(cap)
+
     def _form_batch(self) -> Optional[_Batch]:
         cands = self.queue.batchable()
         if not cands:
@@ -602,16 +692,12 @@ class RequestServer:
         lead = cands[0]
         key = coalesce_key(lead.spec)
         group = [r for r in cands if coalesce_key(r.spec) == key]
-        cap = self.max_batch
-        if self.mem_budget_bytes:
-            per = self._member_bytes(lead.spec)
-            by_mem = max(1, self.mem_budget_bytes // max(1, per))
-            if by_mem < cap:
-                cap = int(by_mem)
-                for rec in group[cap:]:
-                    self._sink.event("serve", "defer",
-                                     job=rec.request_id,
-                                     reason="memory")
+        cap = self._batch_cap(lead.spec)
+        if cap < self.max_batch:
+            for rec in group[cap:]:
+                self._sink.event("serve", "defer",
+                                 job=rec.request_id,
+                                 reason="memory")
         group = group[:cap]
         try:
             tpl = self._template(lead.spec)
@@ -725,13 +811,20 @@ class RequestServer:
             os.makedirs(d, exist_ok=True)
             atomic_write_text(os.path.join(d, "crash.json"),
                               json.dumps(forensics, sort_keys=True))
-        self._write_verdict(rid, {
+        verdict = {
             "status": "failed", "reason": reason,
             "attempts": rec.attempts,
             **({"forensics": "crash.json"} if forensics else {}),
-        })
-        self._transition(rid, "failed", reason=reason,
-                         failure={"reason": reason})
+        }
+        if self.journal.group_commit_s > 0.0:
+            # journal first, ack after the commit barrier
+            self._transition(rid, "failed", reason=reason,
+                             failure={"reason": reason})
+            self._ack(rid, verdict)
+        else:
+            self._write_verdict(rid, verdict)
+            self._transition(rid, "failed", reason=reason,
+                             failure={"reason": reason})
         extra = ({"deadline_s": rec.spec.deadline_s}
                  if rec.spec.deadline_s is not None else {})
         self._sink.event("req", "failed", job=rid, reason=reason[:200],
@@ -740,19 +833,20 @@ class RequestServer:
         self._observe_deadline(rec, seconds=None, ok=False)
 
     def _finish(self, rec: RequestRecord, b: _Batch, lane: int,
-                estate) -> None:
+                u: np.ndarray, t: float, it: int) -> None:
         """Publish the lane's result, then journal ``done`` — in that
         order, so a crash between the two re-runs the member (same
-        bits) instead of losing the answer."""
+        bits) instead of losing the answer. Under group commit the
+        verdict ack additionally waits for the ``done`` record's fsync
+        (the :meth:`_flush_acks` barrier). ``u``/``t``/``it`` arrive
+        as HOST values — the pipelined path gathers finished lanes
+        device-side and awaits the copy before calling this."""
         from multigpu_advectiondiffusion_tpu.utils.io import (
             atomic_write_text,
             save_binary,
         )
 
         rid = rec.request_id
-        st = estate.member(lane)
-        u = np.asarray(st.u)
-        t, it = float(np.asarray(st.t)), int(np.asarray(st.it))
         d = self.request_dir(rid)
         os.makedirs(d, exist_ok=True)
         save_binary(u, os.path.join(d, "result.bin"))
@@ -774,11 +868,27 @@ class RequestServer:
         }
         atomic_write_text(os.path.join(d, "result.json"),
                           json.dumps(summary, sort_keys=True, indent=1))
-        self._write_verdict(rid, {
+        verdict = {
             "status": "done", "seconds": seconds,
             "result": "result.json",
-        })
-        self._transition(rid, "done", t=t, it=it, slices=b.slices)
+        }
+        if self._fault_ack_before_fsync:
+            # injected fault (serving_perf_gate --selftest): the ack
+            # escapes while the done record is dropped on the floor —
+            # the power-loss window the commit barrier exists to close.
+            # Memory advances so the loop completes; replay must show
+            # an acked-but-unjournaled request.
+            self._write_verdict(rid, verdict)
+            self.queue._apply_transition(
+                rec, rec.state, "done",
+                {"t": t, "it": it, "slices": b.slices},
+            )
+        elif self.journal.group_commit_s > 0.0:
+            self._transition(rid, "done", t=t, it=it, slices=b.slices)
+            self._ack(rid, verdict)  # released after the fsync barrier
+        else:
+            self._write_verdict(rid, verdict)
+            self._transition(rid, "done", t=t, it=it, slices=b.slices)
         extra = ({"deadline_s": rec.spec.deadline_s}
                  if rec.spec.deadline_s is not None else {})
         self._sink.event("req", "done", job=rid,
@@ -803,33 +913,52 @@ class RequestServer:
         os.makedirs(d, exist_ok=True)
         save_checkpoint(self._ckpt_path(rec.request_id), st)
 
-    def _park(self, b: _Batch, reason: str) -> None:
+    def _observe_batch_idle(self, b: _Batch) -> None:
+        """Per-batch device-idle fraction (mechanics-grade: busy is
+        measured dispatch -> first blocking pull, so host work hidden
+        behind in-flight slices reads as overlap). Observed once, when
+        the batch dissolves."""
+        wall = time.monotonic() - b.t_formed
+        if wall <= 0.0 or b.slices == 0:
+            return
+        idle = min(1.0, max(0.0, 1.0 - b.busy_s / wall))
+        self.metrics.histogram("serve_device_idle_fraction").observe(
+            idle
+        )
+        self._sink.event(
+            "pipeline", "batch_idle", batch=b.batch_id,
+            idle_fraction=round(idle, 4),
+            busy_seconds=round(b.busy_s, 6),
+            wall_seconds=round(wall, 6), slices=b.slices,
+        )
+
+    def _park(self, b: _Batch, reason: str, estate=None) -> None:
         """Dissolve the batch at a slice boundary: every unfinished
         member checkpoints and requeues (journaled), so the next
         formation — with joiners, without diverged lanes, or after the
-        preempting key — resumes bit-exactly."""
+        preempting key — resumes bit-exactly. ``estate`` overrides the
+        checkpoint source (the donated/pipelined paths park from the
+        newest live state — a later point on the same deterministic
+        trajectory, so the resumed march is still bit-exact at te)."""
+        est = b.estate if estate is None else estate
         for i, rec in enumerate(b.reqs):
             if rec is None or rec.state not in ("batched", "running"):
                 continue
-            self._save_member_ckpt(rec, b.estate.member(i))
+            self._save_member_ckpt(rec, est.member(i))
             self._transition(rec.request_id, "requeued", reason=reason,
                              checkpoint=self._ckpt_path(rec.request_id))
+        b.inflight.clear()
+        self._observe_batch_idle(b)
         self._batch = None
 
     # ------------------------------------------------------------------ #
     # The slice loop
     # ------------------------------------------------------------------ #
-    def _handle_divergence(self, b: _Batch, err, estate) -> None:
-        from multigpu_advectiondiffusion_tpu.resilience.errors import (
-            EnsembleMemberDivergedError,
-        )
-
-        assert isinstance(err, EnsembleMemberDivergedError)
-        bad = set(err.members)
+    def _fail_diverged(self, b: _Batch, err) -> List[str]:
         jobs = []
-        for i in sorted(bad):
+        for i in sorted(set(err.members)):
             rec = b.reqs[i] if i < len(b.reqs) else None
-            if rec is None:
+            if rec is None or rec.state not in ("batched", "running"):
                 continue  # a clone lane diverged with its original
             jobs.append(rec.request_id)
             norm = err.member_norms[err.members.index(i)]
@@ -843,12 +972,25 @@ class RequestServer:
                            "norm": norm,
                            "reason": err.reason,
                        })
+        return jobs
+
+    def _handle_divergence(self, b: _Batch, err, estate) -> None:
+        from multigpu_advectiondiffusion_tpu.resilience.errors import (
+            EnsembleMemberDivergedError,
+        )
+
+        assert isinstance(err, EnsembleMemberDivergedError)
+        jobs = self._fail_diverged(b, err)
         self._sink.event("serve", "divergence", batch=b.batch_id,
                          jobs=jobs)
         # survivors re-batch from their PRE-slice state: the diverged
         # lanes polluted only themselves, but the pre-slice state is
-        # the last one every survivor is known-healthy at
-        self._park(b, reason="divergence_rebatch")
+        # the last one every survivor is known-healthy at. With the
+        # state operand donated, the pre-slice buffer was consumed by
+        # the dispatch — survivors park from the POST-slice state the
+        # health check just proved them healthy at.
+        self._park(b, reason="divergence_rebatch",
+                   estate=estate if self.donate else None)
 
     def _joiners(self, b: _Batch) -> int:
         return sum(
@@ -864,24 +1006,34 @@ class RequestServer:
                 return r
         return None
 
+    def _start_batch(self, b: _Batch) -> None:
+        if b.started:
+            return
+        for rec in b.reqs:
+            if rec is not None and rec.state == "batched":
+                self._transition(
+                    rec.request_id, "running",
+                    attempt=max(rec.attempts, 1),
+                    batch=b.batch_id, slices=b.slices,
+                )
+        b.started = True
+
     def _tick_batch(self) -> bool:
         if self._batch is None:
             self._batch = self._form_batch()
             if self._batch is None:
                 return False
+        if self.pipeline:
+            return self._tick_batch_pipelined()
+        return self._tick_batch_sync()
+
+    def _tick_batch_sync(self) -> bool:
         b = self._batch
-        if not b.started:
-            for rec in b.reqs:
-                if rec is not None and rec.state == "batched":
-                    self._transition(
-                        rec.request_id, "running",
-                        attempt=max(rec.attempts, 1),
-                        batch=b.batch_id, slices=b.slices,
-                    )
-            b.started = True
+        self._start_batch(b)
         t0 = time.monotonic()
         estate = b.ens.advance_to(b.estate, list(b.te),
-                                  max_steps=self.slice_steps)
+                                  max_steps=self.slice_steps,
+                                  donate=self.donate)
         try:
             b.ens.check_health(estate, growth=self.growth)
         except Exception as err:  # EnsembleMemberDivergedError
@@ -893,6 +1045,11 @@ class RequestServer:
                 self._handle_divergence(b, err, estate)
                 return True
             raise
+        # the health probe synchronized on the slice: device busy ran
+        # dispatch -> now (the synchronous path's whole-slice wait)
+        ready = time.monotonic()
+        b.busy_s += max(0.0, ready - max(t0, b.last_ready))
+        b.last_ready = ready
         prev_it = b.prev_it
         b.estate = estate
         b.slices += 1
@@ -909,7 +1066,9 @@ class RequestServer:
                 or int(it_np[i]) == int(prev_it[i])  # frozen lane
             )
             if finished:
-                self._finish(rec, b, i, estate)
+                st = estate.member(i)
+                self._finish(rec, b, i, np.asarray(st.u),
+                             float(t_np[i]), int(it_np[i]))
                 done += 1
             elif b.slices % self.checkpoint_every == 0:
                 self._save_member_ckpt(rec, estate.member(i))
@@ -934,6 +1093,7 @@ class RequestServer:
             self.ledger.observe(b.key, compile_seconds=0.0)
             self.journal.append("note", note="warm", key=b.key)
         if active == 0:
+            self._observe_batch_idle(b)
             self._batch = None
             return True
         pre = self._preempting(b)
@@ -952,12 +1112,283 @@ class RequestServer:
         return True
 
     # ------------------------------------------------------------------ #
+    # The pipelined slice loop (ISSUE 19)
+    # ------------------------------------------------------------------ #
+    def _dispatch_slice(self, b: _Batch) -> None:
+        """Enqueue one bounded slice — JAX async dispatch returns
+        before the device finishes, so the caller's host work overlaps
+        the march. With donation on, the previous estate's ``u`` is
+        consumed by the dispatch; its (undonated) t/it scalars stay
+        readable, which is all retirement needs. The health reduction
+        launches here too, before the slice's own output buffer can be
+        donated into the next slice."""
+        prev = b.estate
+        # stamp BEFORE the advance call: trace/compile time spent
+        # inside the dispatch counts as busy, matching the synchronous
+        # loop's dispatch->ready interval — otherwise a cold compile
+        # reads as device idle in one mode and busy in the other
+        dispatched = time.monotonic()
+        estate = b.ens.advance_to(prev, list(b.te),
+                                  max_steps=self.slice_steps,
+                                  donate=self.donate)
+        stats = b.ens.probe_launch(estate)
+        slice_no = b.slices + len(b.inflight) + 1
+        b.inflight.append({
+            "estate": estate,
+            "stats": stats,
+            "prev_it": prev.it,
+            "dispatched": dispatched,
+            "slice_no": slice_no,
+        })
+        b.estate = estate
+        self._sink.event("pipeline", "dispatch", batch=b.batch_id,
+                         slice=slice_no, depth=len(b.inflight))
+        self.metrics.counter("serve_pipeline_dispatches_total").inc()
+        self.metrics.gauge("serve_pipeline_depth").set(
+            len(b.inflight)
+        )
+
+    def _tick_batch_pipelined(self) -> bool:
+        """The overlap-everything hot path: keep up to
+        ``pipeline_depth`` slices in flight, then retire the OLDEST
+        while the newer ones march on-device. Retirement's blocking
+        pulls touch per-member scalars only (t/it/health stats); the
+        one full-width transfer is a device-side gather of finished
+        lanes whose async host copy is awaited at publish time. A
+        finished lane's bits are identical in every later slice (the
+        frozen-lane invariance the ensemble engine proves), so
+        publishing from the newest estate is exact."""
+        from multigpu_advectiondiffusion_tpu.resilience.errors import (
+            EnsembleMemberDivergedError,
+        )
+
+        b = self._batch
+        self._start_batch(b)
+        # feed the device before any host work
+        while len(b.inflight) < self.pipeline_depth:
+            self._dispatch_slice(b)
+        entry = b.inflight.pop(0)
+        estate = entry["estate"]
+        pull0 = time.monotonic()
+        t_np = np.asarray(estate.t, dtype=np.float64)
+        it_np = np.asarray(estate.it)
+        prev_it = np.asarray(entry["prev_it"])
+        try:
+            b.ens.check_health_launched(
+                entry["stats"], step=int(np.max(it_np)),
+                t=float(np.max(t_np)), growth=self.growth,
+            )
+        except EnsembleMemberDivergedError as err:
+            self._handle_divergence_pipelined(b, err)
+            return True
+        ready = time.monotonic()
+        stall_s = ready - pull0
+        b.busy_s += max(
+            0.0, ready - max(entry["dispatched"], b.last_ready)
+        )
+        b.last_ready = ready
+        b.slices += 1
+        b.prev_it = it_np.copy()
+        finished = []
+        for i, rec in enumerate(b.reqs):
+            if rec is None or rec.state != "running":
+                continue
+            te = b.te[i]
+            if (
+                t_np[i] >= te - _finish_eps(te)
+                or int(it_np[i]) == int(prev_it[i])  # frozen lane
+            ):
+                finished.append(i)
+        host0 = time.monotonic()
+        gathered = None
+        if finished:
+            import jax.numpy as jnp
+
+            # device-side gather of finished members ONLY — the
+            # (B,*grid) blocking device_get this path replaces
+            gathered = jnp.take(b.estate.u, np.asarray(finished),
+                                axis=0)
+            start_copy = getattr(gathered, "copy_to_host_async", None)
+            if start_copy is not None:
+                try:
+                    start_copy()
+                except Exception:  # noqa: BLE001 — copy still awaited
+                    pass
+        done = 0
+        publish_wait = 0.0
+        if gathered is not None:
+            w0 = time.monotonic()
+            u_host = np.asarray(gathered)  # awaited at publish time
+            publish_wait = time.monotonic() - w0
+            stall_s += publish_wait
+            for j, i in enumerate(finished):
+                self._finish(b.reqs[i], b, i, u_host[j],
+                             float(t_np[i]), int(it_np[i]))
+                done += 1
+            self._sink.event(
+                "pipeline", "publish", batch=b.batch_id,
+                slice=b.slices, lanes=len(finished),
+                wait_seconds=round(publish_wait, 6),
+            )
+        active = len(b.active())
+        if (
+            active > 0
+            and b.slices % self.checkpoint_every == 0
+        ):
+            c0 = time.monotonic()
+            for i, rec in enumerate(b.reqs):
+                if rec is None or rec.state != "running":
+                    continue
+                # newest estate: a later point on the same trajectory,
+                # bit-exact to resume from (slicing invariance)
+                self._save_member_ckpt(rec, b.estate.member(i))
+            ckpt_wait = time.monotonic() - c0
+            stall_s += ckpt_wait
+            self._sink.event("pipeline", "stall", batch=b.batch_id,
+                             where="checkpoint",
+                             seconds=round(ckpt_wait, 6))
+        host_s = max(0.0, time.monotonic() - pull0 - stall_s)
+        overlap = (
+            host_s / (host_s + stall_s)
+            if b.inflight and (host_s + stall_s) > 0 else 0.0
+        )
+        occupancy = round(active / max(1, len(b.reqs)), 4)
+        self._sink.event(
+            "serve", "slice", batch=b.batch_id, slice=b.slices,
+            active=active, done=done, occupancy=occupancy,
+            seconds=round(ready - entry["dispatched"], 6),
+            stall_seconds=round(stall_s, 6),
+            overlap_fraction=round(overlap, 4),
+            depth=len(b.inflight),
+        )
+        self.metrics.counter("serve_slices_total").inc()
+        self.metrics.histogram("serve_slice_seconds").observe(
+            round(ready - entry["dispatched"], 6)
+        )
+        self.metrics.histogram("serve_batch_occupancy").observe(
+            occupancy
+        )
+        self.metrics.histogram("serve_pipeline_stall_seconds").observe(
+            stall_s
+        )
+        self.metrics.histogram(
+            "serve_pipeline_overlap_fraction"
+        ).observe(overlap)
+        if self.ledger.lookup(b.key) is None:
+            self.ledger.observe(b.key, compile_seconds=0.0)
+            self.journal.append("note", note="warm", key=b.key)
+        if active == 0:
+            b.inflight.clear()
+            self._observe_batch_idle(b)
+            self._batch = None
+            return True
+        pre = self._preempting(b)
+        if pre is not None:
+            self._sink.event(
+                "serve", "preempt", batch=b.batch_id,
+                for_job=pre.request_id, parked=active,
+            )
+            self._park(b, reason="preempted")
+            return True
+        joiners = self._joiners(b)
+        if joiners and active < self.max_batch:
+            self._sink.event("serve", "join", batch=b.batch_id,
+                             waiting=joiners)
+            self._park(b, reason="rebatch_join")
+        return True
+
+    def _handle_divergence_pipelined(self, b: _Batch, err) -> None:
+        from multigpu_advectiondiffusion_tpu.resilience.errors import (
+            EnsembleMemberDivergedError,
+        )
+
+        jobs = self._fail_diverged(b, err)
+        # the pipeline ran ahead of the verdict: re-judge the NEWEST
+        # estate so a survivor that diverged inside the lookahead
+        # fails now, instead of poisoning the re-formed batch's arm()
+        try:
+            b.ens.check_health(b.estate, growth=self.growth)
+        except EnsembleMemberDivergedError as err2:
+            jobs += self._fail_diverged(b, err2)
+        self._sink.event("serve", "divergence", batch=b.batch_id,
+                         jobs=jobs)
+        # survivors park from the newest estate — the only one whose
+        # ``u`` is live under donation, and just proven healthy
+        self._park(b, reason="divergence_rebatch")
+
+    def _maybe_prewarm(self) -> None:
+        """Speculative AOT prewarm (ISSUE 19 layer 4): while the live
+        batch marches on-device, deserialize — never compile — the
+        warm-ledger executable for the most likely next coalesce key,
+        so a key change at the next formation costs a load instead of
+        a compile stall. One attempt per key per incarnation."""
+        if not self.prewarm_enabled or self._batch is None:
+            return
+        from multigpu_advectiondiffusion_tpu.tuning import aot_cache
+
+        if not aot_cache.enabled():
+            return
+        b = self._batch
+        lead = None
+        key = None
+        for r in self.queue.batchable():
+            k = coalesce_key(r.spec)
+            if k == b.key or k in self._prewarmed:
+                continue
+            if self.ledger.lookup(k) is None:
+                continue  # cold key: prewarm never compiles
+            lead, key = r, k
+            break
+        if lead is None:
+            return
+        self._prewarmed.add(key)
+        t0 = time.monotonic()
+        try:
+            tpl = self._template(lead.spec)
+            group = [x for x in self.queue.batchable()
+                     if coalesce_key(x.spec) == key]
+            group = group[:self._batch_cap(lead.spec)]
+            overrides = [
+                self._member_overrides(x.spec) for x in group
+            ]
+            from multigpu_advectiondiffusion_tpu.models.ensemble import (
+                EnsembleSolver,
+            )
+            from multigpu_advectiondiffusion_tpu.parallel.mesh import (
+                member_extent,
+            )
+
+            pad = (-len(overrides)) % member_extent(tpl["mesh"])
+            overrides += [dict(overrides[0]) for _ in range(pad)]
+            # construction never compiles; prewarm only deserializes
+            ens = EnsembleSolver(
+                tpl["family"].solver_cls, tpl["cfg"], overrides,
+                mesh=tpl["mesh"], decomp=tpl["decomp"],
+            )
+            status = ens.prewarm(max_steps=self.slice_steps,
+                                 donate=self.donate)
+        except Exception as err:  # noqa: BLE001 — prewarm never kills
+            status = f"error: {type(err).__name__}: {err}"[:200]
+        self._sink.event(
+            "pipeline", "prewarm", key=key, status=str(status),
+            seconds=round(time.monotonic() - t0, 6),
+        )
+        self.metrics.counter("serve_prewarm_total").inc()
+        if status == "hit":
+            self.metrics.counter("serve_prewarm_hits_total").inc()
+
+    # ------------------------------------------------------------------ #
     # The loop
     # ------------------------------------------------------------------ #
     def tick(self) -> dict:
         self.recover()
         self._ingest()
         progressed = self._tick_batch()
+        # host-side work that overlaps the in-flight slices: prewarm
+        # the likely next executable, then the group-commit barrier
+        # that releases this tick's acks
+        self._maybe_prewarm()
+        self._flush_acks()
         open_count = len(self.queue.open_requests())
         self.metrics.gauge("serve_queue_depth").set(open_count)
         self.slo.evaluate()  # time alone can clear (or breach) windows
@@ -985,6 +1416,9 @@ class RequestServer:
             "serve", "start", root=self.root,
             max_batch=self.max_batch, slice_steps=self.slice_steps,
             queue_bound=self.queue_bound,
+            pipeline=self.pipeline, pipeline_depth=self.pipeline_depth,
+            donate=self.donate,
+            group_commit_s=self.journal.group_commit_s,
         )
         t0 = time.monotonic()
         ticks = 0
@@ -1022,7 +1456,15 @@ class RequestServer:
         return outcome
 
     def close(self) -> None:
+        self._flush_acks()
         self.export_metrics(force=True)
+        if self._ingest_http is not None:
+            try:
+                self._ingest_http.shutdown()
+                self._ingest_http.server_close()
+            except OSError:
+                pass
+            self._ingest_http = None
         if self._http is not None:
             try:
                 self._http.shutdown()

@@ -170,13 +170,18 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
     # formation, slice progress (the request timeline's spine), joins,
     # preemptions, member-attributed divergence, spool quarantines
     "serve": {
-        "start": {"root", "max_batch", "slice_steps", "queue_bound"},
+        "start": {"root", "max_batch", "slice_steps", "queue_bound",
+                  "pipeline", "pipeline_depth", "donate",
+                  "group_commit_s"},
         "recover": {"records", "torn_lines", "requests", "requeued",
                     "failed"},
         "admit": {"job", "key", "warm"},
         "defer": {"job", "reason"},
         "shed": {"job", "open", "bound", "retry_after_s"},
         "batch": {"batch", "key", "members", "lanes"},
+        # pipelined slices (ISSUE 19) additionally carry
+        # stall_seconds / overlap_fraction / depth — optional here
+        # because the synchronous loop's slices do not
         "slice": {"batch", "slice", "active", "done", "occupancy",
                   "seconds"},
         "join": {"batch", "waiting"},
@@ -184,6 +189,22 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "divergence": {"batch", "jobs"},
         "spool_skip": {"file", "error"},
         "stop": {"reason", "states"},
+        # stdlib HTTP ingestion adapter came up (service/http.py)
+        "http": {"port"},
+    },
+    # zero-copy pipelined serving (ISSUE 19, service/server.py): the
+    # overlap machinery's own trace — dispatch-ahead depth, the
+    # non-blocking publish of finished lanes, every stall the pipeline
+    # could not hide, speculative AOT prewarm verdicts, and the
+    # per-batch device-idle accounting the bench's device_idle_frac
+    # column and the serving perf gate read
+    "pipeline": {
+        "dispatch": {"batch", "slice", "depth"},
+        "publish": {"batch", "slice", "lanes", "wait_seconds"},
+        "stall": {"batch", "where", "seconds"},
+        "prewarm": {"key", "status", "seconds"},
+        "batch_idle": {"batch", "idle_fraction", "busy_seconds",
+                       "wall_seconds", "slices"},
     },
     # per-request lifecycle in the server's stream: every journal
     # transition is mirrored as a req:state event so tpucfd-trace can
@@ -280,6 +301,11 @@ COUNTER_NAMES: Set[str] = {
     "serve_deadline_missed_total",
     "serve_slo_alerts_total",
     "serve_slo_resolves_total",
+    # zero-copy pipelined serving (ISSUE 19): dispatch-ahead launches,
+    # and the speculative AOT prewarm's attempts/deserialization hits
+    "serve_pipeline_dispatches_total",
+    "serve_prewarm_total",
+    "serve_prewarm_hits_total",
     "sched_jobs_submitted_total",
     "sched_jobs_admitted_total",
     "sched_job_exits_total",

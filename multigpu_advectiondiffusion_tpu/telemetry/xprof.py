@@ -122,6 +122,10 @@ class ExecRecord:
     # when the cache is disabled
     aot: Optional[str] = None
     compile_seconds_saved: Optional[float] = None
+    # buffer donation (ISSUE 19): True when the program donates its
+    # state operand (XLA aliases it into the output — no second
+    # state-sized HBM buffer per dispatch)
+    donated: bool = False
 
     def to_fields(self) -> dict:
         return dataclasses.asdict(self)
@@ -200,15 +204,50 @@ class _IntrospectedDispatch:
     """
 
     def __init__(self, fn, solver, key: str, steps: Optional[int] = None,
-                 aot_key: Optional[str] = None):
+                 aot_key: Optional[str] = None, donated: bool = False):
         self._fn = fn
         self._solver = solver
         self._key = key
         self._steps = steps
         self._aot_key = aot_key
+        self._donated = bool(donated)
         self._compiled = None
         self._fallback = False
         self.record: Optional[ExecRecord] = None
+
+    def prewarm(self, shaped_args) -> Optional[str]:
+        """Speculative AOT resolve (ISSUE 19): look the program up in
+        the persistent store against ABSTRACT operands
+        (``jax.ShapeDtypeStruct`` leaves carry the same aval
+        fingerprint as the concrete arrays) and deserialize on a hit —
+        NEVER compiles cold, so a miss costs one file stat. Returns
+        ``"hit"`` (executable now resident — the first real call skips
+        both compile and load), ``"miss"``, ``"resident"`` (already
+        compiled), or ``None`` (cache off / fallback engaged)."""
+        from multigpu_advectiondiffusion_tpu.tuning import aot_cache
+
+        if self._fallback:
+            return None
+        if self._compiled is not None:
+            return "resident"
+        if not (self._aot_key and aot_cache.enabled()):
+            return None
+        full_key = (
+            f"{self._aot_key}|"
+            f"avals={aot_cache.aval_fingerprint(shaped_args)}"
+        )
+        loaded = aot_cache.load(full_key, shaped_args)
+        if loaded is None:
+            return "miss"
+        compiled, meta = loaded
+        self._compiled = compiled
+        self.record = _capture(
+            compiled, self._solver, self._key, self._steps,
+            meta["load_seconds"], aot="hit",
+            compile_seconds_saved=meta["compile_seconds_saved"],
+            donated=self._donated,
+        )
+        return "hit"
 
     def _aot_resolve(self, args):
         """Persistent AOT cache (tuning/aot_cache.py): returns
@@ -253,6 +292,7 @@ class _IntrospectedDispatch:
             self.record = _capture(
                 compiled, self._solver, self._key, self._steps, compile_s,
                 aot=aot, compile_seconds_saved=saved,
+                donated=self._donated,
             )
         try:
             return self._compiled(*args)
@@ -265,6 +305,7 @@ class _IntrospectedDispatch:
 def _capture(compiled, solver, key: str, steps: Optional[int],
              compile_s: float, aot: Optional[str] = None,
              compile_seconds_saved: Optional[float] = None,
+             donated: bool = False,
              ) -> Optional[ExecRecord]:
     """Build (and register + emit) the ExecRecord for one compiled
     executable; every probe is individually fault-tolerant."""
@@ -316,6 +357,7 @@ def _capture(compiled, solver, key: str, steps: Optional[int],
             None if compile_seconds_saved is None
             else round(compile_seconds_saved, 6)
         ),
+        donated=bool(donated),
         **cost,
         **mem,
     )
@@ -333,16 +375,19 @@ def _capture(compiled, solver, key: str, steps: Optional[int],
 
 
 def wrap_dispatch(fn, solver, key: str, steps: Optional[int] = None,
-                  aot_key: Optional[str] = None):
+                  aot_key: Optional[str] = None,
+                  donated: bool = False):
     """Dispatch-layer hook: wrap a freshly built jitted program for
     measured introspection (no-op passthrough when ``TPUCFD_XPROF=0``
     or the builder returned something un-lowerable). ``aot_key``
     additionally routes the first-call compile through the persistent
-    AOT executable cache (tuning/aot_cache.py)."""
+    AOT executable cache (tuning/aot_cache.py); ``donated`` marks a
+    program that donates its state operand (recorded on the
+    ``xla:cost`` event — the bit also rides the AOT key upstream)."""
     if not enabled() or not hasattr(fn, "lower"):
         return fn
     return _IntrospectedDispatch(fn, solver, key, steps=steps,
-                                 aot_key=aot_key)
+                                 aot_key=aot_key, donated=donated)
 
 
 # --------------------------------------------------------------------- #

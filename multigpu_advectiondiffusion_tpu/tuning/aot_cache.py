@@ -118,7 +118,8 @@ def _physics_fingerprint(cfg) -> str:
     return hashlib.sha256(body.encode()).hexdigest()[:16]
 
 
-def dispatch_key(solver, program_key, steps=None) -> str:
+def dispatch_key(solver, program_key, steps=None,
+                 donate: bool = False) -> str:
     """The cache key for one dispatch-cache entry: the tuner's config
     key (solver, shape, dtype, integrator, mesh, backend — and, for the
     ensemble programs, the member count B riding ``program_key``) plus
@@ -163,6 +164,12 @@ def dispatch_key(solver, program_key, steps=None) -> str:
         f"phys={phys}",
         f"prog={program_key}",
         f"steps={steps}",
+        # buffer donation (ISSUE 19): a donated program aliases its
+        # state operand into the output — a different executable than
+        # the undonated build, so the bit is part of the identity (a
+        # donated blob deserialized into an undonated dispatch would
+        # free buffers the caller still holds)
+        f"donate={int(bool(donate))}",
     ])
 
 

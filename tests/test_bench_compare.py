@@ -133,6 +133,12 @@ def test_gate_passes_unmodified_r05_round():
 
 
 def test_gate_trips_on_injected_20pct_regression():
+    # This acceptance case depends on DEFAULT_SPREAD_CAP: without the
+    # cap, an archived round whose victim row carries a large measured
+    # spread (a CPU-round artifact) can widen its own threshold past
+    # 20% and swallow the injected regression — the seed's original
+    # failure mode. The cap (0.15) bounds the spread-derived slack
+    # below the injection, so this must trip for EVERY archived round.
     rows = cmp.load_rows(_newest_round())
     slowed = {k: dict(v) for k, v in rows.items()}
     victim = sorted(slowed)[0]
@@ -143,6 +149,34 @@ def test_gate_trips_on_injected_20pct_regression():
     assert [r.metric for r in bad] == [victim]
     assert "REGRESSION" in res.format_text()
     assert "FAIL" in res.format_text()
+
+
+def test_gate_trips_on_20pct_regression_pinned_fixtures():
+    """The injected-regression guarantee, pinned — no dependence on
+    whatever BENCH_r0*.json ships in the checkout. A -20% move must
+    trip at BOTH spread extremes: a quiet row (threshold = rel_tol)
+    and a pathologically noisy row, where DEFAULT_SPREAD_CAP must keep
+    the spread-derived slack below the injection."""
+    assert cmp.DEFAULT_SPREAD_CAP < 0.20, (
+        "spread cap must stay below the 20% acceptance injection"
+    )
+    for spread in (0.0, 0.02, 0.15, 0.5, 5.0):
+        old = {"m_mlups": {"metric": "m_mlups", "value": 100.0,
+                           "spread": spread}}
+        new = {"m_mlups": {"metric": "m_mlups", "value": 80.0,
+                           "spread": spread}}
+        res = cmp.compare(new, old)
+        assert not res.ok, (
+            f"-20% hid inside spread={spread} (threshold "
+            f"{res.rows[0].threshold})"
+        )
+        # and an in-noise move must NOT trip (the cap keeps semantics,
+        # it does not turn the gate paranoid)
+        ok = {"m_mlups": {"metric": "m_mlups", "value": 97.0,
+                          "spread": spread}}
+        assert cmp.compare(ok, old).ok, (
+            f"-3% tripped at spread={spread}"
+        )
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,401 @@
+"""Zero-copy pipelined serving (ISSUE 19).
+
+Tier-1 coverage of the four tentpole layers: buffer donation through
+the batched ensemble dispatch (bit-exact vs undonated on diffusion AND
+Burgers, reuse-after-donate a loud error), the pipelined slice loop
+(bit-exact vs the synchronous server at B in {1, 8}), group-commit
+journaling (durability semantics, batch accounting, the bounded-latency
+window, and the ack barrier — an injected ack-before-fsync fault leaves
+detectable acked-but-unjournaled orphans), and the real-SIGKILL chaos
+case under --pipeline --group-commit: restart replays to exactly-once
+with ZERO acked-but-unjournaled requests.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from multigpu_advectiondiffusion_tpu import Grid
+from multigpu_advectiondiffusion_tpu.models import registry
+from multigpu_advectiondiffusion_tpu.models.ensemble import EnsembleSolver
+from multigpu_advectiondiffusion_tpu.resilience import faults
+from multigpu_advectiondiffusion_tpu.service.journal import (
+    Journal,
+    verify_records,
+)
+from multigpu_advectiondiffusion_tpu.service.requests import (
+    ALLOWED_REQUEST_TRANSITIONS,
+    REQUEST_TERMINAL_STATES,
+    RequestSpec,
+    submit_request_to_spool,
+)
+from multigpu_advectiondiffusion_tpu.service.server import RequestServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the tier-1 serving shape (see tests/test_serving.py): diffusion's
+# analytic Gaussian starts at t0 = 0.1, so horizons must exceed it
+N = [12, 12]
+T_END = 0.18  # ~12 steps at this grid's stability dt
+
+
+def _spec(rid, **kw) -> RequestSpec:
+    base = dict(model="diffusion", n=list(N), t_end=T_END,
+                ic="gaussian")
+    base.update(kw)
+    return RequestSpec(request_id=rid, **base)
+
+
+def _result_bits(root, rid) -> bytes:
+    with open(os.path.join(root, "requests", rid, "result.bin"),
+              "rb") as f:
+        return f.read()
+
+
+def _acked_but_unjournaled(root):
+    """Request ids whose verdict.json says done but whose journal has
+    no done transition — the inconsistency the group-commit ack barrier
+    must make impossible (and the injected fault must make visible)."""
+    records, _ = Journal.replay(os.path.join(root, "journal.jsonl"))
+    journaled = {r.get("job") for r in records
+                 if r.get("type") == "state" and r.get("to") == "done"}
+    acked = set()
+    for p in glob.glob(os.path.join(root, "requests", "*",
+                                    "verdict.json")):
+        with open(p) as f:
+            v = json.load(f)
+        if v.get("status") == "done":
+            acked.add(os.path.basename(os.path.dirname(p)))
+    return sorted(acked - journaled)
+
+
+# --------------------------------------------------------------------- #
+# Layer 1: buffer donation through the batched dispatch
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("family,overrides,t_end", [
+    ("diffusion", [{}, {"diffusivity": 1.3}], 0.16),
+    ("burgers", [{}, {"cfl": 0.4}], 0.2),
+])
+def test_donated_advance_bit_exact(family, overrides, t_end):
+    """The acceptance criterion: a donated dispatch computes the SAME
+    bits as the undonated one — donation changes buffer lifetime, never
+    arithmetic — on both model families."""
+    fam = registry.get(family)
+    cfg = fam.config_cls(grid=Grid.make(24, 24))
+    te = [float(t_end)] * len(overrides)
+
+    ens = EnsembleSolver(fam.solver_cls, cfg, overrides)
+    plain = ens.advance_to(ens.initial_state(), te, max_steps=64)
+    donated = ens.advance_to(ens.initial_state(), te, max_steps=64,
+                             donate=True)
+    assert np.asarray(plain.it).tolist() == \
+        np.asarray(donated.it).tolist()
+    pu = np.asarray(plain.u)
+    du = np.asarray(donated.u)
+    assert pu.dtype == du.dtype
+    assert (pu == du).all(), (
+        f"{family}: donated dispatch changed bits "
+        f"(max abs diff {np.max(np.abs(pu - du))})"
+    )
+
+
+def test_reuse_after_donate_raises():
+    """The donated operand is consumed: touching the old state's ``u``
+    after a donating dispatch must be a loud error on EVERY backend —
+    including CPU, where XLA ignores the donation hint and the explicit
+    post-dispatch delete supplies the semantics."""
+    fam = registry.get("diffusion")
+    cfg = fam.config_cls(grid=Grid.make(16, 16))
+    ens = EnsembleSolver(fam.solver_cls, cfg, [{}, {"diffusivity": 1.3}])
+    st = ens.initial_state()
+    out = ens.advance_to(st, [0.14, 0.14], max_steps=8, donate=True)
+    with pytest.raises(RuntimeError):
+        np.asarray(st.u)
+    # the NEW state and the old state's undonated scalars stay readable
+    assert np.isfinite(np.asarray(out.u)).all()
+    assert np.asarray(st.t).shape == (2,)
+    assert np.asarray(st.it).shape == (2,)
+
+
+# --------------------------------------------------------------------- #
+# Layer 2: pipelined vs synchronous serving, bit-exact
+# --------------------------------------------------------------------- #
+def _serve(root, specs, **server_kw):
+    for s in specs:
+        submit_request_to_spool(root, s)
+    srv = RequestServer(root, max_batch=8, slice_steps=4, fsync=False,
+                        **server_kw)
+    try:
+        out = srv.serve(until_idle=True, poll_seconds=0.001)
+    finally:
+        srv.close()
+    return out
+
+
+@pytest.mark.parametrize("width", [1, 8])
+def test_pipelined_bit_exact_vs_sync(tmp_path, width):
+    """The same request set served by the synchronous loop and by the
+    pipelined loop (donated buffers, depth 2, async finished-lane
+    publish) publishes bit-identical results at B in {1, 8}."""
+    specs = [
+        _spec(f"p{i}", ic_params={"width": 0.08 + 0.01 * i})
+        for i in range(width)
+    ]
+    sync_root = str(tmp_path / "sync")
+    pipe_root = str(tmp_path / "pipe")
+    out_sync = _serve(sync_root, specs, pipeline=False)
+    out_pipe = _serve(pipe_root, specs, pipeline=True,
+                      pipeline_depth=2)
+    assert out_sync["states"].get("done") == width
+    assert out_pipe["states"].get("done") == width
+    for s in specs:
+        assert _result_bits(sync_root, s.request_id) == \
+            _result_bits(pipe_root, s.request_id), (
+                f"{s.request_id}: pipelined serving changed the answer"
+            )
+    # the pipelined round actually dispatched ahead and published
+    ev = [json.loads(l) for l in
+          open(os.path.join(pipe_root, "serve_events.jsonl"))
+          if l.strip()]
+    assert any(e["kind"] == "pipeline" and e["name"] == "dispatch"
+               for e in ev)
+    assert any(e["kind"] == "pipeline" and e["name"] == "publish"
+               for e in ev)
+    assert any(e["kind"] == "pipeline" and e["name"] == "batch_idle"
+               for e in ev)
+
+
+# --------------------------------------------------------------------- #
+# Layer 3: group-commit journaling
+# --------------------------------------------------------------------- #
+def test_group_commit_defers_fsync_until_barrier(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path, group_commit_s=60.0) as j:
+        r1 = j.append("note", msg="a")
+        r2 = j.append("note", msg="b")
+        # written + flushed (replayable NOW), but not fsync-durable
+        assert r1["durable"] is False and r2["durable"] is False
+        assert j.unsynced == 2
+        assert not j.commit_due()
+        assert j.maybe_commit() == 0  # window not elapsed: no fsync
+        records, torn = Journal.replay(path)
+        assert [r["msg"] for r in records] == ["a", "b"]
+        assert torn == 0
+        # the barrier fsyncs the whole batch and reports its size
+        assert j.commit() == 2
+        assert j.unsynced == 0
+        assert j.last_commit_batch == 2
+        assert j.commit() == 0  # idempotent
+
+
+def test_group_commit_window_elapses(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    sizes = []
+    with Journal(path, group_commit_s=0.02) as j:
+        j.on_commit_batch = sizes.append
+        j.append("note", msg="a")
+        assert j.unsynced == 1
+        time.sleep(0.03)
+        # the bounded-latency window elapsed: the next append (or the
+        # loop's maybe_commit) fsyncs without an explicit barrier
+        rec = j.append("note", msg="b")
+        assert rec["durable"] is True
+        assert j.unsynced == 0
+    assert sizes and sizes[0] == 2
+
+
+def test_group_commit_zero_is_immediate(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with Journal(path, group_commit_s=0.0) as j:
+        assert j.append("note", msg="a")["durable"] is True
+        assert j.unsynced == 0
+
+
+def test_group_commit_close_flushes_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    sizes = []
+    j = Journal(path, group_commit_s=60.0)
+    j.on_commit_batch = sizes.append
+    j.append("note", msg="tail")
+    j.close()
+    assert sizes == [1]  # close() is a barrier: no unsynced tail
+
+
+def test_ack_before_fsync_fault_leaves_detectable_orphans(
+        tmp_path, monkeypatch):
+    """The gate's teeth, in-process: with the injected fault the server
+    acks done BEFORE the journal record exists — the consistency check
+    must see acked-but-unjournaled requests. Without the fault (the
+    real ack barrier) the same check must see none."""
+    specs = [_spec(f"f{i}", ic_params={"width": 0.08 + 0.01 * i})
+             for i in range(2)]
+
+    clean_root = str(tmp_path / "clean")
+    _serve(clean_root, specs, pipeline=True, group_commit_s=0.005)
+    assert _acked_but_unjournaled(clean_root) == []
+
+    monkeypatch.setenv("TPUCFD_FAULT_ACK_BEFORE_FSYNC", "1")
+    fault_root = str(tmp_path / "fault")
+    _serve(fault_root, specs, pipeline=True, group_commit_s=0.005)
+    orphans = _acked_but_unjournaled(fault_root)
+    assert orphans == sorted(s.request_id for s in specs), (
+        f"fault injection should orphan every ack, got {orphans}"
+    )
+
+
+# --------------------------------------------------------------------- #
+# Layer 4: SIGKILL mid-group-commit chaos
+# --------------------------------------------------------------------- #
+_PIPELINED_WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multigpu_advectiondiffusion_tpu.cli.__main__ import main
+main(["serve-requests", "--root", sys.argv[2], "--until-idle",
+      "--max-batch", "4", "--slice-steps", "2", "--poll", "0.01",
+      "--pipeline", "--pipeline-depth", "2", "--group-commit-ms", "20"])
+print("SERVE-WORKER-OK", flush=True)
+'''
+
+
+def _launch(tmp_path, tag, root):
+    script = tmp_path / f"server_{tag}.py"
+    script.write_text(_PIPELINED_WORKER)
+    log = tmp_path / f"server_{tag}.log"
+    handle = open(log, "w")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), REPO, root],
+        stdout=handle, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    return proc, log, handle
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_group_commit_replays_exactly_once(tmp_path):
+    """SIGKILL a pipelined group-commit server mid-batch, restart it:
+    every request reaches done exactly once, the journal linearizes
+    complete, and — the group-commit contract — ZERO requests are
+    acked-but-unjournaled at every point (the kill instant included:
+    no verdict may exist without its fsynced done record)."""
+    root = str(tmp_path / "killed")
+    specs = [_spec(f"k{i}", t_end=0.5,
+                   ic_params={"width": 0.08 + 0.02 * i})
+             for i in range(4)]
+    for s in specs:
+        submit_request_to_spool(root, s)
+
+    proc, log, handle = _launch(tmp_path, "victim", root)
+    try:
+        slices_seen = faults.kill_server_mid_batch(proc, root,
+                                                   timeout=180.0)
+        assert slices_seen >= 1
+        proc.wait(timeout=30)
+        assert proc.returncode == -9
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        handle.close()
+
+    # the kill instant: whatever was acked must already be journalled
+    assert _acked_but_unjournaled(root) == [], (
+        "SIGKILL caught an ack ahead of its fsync barrier"
+    )
+
+    proc, log, handle = _launch(tmp_path, "recovered", root)
+    try:
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        handle.close()
+    assert rc == 0, f"recovered server rc={rc}:\n{log.read_text()[-2000:]}"
+
+    records, torn = Journal.replay(os.path.join(root, "journal.jsonl"))
+    assert verify_records(
+        records, torn=torn,
+        allowed_transitions=ALLOWED_REQUEST_TRANSITIONS,
+        terminal_states=REQUEST_TERMINAL_STATES,
+        initial_state="received",
+        require_complete=True,
+    ) == []
+    for s in specs:
+        dones = [r for r in records if r.get("type") == "state"
+                 and r.get("job") == s.request_id
+                 and r.get("to") == "done"]
+        assert len(dones) == 1, (
+            f"{s.request_id}: answered {len(dones)} times"
+        )
+    assert _acked_but_unjournaled(root) == []
+
+
+# --------------------------------------------------------------------- #
+# Satellite: the stdlib HTTP ingestion adapter
+# --------------------------------------------------------------------- #
+def test_http_adapter_submits_and_reads_results(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    root = str(tmp_path / "http")
+    os.makedirs(root, exist_ok=True)
+    srv = RequestServer(root, max_batch=4, slice_steps=4, fsync=False,
+                        pipeline=True, http_port=0)
+    try:
+        port = srv.http_port
+        assert port
+        base = f"http://127.0.0.1:{port}"
+        body = json.dumps({
+            "request_id": "h1", "model": "diffusion", "n": N,
+            "t_end": T_END, "ic": "gaussian",
+            "ic_params": {"width": 0.09},
+        }).encode()
+        req = urllib.request.Request(
+            f"{base}/requests", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 202
+            assert json.load(resp)["request_id"] == "h1"
+        # drive the serving loop until the request publishes
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            srv.tick()
+            if os.path.exists(os.path.join(root, "requests", "h1",
+                                           "verdict.json")):
+                break
+        with urllib.request.urlopen(f"{base}/requests/h1",
+                                    timeout=10) as resp:
+            assert json.load(resp)["status"] == "done"
+        with urllib.request.urlopen(f"{base}/requests/h1/result.bin",
+                                    timeout=10) as resp:
+            bits = resp.read()
+        assert bits == _result_bits(root, "h1")
+        with urllib.request.urlopen(f"{base}/healthz",
+                                    timeout=10) as resp:
+            assert json.load(resp)["status"] == "ok"
+        # path traversal is a 400, never a read
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/requests/..%2f..%2fjournal.jsonl", timeout=10
+            )
+        assert ei.value.code in (400, 404)
+        # a malformed POST is a 400, not a crash
+        bad = urllib.request.Request(f"{base}/requests",
+                                     data=b"{not json")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        srv.close()
