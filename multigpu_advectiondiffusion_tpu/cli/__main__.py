@@ -596,6 +596,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "[--operand diffusivity=0.5 --wait 60]")
     service_cli.configure_request(p)
 
+    p = sub.add_parser("migrate",
+                       help="upgrade a service root's journal to the "
+                            "current schema version in place (atomic "
+                            "tempfile + rename; idempotent; refuses "
+                            "journals stamped with a future version)")
+    service_cli.configure_migrate(p)
+
     # tpucfd-status: the fleet dashboard (also standalone:
     # python -m multigpu_advectiondiffusion_tpu.cli.status)
     from multigpu_advectiondiffusion_tpu.cli import status as status_cli

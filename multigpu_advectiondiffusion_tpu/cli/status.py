@@ -68,17 +68,38 @@ def _state_counts(root: str) -> dict:
     """Replay the root's journal into request/job state counts. The
     journal is the durable truth (the metrics snapshot is a cadence
     behind by design), so the dashboard's state table reads it."""
-    from multigpu_advectiondiffusion_tpu.service.journal import Journal
+    from multigpu_advectiondiffusion_tpu.service.journal import (
+        Journal,
+        JournalSchemaError,
+    )
 
     out = {"requests": {}, "jobs": {}, "journal_records": 0,
-           "torn_lines": 0, "slo": {"alerts": 0, "resolves": 0,
-                                    "firing": False, "last_alert": None}}
+           "torn_lines": 0, "clean_shutdown": False, "draining": False,
+           "schema_error": None,
+           "slo": {"alerts": 0, "resolves": 0,
+                   "firing": False, "last_alert": None}}
     path = os.path.join(root, "journal.jsonl")
     if not os.path.exists(path):
         return out
-    records, torn = Journal.replay(path)
+    try:
+        records, torn = Journal.replay(path)
+    except JournalSchemaError as err:
+        # a future-schema journal is a dashboard FACT, not a crash
+        out["schema_error"] = str(err)
+        return out
     out["journal_records"] = len(records)
     out["torn_lines"] = int(torn)
+    if records:
+        last = records[-1]
+        out["clean_shutdown"] = bool(
+            last.get("type") == "note"
+            and last.get("note") == "shutdown"
+            and last.get("clean")
+        )
+    out["draining"] = any(
+        rec.get("type") == "note" and rec.get("note") == "drain"
+        for rec in records
+    ) and not out["clean_shutdown"]
     is_serving = os.path.isdir(os.path.join(root, "requests"))
     key = "requests" if is_serving else "jobs"
     states = {}
@@ -109,6 +130,9 @@ def _state_counts(root: str) -> dict:
 
 def collect_status(root: str) -> dict:
     """One status frame: journal truth + merged metrics + quantiles."""
+    from multigpu_advectiondiffusion_tpu.service.lease import (
+        inspect_lease,
+    )
     from multigpu_advectiondiffusion_tpu.telemetry.metrics import (
         merge_snapshot_dirs,
         snapshot_histogram,
@@ -117,6 +141,10 @@ def collect_status(root: str) -> dict:
     root = os.path.abspath(root)
     status = {"root": root, "wall_time": round(time.time(), 3)}
     status.update(_state_counts(root))
+    status["lease"] = inspect_lease(root)
+    if status["lease"].get("alive"):
+        # the live holder's own flag beats the journal-derived guess
+        status["draining"] = bool(status["lease"].get("draining"))
     merged = merge_snapshot_dirs(os.path.join(root, "metrics"))
     status["metrics"] = {
         "snapshots": merged.get("snapshots", 0),
@@ -165,8 +193,29 @@ def render_text(status: dict) -> List[str]:
     lines = [
         f"tpucfd-status  {status['root']}",
         f"  journal   {status['journal_records']} record(s), "
-        f"{status['torn_lines']} torn line(s)",
+        f"{status['torn_lines']} torn line(s)"
+        + (", clean shutdown" if status.get("clean_shutdown") else ""),
     ]
+    if status.get("schema_error"):
+        lines.append(f"  journal   SCHEMA ERROR: "
+                     f"{status['schema_error']}")
+    lease = status.get("lease") or {}
+    if lease.get("present"):
+        holder = lease.get("holder") or {}
+        hb = lease.get("heartbeat_age_s")
+        line = (f"  lease     pid={holder.get('pid')} "
+                f"role={holder.get('role')} "
+                f"age={lease.get('age_s', 0.0):.1f}s")
+        if hb is not None:
+            line += f" heartbeat={hb:.1f}s ago"
+        if lease.get("stale"):
+            line += "  STALE (holder dead; next start takes over)"
+        elif lease.get("draining"):
+            line += "  draining"
+        lines.append(line)
+    elif status.get("draining"):
+        lines.append("  lease     none  (journal shows a drain in "
+                     "progress)")
     if status["requests"]:
         lines.append(f"  requests  {_fmt_states(status['requests'])}")
     if status["jobs"]:

@@ -356,6 +356,58 @@ def disk_full(targets=("checkpoint", "journal"), times: Optional[int] = None):
             setattr(owner, name, fn)
 
 
+@contextlib.contextmanager
+def stall_dispatch(seconds: float, operand: Optional[str] = None,
+                   value: Optional[float] = None,
+                   times: Optional[int] = None):
+    """Within the context, ``EnsembleSolver.advance_to`` calls sleep
+    ``seconds`` of wall time before dispatching — the hung-dispatch
+    fault (a wedged device, a pathological compile, a collective that
+    never completes) the request server's slice-budget watchdog must
+    bound (ISSUE 20).
+
+    With ``operand``/``value`` set, only batches CARRYING a member
+    whose override ``operand`` is within 1e-9 of ``value`` stall — the
+    poison-member case: the watchdog's bisection must isolate exactly
+    that member and let every cohort without it march clean.
+    ``times=N`` fires only the first N stalls (a transient wedge);
+    ``None`` stalls for the context's whole extent. Yields the
+    fired-count dict like the other injectors."""
+    import time as _time
+
+    from multigpu_advectiondiffusion_tpu.models.ensemble import (
+        EnsembleSolver,
+    )
+
+    fired = {"count": 0}
+    orig = EnsembleSolver.advance_to
+
+    def _carries_poison(solver) -> bool:
+        if operand is None:
+            return True
+        for ov in getattr(solver, "_overrides", []):
+            try:
+                if abs(float(ov.get(operand)) - float(value)) < 1e-9:
+                    return True
+            except (TypeError, ValueError):
+                continue
+        return False
+
+    def stalled(self, *a, **kw):
+        if _carries_poison(self) and (
+            times is None or fired["count"] < times
+        ):
+            fired["count"] += 1
+            _time.sleep(seconds)
+        return orig(self, *a, **kw)
+
+    EnsembleSolver.advance_to = stalled
+    try:
+        yield fired
+    finally:
+        EnsembleSolver.advance_to = orig
+
+
 def torn_ckptd_write(directory: str, mode: str = "uncommitted") -> None:
     """Tear a sharded ``.ckptd`` checkpoint directory the way a
     mid-write crash (or bit-rot) would, so the verification/resume path

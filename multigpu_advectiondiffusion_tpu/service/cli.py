@@ -56,6 +56,10 @@ def configure_serve(p: argparse.ArgumentParser) -> None:
                    help="with --verify: also fail when any submitted "
                         "job never reached done/failed, or the journal "
                         "has torn lines — the sched_gate.sh assertion")
+    p.add_argument("--no-lease", action="store_true",
+                   help="skip the single-writer lease (testing only: "
+                        "two daemons on one root WILL interleave "
+                        "journal appends)")
     p.set_defaults(fn=run_serve)
 
 
@@ -93,19 +97,31 @@ def run_serve(args) -> None:
     from multigpu_advectiondiffusion_tpu.service.daemon import Scheduler
     from multigpu_advectiondiffusion_tpu.service.journal import (
         Journal,
+        JournalSchemaError,
+        schema_stamps,
         verify_records,
+    )
+    from multigpu_advectiondiffusion_tpu.service.lease import (
+        EXIT_LEASE_HELD,
+        LeaseHeldError,
     )
     from multigpu_advectiondiffusion_tpu.service.queue import JobQueue
 
     if args.verify:
         journal_path = os.path.join(args.root, "journal.jsonl")
-        records, torn = Journal.replay(journal_path)
+        try:
+            records, torn = Journal.replay(journal_path)
+        except JournalSchemaError as err:
+            print(f"   PROBLEM: {err}", file=sys.stderr)
+            raise SystemExit(1)
         problems = verify_records(
             records, torn=torn,
             require_complete=args.require_complete,
+            schema_versions=schema_stamps(journal_path),
         )
         # the state table, rebuilt exactly the way recovery would
-        q, report = JobQueue.replay(Journal(journal_path, fsync=False))
+        with Journal(journal_path, fsync=False) as j:
+            q, report = JobQueue.replay(j)
         print(f"-- journal {journal_path}: {len(records)} record(s), "
               f"{torn} torn line(s), {len(q.jobs)} job(s)")
         for rec in sorted(q.jobs.values(), key=lambda r: r.order):
@@ -122,14 +138,19 @@ def run_serve(args) -> None:
         print("-- journal linearizes")
         return None
 
-    sched = Scheduler(
-        args.root,
-        max_concurrent=args.max_concurrent,
-        device_budget=args.devices,
-        mem_budget_bytes=args.mem_budget_mb * (1 << 20),
-        poll_seconds=args.poll,
-        aot_cache=not args.no_aot_cache,
-    )
+    try:
+        sched = Scheduler(
+            args.root,
+            max_concurrent=args.max_concurrent,
+            device_budget=args.devices,
+            mem_budget_bytes=args.mem_budget_mb * (1 << 20),
+            poll_seconds=args.poll,
+            aot_cache=not args.no_aot_cache,
+            lease=not args.no_lease,
+        )
+    except LeaseHeldError as err:
+        print(f"-- serve: {err}", file=sys.stderr)
+        raise SystemExit(EXIT_LEASE_HELD)
     try:
         outcome = sched.serve(until_idle=args.until_idle)
     finally:
@@ -240,6 +261,30 @@ def configure_serve_requests(p: argparse.ArgumentParser) -> None:
                         "request never reached done/failed/shed, or "
                         "the journal has torn lines — the "
                         "serve_gate.sh assertion")
+    p.add_argument("--no-lease", action="store_true",
+                   help="skip the single-writer lease (testing only: "
+                        "two servers on one root WILL double-serve "
+                        "requests and interleave journal appends)")
+    p.add_argument("--drain", action="store_true",
+                   help="no daemon: signal the live lease holder on "
+                        "--root to drain (stop admission, park the "
+                        "in-flight batch at the next slice boundary, "
+                        "journal a clean shutdown, release the lease) "
+                        "and return; exit 1 when no live holder")
+    p.add_argument("--best-effort", action="store_true",
+                   help="do not cancel past-deadline requests at "
+                        "slice boundaries; deadlines stay advisory "
+                        "(ordering + SLO accounting only)")
+    p.add_argument("--hang-budget", type=float, default=None,
+                   metavar="S",
+                   help="fixed wall-clock budget per non-first slice; "
+                        "beyond it the dispatch is declared hung and "
+                        "the batch evacuated (default: adaptive, "
+                        "rolling-median x --hang-multiplier)")
+    p.add_argument("--hang-multiplier", type=float, default=8.0,
+                   metavar="X",
+                   help="adaptive hung-dispatch budget: rolling median "
+                        "slice wall time times X (default 8)")
     p.set_defaults(fn=run_serve_requests)
 
 
@@ -309,7 +354,14 @@ def _kv_floats(items, flag: str) -> dict:
 def run_serve_requests(args) -> None:
     from multigpu_advectiondiffusion_tpu.service.journal import (
         Journal,
+        JournalSchemaError,
+        schema_stamps,
         verify_records,
+    )
+    from multigpu_advectiondiffusion_tpu.service.lease import (
+        EXIT_LEASE_HELD,
+        LeaseHeldError,
+        inspect_lease,
     )
     from multigpu_advectiondiffusion_tpu.service.requests import (
         ALLOWED_REQUEST_TRANSITIONS,
@@ -317,19 +369,42 @@ def run_serve_requests(args) -> None:
         RequestQueue,
     )
 
+    if args.drain:
+        import signal
+
+        info = inspect_lease(args.root)
+        if not info.get("present") or not info.get("alive"):
+            print(f"-- drain: no live lease holder on {args.root}"
+                  + (" (stale lease on disk)" if info.get("stale")
+                     else ""),
+                  file=sys.stderr)
+            raise SystemExit(1)
+        pid = int(info["holder"]["pid"])
+        os.kill(pid, signal.SIGTERM)
+        print(f"-- drain: SIGTERM sent to lease holder pid {pid} "
+              f"(age {info.get('age_s', 0.0):.1f}s); it will stop "
+              f"admission, park in-flight work at the next slice "
+              f"boundary, journal a clean shutdown and release the "
+              f"lease")
+        return None
+
     if args.verify:
         journal_path = os.path.join(args.root, "journal.jsonl")
-        records, torn = Journal.replay(journal_path)
+        try:
+            records, torn = Journal.replay(journal_path)
+        except JournalSchemaError as err:
+            print(f"   PROBLEM: {err}", file=sys.stderr)
+            raise SystemExit(1)
         problems = verify_records(
             records, torn=torn,
             allowed_transitions=ALLOWED_REQUEST_TRANSITIONS,
             terminal_states=REQUEST_TERMINAL_STATES,
             initial_state="received",
             require_complete=args.require_complete,
+            schema_versions=schema_stamps(journal_path),
         )
-        q, report = RequestQueue.replay(
-            Journal(journal_path, fsync=False)
-        )
+        with Journal(journal_path, fsync=False) as j:
+            q, report = RequestQueue.replay(j)
         print(f"-- journal {journal_path}: {len(records)} record(s), "
               f"{torn} torn line(s), {len(q.requests)} request(s)")
         for rec in sorted(q.requests.values(), key=lambda r: r.order):
@@ -349,26 +424,34 @@ def run_serve_requests(args) -> None:
         RequestServer,
     )
 
-    server = RequestServer(
-        args.root,
-        max_batch=args.max_batch,
-        slice_steps=args.slice_steps,
-        queue_bound=args.queue_bound,
-        retry_after_s=args.retry_after,
-        mesh=args.mesh,
-        mem_budget_bytes=args.mem_budget_mb * (1 << 20),
-        checkpoint_every=args.checkpoint_every,
-        socket_path=args.socket,
-        metrics_port=args.metrics_port,
-        metrics_every_s=args.metrics_every,
-        slo_objective=args.slo_objective,
-        pipeline=args.pipeline,
-        pipeline_depth=args.pipeline_depth,
-        donate=args.donate,
-        group_commit_s=args.group_commit_ms / 1000.0,
-        prewarm=args.prewarm,
-        http_port=args.http_port,
-    )
+    try:
+        server = RequestServer(
+            args.root,
+            max_batch=args.max_batch,
+            slice_steps=args.slice_steps,
+            queue_bound=args.queue_bound,
+            retry_after_s=args.retry_after,
+            mesh=args.mesh,
+            mem_budget_bytes=args.mem_budget_mb * (1 << 20),
+            checkpoint_every=args.checkpoint_every,
+            socket_path=args.socket,
+            metrics_port=args.metrics_port,
+            metrics_every_s=args.metrics_every,
+            slo_objective=args.slo_objective,
+            pipeline=args.pipeline,
+            pipeline_depth=args.pipeline_depth,
+            donate=args.donate,
+            group_commit_s=args.group_commit_ms / 1000.0,
+            prewarm=args.prewarm,
+            http_port=args.http_port,
+            lease=not args.no_lease,
+            best_effort=args.best_effort,
+            hang_budget_s=args.hang_budget,
+            hang_multiplier=args.hang_multiplier,
+        )
+    except LeaseHeldError as err:
+        print(f"-- serve-requests: {err}", file=sys.stderr)
+        raise SystemExit(EXIT_LEASE_HELD)
     if server.metrics_port is not None:
         print(f"-- metrics endpoint: "
               f"http://127.0.0.1:{server.metrics_port}/metrics")
@@ -482,4 +565,46 @@ def run_submit(args) -> None:
     path = submit_to_spool(args.root, spec)
     print(f"-- submitted {spec.job_id} (priority {spec.priority}) "
           f"-> {path}")
+    return None
+
+
+def configure_migrate(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--root", required=True, metavar="DIR",
+                   help="service root whose journal.jsonl to upgrade "
+                        "in place (atomic: tempfile + rename) to the "
+                        "current schema version")
+    p.set_defaults(fn=run_migrate)
+
+
+def run_migrate(args) -> None:
+    from multigpu_advectiondiffusion_tpu import telemetry
+    from multigpu_advectiondiffusion_tpu.service.journal import (
+        JournalSchemaError,
+        migrate_journal,
+    )
+
+    path = os.path.join(args.root, "journal.jsonl")
+    try:
+        report = migrate_journal(path)
+    except FileNotFoundError:
+        print(f"-- migrate: no journal at {path}", file=sys.stderr)
+        raise SystemExit(1)
+    except JournalSchemaError as err:
+        print(f"-- migrate: {err}", file=sys.stderr)
+        raise SystemExit(1)
+    telemetry.event(
+        "journal", "migrate",
+        path=path,
+        migrated=report["migrated"],
+        from_schema=report["from_schema"],
+        schema=report["schema"],
+        records=report["records"],
+    )
+    if report["migrated"]:
+        print(f"-- journal {path}: schema {report['from_schema']} -> "
+              f"{report['schema']} ({report['records']} record(s), "
+              f"{report['torn']} torn line(s) preserved)")
+    else:
+        print(f"-- journal {path}: already schema "
+              f"{report['schema']}, nothing to do")
     return None

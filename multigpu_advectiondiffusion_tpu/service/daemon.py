@@ -383,9 +383,23 @@ class Scheduler:
     def __init__(self, root: str, max_concurrent: int = 1,
                  device_budget: int = 1, mem_budget_bytes: int = 0,
                  poll_seconds: float = 0.2, runner=None,
-                 aot_cache: bool = True, fsync: bool = True):
+                 aot_cache: bool = True, fsync: bool = True,
+                 lease: bool = False, heartbeat_s: float = 2.0):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        # single-writer lease (ISSUE 20): same mechanism as the request
+        # server — acquire before any root artifact is opened, so a
+        # second daemon on this root exits naming the holder instead of
+        # interleaving journal appends
+        self.lease = None
+        if lease:
+            from multigpu_advectiondiffusion_tpu.service.lease import (
+                ServiceLease,
+            )
+
+            self.lease = ServiceLease(
+                self.root, role="serve", heartbeat_s=heartbeat_s,
+            ).acquire()
         self.jobs_root = os.path.join(self.root, "jobs")
         os.makedirs(self.jobs_root, exist_ok=True)
         self.aot_dir = (
@@ -406,6 +420,18 @@ class Scheduler:
         self._sink = TelemetrySink(
             os.path.join(self.root, "sched_events.jsonl")
         )
+        if self.lease is not None:
+            self._sink.event(
+                "lease", "acquire", pid=os.getpid(),
+                path=self.lease.path,
+                takeover=self.lease.takeover is not None,
+            )
+            if self.lease.takeover:
+                self._sink.event(
+                    "lease", "takeover", pid=os.getpid(),
+                    prev_pid=self.lease.takeover.get("pid"),
+                    age_s=self.lease.takeover.get("age_s"),
+                )
         self.journal = Journal(
             os.path.join(self.root, "journal.jsonl"), fsync=fsync
         )
@@ -828,6 +854,8 @@ class Scheduler:
         if self.journal.degraded:
             self._sink.event("sched", "journal_degraded",
                              pending=len(self.journal._pending))
+        if self.lease is not None:
+            self.lease.heartbeat()
         self.metrics.gauge("sched_jobs_running").set(len(self._handles))
         self.metrics.gauge("sched_jobs_open").set(
             len(self.queue.open_jobs())
@@ -881,6 +909,11 @@ class Scheduler:
                 if guard.should_stop:
                     stop_reason = f"signal {guard.signum}"
                     self._drain()
+                    # the workers parked through their preemption path:
+                    # a successor starts with zero surprise recovery
+                    self.journal.append("note", note="shutdown",
+                                        clean=True, pid=os.getpid(),
+                                        reason=stop_reason)
                     break
                 if max_seconds and time.monotonic() - t0 > max_seconds:
                     stop_reason = "max_seconds"
@@ -916,4 +949,8 @@ class Scheduler:
     def close(self) -> None:
         self.export_metrics(force=True)
         self.journal.close()
+        if self.lease is not None:
+            self._sink.event("lease", "release", pid=os.getpid())
+            self.lease.release()
+            self.lease = None
         self._sink.close()

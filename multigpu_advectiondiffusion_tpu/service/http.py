@@ -38,6 +38,10 @@ from multigpu_advectiondiffusion_tpu.service.requests import (
 
 _ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
 
+# a RequestSpec is a few hundred bytes of JSON; anything near this is
+# hostile or corrupt, and an unbounded read lets one POST exhaust RAM
+MAX_BODY_BYTES = 1 << 20
+
 
 def _request_paths(root: str, request_id: str) -> Optional[str]:
     """The request's artifact directory, or None for an id that could
@@ -82,11 +86,44 @@ def start_ingest_http(server, port: int) -> Tuple[object, int]:
             self._send(200, body, ctype)
 
         def do_POST(self):  # noqa: N802 — stdlib contract
+            try:
+                self._post()
+            except Exception as err:  # noqa: BLE001 — transport wall:
+                # a handler bug must answer structured JSON, never leak
+                # a traceback to the peer or kill the listener thread
+                try:
+                    self._send_json(500, {
+                        "error": f"{type(err).__name__}"[:300],
+                    })
+                except OSError:
+                    pass
+
+        def _post(self):
             if self.path.split("?")[0] not in ("/requests", "/submit"):
                 self._send_json(404, {"error": "POST /requests"})
                 return
+            if server.draining:
+                self._send_json(503, {
+                    "status": "draining",
+                    "error": "server is draining; resubmit to the "
+                             "successor",
+                    "retry_after_s": server.retry_after_s,
+                })
+                return
             try:
                 length = int(self.headers.get("Content-Length", 0))
+            except (ValueError, TypeError):
+                self._send_json(400, {
+                    "error": "bad Content-Length header",
+                })
+                return
+            if length < 0 or length > MAX_BODY_BYTES:
+                self._send_json(413, {
+                    "error": f"body exceeds {MAX_BODY_BYTES} bytes",
+                    "max_body_bytes": MAX_BODY_BYTES,
+                })
+                return
+            try:
                 payload = json.loads(self.rfile.read(length).decode())
                 if not isinstance(payload, dict):
                     raise ValueError("request body is not a JSON object")
@@ -95,6 +132,8 @@ def start_ingest_http(server, port: int) -> Tuple[object, int]:
                 # ingest journals it first, exactly like file/socket
                 submit_request_to_spool(root, spec)
             except (ValueError, TypeError, KeyError) as err:
+                # UnicodeDecodeError is a ValueError subclass: malformed
+                # UTF-8 lands here too, as a 400 not a traceback
                 sink.event(
                     "serve", "spool_skip", file="<http>",
                     error=f"{type(err).__name__}: {err}"[:200],
@@ -108,11 +147,37 @@ def start_ingest_http(server, port: int) -> Tuple[object, int]:
                 "status": "spooled",
             })
 
+        def do_PUT(self):  # noqa: N802 — stdlib contract
+            self._send_json(405, {"error": "method not allowed"})
+
+        def do_DELETE(self):  # noqa: N802 — stdlib contract
+            self._send_json(405, {"error": "method not allowed"})
+
         def do_GET(self):  # noqa: N802 — stdlib contract
+            try:
+                self._get()
+            except Exception as err:  # noqa: BLE001 — transport wall
+                try:
+                    self._send_json(500, {
+                        "error": f"{type(err).__name__}"[:300],
+                    })
+                except OSError:
+                    pass
+
+        def _get(self):
             path = self.path.split("?")[0]
             if path == "/healthz":
+                lease = None
+                if server.lease is not None:
+                    lease = {
+                        "pid": os.getpid(),
+                        "held": bool(server.lease.held),
+                    }
                 self._send_json(200, {
-                    "status": "ok",
+                    "status": ("draining" if server.draining
+                               else "ok"),
+                    "draining": bool(server.draining),
+                    "lease": lease,
                     "open_requests": len(queue.open_requests()),
                 })
                 return

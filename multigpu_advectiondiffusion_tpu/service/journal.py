@@ -34,6 +34,18 @@ syscall-identical to the pre-group-commit journal.
 Records are dicts with an envelope of ``seq`` (strictly increasing),
 ``wall`` (epoch seconds), ``type`` (``submit``/``state``/``note``) and
 the caller's fields; the ``crc`` field commits the rest.
+
+**Schema versioning** (ISSUE 20): a fresh journal's first line is a
+sealed header record ``{"seq": 0, "type": "note", "note": "schema",
+"schema": N}`` written outside the user sequence (seq 0, no group-
+commit accounting), so a format change can never silently mis-replay an
+old root. :meth:`Journal.replay` strips headers from the returned
+records (consumers see only state-machine records) and raises
+:class:`JournalSchemaError` on a version newer than this code —
+refusal, not corruption. Pre-versioning roots are *v0* (headerless):
+they keep replaying as before, and :func:`migrate_journal` upgrades
+them in place atomically (header prepended, every existing line
+byte-verbatim, so the replayed state machine is identical).
 """
 
 from __future__ import annotations
@@ -41,10 +53,32 @@ from __future__ import annotations
 import binascii
 import json
 import os
+import tempfile
 import time
 from typing import List, Optional, Tuple
 
 JOURNAL_SCHEMA = 1
+
+
+class JournalSchemaError(RuntimeError):
+    """The journal was written by a NEWER schema than this code reads.
+
+    Raised loudly instead of mis-replaying: a future format may encode
+    state this reader would silently drop."""
+
+    def __init__(self, path: str, found: int, supported: int):
+        self.path = path
+        self.found = found
+        self.supported = supported
+        super().__init__(
+            f"journal {path} has schema {found}, newer than the "
+            f"supported {supported} — refusing to replay (upgrade the "
+            f"code, or serve this root with the version that wrote it)"
+        )
+
+
+def _is_schema_header(rec: dict) -> bool:
+    return rec.get("type") == "note" and rec.get("note") == "schema"
 
 
 def _crc(body: str) -> str:
@@ -102,9 +136,38 @@ class Journal:
         if parent:
             os.makedirs(parent, exist_ok=True)
         # continue the sequence a previous incarnation committed — the
-        # replay cost is paid once, at open
+        # replay cost is paid once, at open (raises JournalSchemaError
+        # on a future-version file: refuse before writing a byte)
+        existing = os.path.exists(path) and os.path.getsize(path) > 0
         records, _ = self.replay(path)
         self._seq = max((r.get("seq", 0) for r in records), default=0)
+        if existing:
+            self.schema = journal_schema(path) or 0
+        else:
+            self.schema = JOURNAL_SCHEMA
+            self._stamp_header()
+
+    def _stamp_header(self) -> None:
+        """Write the seq-0 schema header. Outside the user sequence and
+        the group-commit accounting: readers strip it, acks never wait
+        on it, and the seq counter stays a pure record count."""
+        rec = {
+            "seq": 0,
+            "wall": round(time.time(), 6),
+            "type": "note",
+            "note": "schema",
+            "schema": JOURNAL_SCHEMA,
+        }
+        line = _seal(rec)
+        try:
+            self._write(line + "\n")
+            if self._fsync and self.group_commit_s > 0.0:
+                os.fsync(self._f.fileno())
+        except OSError:
+            # same containment as any append: park it, heal later
+            self._close_handle()
+            self._pending.append(line)
+            self.degraded = True
 
     # ------------------------------------------------------------------ #
     def append(self, rtype: str, **fields) -> dict:
@@ -255,11 +318,18 @@ class Journal:
 
     # ------------------------------------------------------------------ #
     @staticmethod
-    def replay(path: str) -> Tuple[List[dict], int]:
+    def replay(path: str,
+               include_schema: bool = False) -> Tuple[List[dict], int]:
         """Read every committed record, tolerating torn lines. Returns
         ``(records, torn_count)`` — torn means unparseable JSON, a
         non-dict line, or a CRC that no longer commits its content
-        (a mid-write crash or bit rot)."""
+        (a mid-write crash or bit rot).
+
+        Schema headers are validated (a version newer than
+        ``JOURNAL_SCHEMA`` raises :class:`JournalSchemaError` — loud
+        refusal, never a silent mis-replay) and stripped from the
+        returned records unless ``include_schema`` — they are format
+        metadata, not state-machine history."""
         if not os.path.exists(path):
             return [], 0
         records: List[dict] = []
@@ -277,8 +347,112 @@ class Journal:
                 if not isinstance(rec, dict) or not _check(rec):
                     torn += 1
                     continue
+                if _is_schema_header(rec):
+                    found = rec.get("schema")
+                    if isinstance(found, int) and found > JOURNAL_SCHEMA:
+                        raise JournalSchemaError(
+                            path, found, JOURNAL_SCHEMA
+                        )
+                    if not include_schema:
+                        continue
                 records.append(rec)
         return records, torn
+
+
+def journal_schema(path: str) -> Optional[int]:
+    """The schema version a journal file was written under: the first
+    committed record's header value, ``0`` for a headerless (v0) file
+    with content, ``None`` for a missing/empty/all-torn file. Never
+    raises — the refusal decision belongs to :meth:`Journal.replay`."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or not _check(rec):
+                continue
+            if _is_schema_header(rec):
+                found = rec.get("schema")
+                return found if isinstance(found, int) else 0
+            return 0
+    return None
+
+
+def schema_stamps(path: str) -> List[int]:
+    """Every schema-header value in file order (a migrated-then-
+    appended history can carry several) — feed to
+    :func:`verify_records` for the monotonicity check."""
+    stamps: List[int] = []
+    if not os.path.exists(path):
+        return stamps
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or not _check(rec):
+                continue
+            if _is_schema_header(rec):
+                found = rec.get("schema")
+                stamps.append(found if isinstance(found, int) else -1)
+    return stamps
+
+
+def migrate_journal(path: str) -> dict:
+    """Upgrade a v0 (headerless) journal to the current schema in
+    place, atomically: the header line is prepended and every existing
+    line rides byte-verbatim (CRCs untouched), so replay produces the
+    identical state machine. Idempotent — an already-current journal is
+    left alone. Raises :class:`JournalSchemaError` on a future version
+    and ``FileNotFoundError`` on a missing file."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    found = journal_schema(path)
+    if found is not None and found > JOURNAL_SCHEMA:
+        raise JournalSchemaError(path, found, JOURNAL_SCHEMA)
+    records, torn = Journal.replay(path)
+    if found == JOURNAL_SCHEMA:
+        return {"migrated": False, "from_schema": found,
+                "schema": JOURNAL_SCHEMA, "records": len(records),
+                "torn": torn}
+    header = _seal({
+        "seq": 0,
+        "wall": round(time.time(), 6),
+        "type": "note",
+        "note": "schema",
+        "schema": JOURNAL_SCHEMA,
+    })
+    with open(path) as f:
+        body = f.read()
+    parent = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=parent, prefix=".journal_migrate_", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(header + "\n" + body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return {"migrated": True, "from_schema": found or 0,
+            "schema": JOURNAL_SCHEMA, "records": len(records),
+            "torn": torn}
 
 
 def verify_records(records: List[dict],
@@ -286,7 +460,8 @@ def verify_records(records: List[dict],
                    allowed_transitions=None,
                    require_complete: bool = False,
                    terminal_states=None,
-                   initial_state: str = "queued") -> List[str]:
+                   initial_state: str = "queued",
+                   schema_versions=None) -> List[str]:
     """Structural linearization check over replayed records: sequence
     numbers strictly increase, every transition names a submitted job,
     every (from, to) pair is legal, and — with ``require_complete`` —
@@ -296,7 +471,10 @@ def verify_records(records: List[dict],
     The defaults check the job scheduler's table; the request server
     passes its own ``allowed_transitions``/``terminal_states``/
     ``initial_state`` (``service/requests.py``) — one verifier, two
-    state machines."""
+    state machines. ``schema_versions`` (from :func:`schema_stamps`)
+    adds the version check: stamps must be known (≤ JOURNAL_SCHEMA)
+    and non-decreasing in file order — a regressed stamp means an
+    older writer appended to a migrated root."""
     from multigpu_advectiondiffusion_tpu.service.queue import (
         ALLOWED_TRANSITIONS,
         TERMINAL_STATES,
@@ -306,6 +484,23 @@ def verify_records(records: List[dict],
     terminal = (TERMINAL_STATES if terminal_states is None
                 else frozenset(terminal_states))
     problems: List[str] = []
+    if schema_versions:
+        last_v: Optional[int] = None
+        for v in schema_versions:
+            if not isinstance(v, int) or v < 0:
+                problems.append(f"malformed schema stamp {v!r}")
+                continue
+            if v > JOURNAL_SCHEMA:
+                problems.append(
+                    f"schema stamp {v} is newer than the supported "
+                    f"{JOURNAL_SCHEMA}"
+                )
+            if last_v is not None and v < last_v:
+                problems.append(
+                    f"schema stamp regressed {last_v} -> {v} (an "
+                    f"older writer appended to a migrated journal)"
+                )
+            last_v = v
     last_seq: Optional[int] = None
     state: dict = {}
     for rec in records:

@@ -1,0 +1,282 @@
+"""Single-writer lease for a service root.
+
+Exactly one server incarnation (``serve-requests`` or the ``serve``
+scheduler) may write a root's journal at a time: two writers interleave
+appends at stale sequence numbers and double-serve requests — the
+failure class the crash-safety layer cannot detect until replay. The
+lease makes the exclusion explicit and *operable*:
+
+* the mutex is an ``fcntl.flock`` on ``<root>/lease.lock``, held for
+  the owner's lifetime — the kernel releases it when the holder dies,
+  so a crashed holder's lease is reclaimed with zero timeout tuning;
+* ``<root>/lease.json`` is advisory metadata (pid, role, cmdline,
+  acquire/heartbeat walls, drain state) written atomically for
+  ``tpucfd-status`` / ``GET /healthz``; stale metadata left by a crash
+  is classified with the pid+cmdline guard (the scheduler's adoption
+  discipline) before takeover is reported;
+* a losing acquire raises :class:`LeaseHeldError` naming the holder —
+  the CLI maps it to ``EXIT_LEASE_HELD`` (78) with a structured line,
+  never a traceback, and never touches the journal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Optional
+
+try:  # pragma: no cover - absent only on non-POSIX platforms
+    import fcntl
+except ImportError:  # pragma: no cover
+    fcntl = None  # type: ignore[assignment]
+
+#: sysexits-adjacent, after EXIT_PREEMPTED=75 / EXIT_RANK_FAILURE=76 /
+#: EXIT_SDC=77: the root already has a live writer.
+EXIT_LEASE_HELD = 78
+
+LEASE_FILE = "lease.json"
+LOCK_FILE = "lease.lock"
+
+
+class LeaseHeldError(RuntimeError):
+    """Another live incarnation holds the root's writer lease."""
+
+    def __init__(self, path: str, holder: dict, age_s: float):
+        self.path = path
+        self.holder = dict(holder or {})
+        self.age_s = float(age_s)
+        pid = self.holder.get("pid")
+        super().__init__(
+            f"lease held by pid {pid if pid is not None else '?'}, "
+            f"age {self.age_s:.1f}s ({path})"
+        )
+
+
+def _pid_alive(pid) -> bool:
+    if not isinstance(pid, int) or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def _pid_cmdline(pid) -> Optional[str]:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode(
+                "utf-8", "replace"
+            )
+    except OSError:
+        return None
+
+
+def _holder_matches(holder: dict, root: str) -> bool:
+    """pid+cmdline guard: does the recorded pid still look like the
+    process that took the lease?  ``True`` on any doubt (no /proc,
+    permission) — adoption errs toward *not* declaring staleness."""
+    pid = holder.get("pid")
+    if not _pid_alive(pid):
+        return False
+    cmd = _pid_cmdline(pid)
+    if cmd is None:  # can't inspect: treat as live (be conservative)
+        return True
+    want = holder.get("cmdline")
+    if want:
+        return want.strip() == cmd.strip()
+    return os.path.basename(root) in cmd or root in cmd
+
+
+def _read_meta(path: str) -> Optional[dict]:
+    try:
+        with open(path, "r") as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return meta if isinstance(meta, dict) else None
+
+
+class ServiceLease:
+    """Hold the single-writer lease on ``root`` for this process."""
+
+    def __init__(self, root: str, role: str = "serve",
+                 heartbeat_s: float = 2.0):
+        self.root = os.path.abspath(root)
+        self.path = os.path.join(self.root, LEASE_FILE)
+        self.lock_path = os.path.join(self.root, LOCK_FILE)
+        self.role = role
+        self.heartbeat_s = float(heartbeat_s)
+        self.takeover: Optional[dict] = None
+        self.acquired_wall: Optional[float] = None
+        self._fd: Optional[int] = None
+        self._last_beat = 0.0
+        self._draining = False
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def acquire(self) -> "ServiceLease":
+        os.makedirs(self.root, exist_ok=True)
+        fd = os.open(self.lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            if fcntl is not None:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            info = inspect_lease(self.root)
+            raise LeaseHeldError(
+                self.path, info.get("holder") or {},
+                info.get("age_s") or 0.0,
+            ) from None
+        if fcntl is None:
+            # no flock on this platform: fall back to the metadata
+            # pid guard alone (weaker, but still refuses live holders)
+            stale = _read_meta(self.path)
+            if stale and stale.get("pid") != os.getpid() and (
+                _holder_matches(stale, self.root)
+            ):
+                os.close(fd)
+                now = time.time()
+                raise LeaseHeldError(
+                    self.path, stale,
+                    now - float(stale.get("heartbeat")
+                                or stale.get("acquired") or now),
+                )
+        self._fd = fd
+        now = time.time()
+        stale = _read_meta(self.path)
+        if stale and stale.get("pid") not in (None, os.getpid()):
+            # the flock was free, yet metadata survives: the previous
+            # holder died without releasing.  Record the takeover.
+            self.takeover = {
+                "pid": stale.get("pid"),
+                "role": stale.get("role"),
+                "age_s": round(now - float(
+                    stale.get("heartbeat")
+                    or stale.get("acquired") or now), 3),
+            }
+        self.acquired_wall = now
+        self._write_meta(now)
+        return self
+
+    def _write_meta(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        meta = {
+            "pid": os.getpid(),
+            "role": self.role,
+            "root": self.root,
+            "cmdline": _pid_cmdline(os.getpid()),
+            "acquired": round(self.acquired_wall or now, 6),
+            "heartbeat": round(now, 6),
+            "draining": bool(self._draining),
+        }
+        fd, tmp = tempfile.mkstemp(
+            dir=self.root, prefix=".lease_", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._last_beat = time.monotonic()
+
+    def heartbeat(self, draining: bool = False,
+                  force: bool = False) -> bool:
+        """Refresh the advisory metadata; throttled to
+        ``heartbeat_s`` unless the drain state flips or ``force``."""
+        if self._fd is None:
+            return False
+        flipped = bool(draining) != self._draining
+        self._draining = bool(draining)
+        if not force and not flipped and (
+            time.monotonic() - self._last_beat < self.heartbeat_s
+        ):
+            return False
+        self._write_meta()
+        return True
+
+    def release(self) -> None:
+        if self._fd is None:
+            return
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        try:
+            if fcntl is not None:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        self._fd = None
+
+    def __enter__(self) -> "ServiceLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def inspect_lease(root: str) -> dict:
+    """Read-only lease view for status/healthz: never takes the lock.
+
+    ``locked`` is authoritative liveness (a non-blocking flock probe);
+    ``stale`` flags leftover metadata whose recorded pid no longer
+    passes the pid+cmdline guard — the root a crashed holder left
+    behind, reclaimable by the next acquire."""
+    root = os.path.abspath(root)
+    path = os.path.join(root, LEASE_FILE)
+    meta = _read_meta(path)
+    locked = False
+    lock_path = os.path.join(root, LOCK_FILE)
+    if fcntl is not None and os.path.exists(lock_path):
+        try:
+            fd = os.open(lock_path, os.O_RDWR)
+        except OSError:
+            fd = None
+        if fd is not None:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            except OSError:
+                locked = True
+            finally:
+                os.close(fd)
+    out = {
+        "present": meta is not None,
+        "locked": locked,
+        "holder": meta,
+        "age_s": None,
+        "heartbeat_age_s": None,
+        "alive": False,
+        "stale": False,
+        "draining": False,
+    }
+    if meta is None:
+        return out
+    now = time.time()
+    acquired = meta.get("acquired")
+    beat = meta.get("heartbeat") or acquired
+    if isinstance(acquired, (int, float)):
+        out["age_s"] = round(now - float(acquired), 3)
+    if isinstance(beat, (int, float)):
+        out["heartbeat_age_s"] = round(now - float(beat), 3)
+    out["draining"] = bool(meta.get("draining"))
+    out["alive"] = locked or _holder_matches(meta, root)
+    out["stale"] = not out["alive"]
+    return out

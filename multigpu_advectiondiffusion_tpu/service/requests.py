@@ -225,6 +225,15 @@ class RequestRecord:
             return None
         return self.admitted_wall + float(self.spec.deadline_s)
 
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when the request's deadline has passed — the server's
+        slice-boundary cancellation predicate (ISSUE 20). Requests
+        without a deadline never expire."""
+        wall = self.deadline_wall()
+        if wall is None:
+            return False
+        return (time.time() if now is None else float(now)) > wall
+
 
 class RequestQueue:
     """The journal-backed request queue: every mutation journals first

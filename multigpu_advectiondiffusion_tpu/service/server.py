@@ -39,8 +39,10 @@ from __future__ import annotations
 import json
 import math
 import os
+import statistics
 import time
 import uuid
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -154,9 +156,28 @@ class RequestServer:
                  donate: Optional[bool] = None,
                  group_commit_s: float = 0.0,
                  prewarm: bool = True,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None,
+                 lease: bool = False,
+                 heartbeat_s: float = 2.0,
+                 best_effort: bool = False,
+                 hang_multiplier: float = 8.0,
+                 hang_min_history: int = 5,
+                 hang_budget_s: Optional[float] = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        # single-writer lease (ISSUE 20): acquire BEFORE any other root
+        # artifact is opened — a refused incarnation must exit without
+        # writing a byte of the holder's journal
+        self.lease = None
+        if lease:
+            from multigpu_advectiondiffusion_tpu.service.lease import (
+                ServiceLease,
+            )
+
+            self.lease = ServiceLease(
+                self.root, role="serve-requests",
+                heartbeat_s=heartbeat_s,
+            ).acquire()
         os.makedirs(os.path.join(self.root, "requests"), exist_ok=True)
         from multigpu_advectiondiffusion_tpu.telemetry.metrics import (
             DEFAULT_SLO_WINDOWS,
@@ -173,11 +194,24 @@ class RequestServer:
         self._sink = TelemetrySink(
             os.path.join(self.root, "serve_events.jsonl")
         )
+        if self.lease is not None:
+            self._sink.event(
+                "lease", "acquire", pid=os.getpid(),
+                path=self.lease.path,
+                takeover=self.lease.takeover is not None,
+            )
         # fleet metrics (ISSUE 18): one snapshot dir PER INCARNATION —
         # a restarted server must not overwrite the dead life's
         # counters, because the merged union across incarnations is
         # what reconciles exactly-once against the replayed journal
         self.metrics = MetricsRegistry(proc=f"server-{os.getpid()}")
+        if self.lease is not None and self.lease.takeover:
+            self._sink.event(
+                "lease", "takeover", pid=os.getpid(),
+                prev_pid=self.lease.takeover.get("pid"),
+                age_s=self.lease.takeover.get("age_s"),
+            )
+            self.metrics.counter("serve_lease_takeovers_total").inc()
         self.metrics_dir = os.path.join(
             self.root, "metrics", self.metrics.proc
         )
@@ -227,6 +261,26 @@ class RequestServer:
         self._templates: Dict[str, dict] = {}
         self._recovered = False
         self._stalled_ticks = 0
+        # graceful drain (ISSUE 20): the signal handler only sets the
+        # request flag — journal writes from a handler could interleave
+        # with an append already on the stack; tick() acts on it
+        self.draining = False
+        self._drain_requested: Optional[str] = None
+        # deadline enforcement: past-deadline members are cancelled at
+        # slice boundaries unless the operator opted out
+        self.best_effort = bool(best_effort)
+        # hung-dispatch watchdog: wall-clock budget from measured slice
+        # history (rolling median × multiplier, the bench outlier
+        # discipline); an explicit hang_budget_s overrides. Cohort
+        # labels drive the poison-member bisection across re-batches.
+        self.hang_multiplier = float(hang_multiplier)
+        self.hang_min_history = max(1, int(hang_min_history))
+        self.hang_budget_s = (
+            float(hang_budget_s) if hang_budget_s else None
+        )
+        self._slice_history: deque = deque(maxlen=64)
+        self._hang_cohort: Dict[str, str] = {}
+        self._hang_strikes: Dict[str, int] = {}
         self._sock = None
         self.socket_path = socket_path
         if socket_path:
@@ -471,6 +525,15 @@ class RequestServer:
         if self._recovered:
             return {}
         self._recovered = True
+        # a clean handover leaves the shutdown marker as the LAST
+        # record: the predecessor drained (parked everything to
+        # requeued), so this incarnation starts with zero requeue work
+        records, _ = Journal.replay(self.journal.path)
+        clean = bool(records) and (
+            records[-1].get("type") == "note"
+            and records[-1].get("note") == "shutdown"
+            and bool(records[-1].get("clean"))
+        )
         requeued = failed = 0
         for rec in list(self.queue.in_flight()):
             rid = rec.request_id
@@ -491,6 +554,7 @@ class RequestServer:
             "requests": len(self.queue.requests),
             "requeued": requeued,
             "failed": failed,
+            "clean_shutdown": clean,
         }
         self._sink.event("serve", "recover", **report)
         return report
@@ -499,6 +563,13 @@ class RequestServer:
     # Ingest + admission
     # ------------------------------------------------------------------ #
     def _ingest(self) -> None:
+        if self.draining:
+            # admission is closed: the socket stays unread and the
+            # spool — a durable mailbox — is left intact for the
+            # successor; HTTP answers with the structured draining
+            # verdict. Nothing submitted from here on is lost, it is
+            # simply the next incarnation's work.
+            return
         self._drain_socket()
 
         def on_skip(name, reason):
@@ -691,7 +762,12 @@ class RequestServer:
             return None
         lead = cands[0]
         key = coalesce_key(lead.spec)
-        group = [r for r in cands if coalesce_key(r.spec) == key]
+        # hang-bisection cohorts re-batch separately: a suspect set
+        # split by the watchdog must not remix, or repeated hangs
+        # could never isolate the poison member
+        cohort = self._hang_cohort.get(lead.request_id)
+        group = [r for r in cands if coalesce_key(r.spec) == key
+                 and self._hang_cohort.get(r.request_id) == cohort]
         cap = self._batch_cap(lead.spec)
         if cap < self.max_batch:
             for rec in group[cap:]:
@@ -830,6 +906,7 @@ class RequestServer:
         self._sink.event("req", "failed", job=rid, reason=reason[:200],
                          **extra)
         self.metrics.counter("serve_requests_failed_total").inc()
+        self._hang_cohort.pop(rid, None)
         self._observe_deadline(rec, seconds=None, ok=False)
 
     def _finish(self, rec: RequestRecord, b: _Batch, lane: int,
@@ -898,6 +975,8 @@ class RequestServer:
             self.metrics.histogram(
                 "serve_request_latency_seconds"
             ).observe(seconds)
+        self._hang_cohort.pop(rid, None)
+        self._hang_strikes.pop(rid, None)
         self._observe_deadline(rec, seconds=seconds, ok=True)
         try:
             os.remove(self._ckpt_path(rid))
@@ -952,6 +1031,174 @@ class RequestServer:
         self._batch = None
 
     # ------------------------------------------------------------------ #
+    # Graceful drain (ISSUE 20)
+    # ------------------------------------------------------------------ #
+    def request_drain(self, reason: str = "signal") -> None:
+        """Stop admission and hand over: live transports refuse with a
+        structured draining verdict, the spool (a durable mailbox) is
+        left untouched for the successor, and the in-flight batch parks
+        at its next slice boundary. The loop then journals the
+        ``shutdown clean=true`` marker and releases the lease."""
+        if self.draining:
+            return
+        self.draining = True
+        self._sink.event("drain", "start", reason=str(reason),
+                         open=len(self.queue.open_requests()))
+        self.journal.append("note", note="drain", reason=str(reason))
+        if self.lease is not None:
+            self.lease.heartbeat(draining=True, force=True)
+
+    def _finish_drain(self) -> None:
+        """The handover epilogue: every ack flushed behind its fsync,
+        the clean-shutdown marker as the journal's LAST record, the
+        lease released so the successor's acquire wins immediately."""
+        self._flush_acks()
+        self.journal.append("note", note="shutdown", clean=True,
+                            pid=os.getpid())
+        self.journal.commit()
+        self._sink.event("drain", "done", clean=True,
+                         open=len(self.queue.open_requests()))
+        if self.lease is not None:
+            self._sink.event("lease", "release", pid=os.getpid())
+            self.lease.release()
+            self.lease = None
+
+    # ------------------------------------------------------------------ #
+    # Hung-dispatch watchdog + deadline enforcement (ISSUE 20)
+    # ------------------------------------------------------------------ #
+    #: adaptive-budget floor: with millisecond slices, median × the
+    #: multiplier is blown by any scheduling hiccup on a loaded host —
+    #: a "hang" shorter than this is not worth an evacuation. An
+    #: explicit ``hang_budget_s`` is exempt (tests pin tighter ones).
+    HANG_BUDGET_FLOOR_S = 1.0
+
+    def _slice_budget(self) -> Optional[float]:
+        """Wall-clock budget for one slice: the rolling median of
+        measured slice history × ``hang_multiplier`` (the bench outlier
+        discipline — a hang is an outlier against what this server
+        actually measured, not a hardcoded timeout). ``hang_budget_s``
+        overrides; None until enough history exists."""
+        if self.hang_budget_s is not None:
+            return float(self.hang_budget_s)
+        if len(self._slice_history) < self.hang_min_history:
+            return None
+        med = statistics.median(self._slice_history)
+        return max(med * self.hang_multiplier,
+                   self.HANG_BUDGET_FLOOR_S)
+
+    def _handle_hung(self, b: _Batch, elapsed: float,
+                     budget: float) -> None:
+        """Budget blown: journal the hang, evacuate the batch from the
+        last per-member slice checkpoints (the hung estate is suspect —
+        members without a checkpoint resume from their ICs, bit-exact
+        either way by slicing invariance), and bisect: the suspects
+        split into two cohorts that re-batch separately, so repeated
+        hangs converge on the poison member, which is quarantined with
+        forensics once it hangs alone."""
+        active = b.active()
+        rids = [r.request_id for r in active]
+        hung_slice = b.slices + 1
+        self.journal.append(
+            "note", note="dispatch_hung", batch=b.batch_id,
+            slice=hung_slice, elapsed_s=round(elapsed, 6),
+            budget_s=round(budget, 6), jobs=rids,
+        )
+        self._sink.event(
+            "dispatch", "hung", batch=b.batch_id, slice=hung_slice,
+            elapsed_s=round(elapsed, 6), budget_s=round(budget, 6),
+            jobs=rids,
+        )
+        self.metrics.counter("serve_dispatch_hung_total").inc()
+        for rec in active:
+            self._hang_strikes[rec.request_id] = (
+                self._hang_strikes.get(rec.request_id, 0) + 1
+            )
+        if len(active) == 1:
+            rec = active[0]
+            if self._hang_strikes.get(rec.request_id, 0) >= 2:
+                # bisection converged (a member that hung in company
+                # now hangs alone), or a solo batch hung twice:
+                # quarantine with forensics
+                self._fail(rec, reason="dispatch_hung", forensics={
+                    "type": "DispatchHung",
+                    "batch": b.batch_id,
+                    "slice": hung_slice,
+                    "elapsed_s": round(elapsed, 6),
+                    "budget_s": round(budget, 6),
+                    "strikes": self._hang_strikes.get(
+                        rec.request_id, 1),
+                    "quarantined": True,
+                })
+            else:
+                # first strike for a solo batch: a transient stall (a
+                # loaded host, a GC pause) gets one retry from its
+                # checkpoint; a genuinely wedged member hangs again
+                # and is quarantined on the repeat
+                ckpt = self._ckpt_path(rec.request_id)
+                self._transition(
+                    rec.request_id, "requeued",
+                    reason="dispatch_hung",
+                    checkpoint=ckpt if os.path.exists(ckpt) else None,
+                )
+        else:
+            half = (len(active) + 1) // 2
+            for idx, rec in enumerate(active):
+                self._hang_cohort[rec.request_id] = (
+                    f"{b.batch_id}:{'a' if idx < half else 'b'}"
+                )
+            for rec in active:
+                ckpt = self._ckpt_path(rec.request_id)
+                self._transition(
+                    rec.request_id, "requeued", reason="dispatch_hung",
+                    checkpoint=ckpt if os.path.exists(ckpt) else None,
+                )
+        b.inflight.clear()
+        self._observe_batch_idle(b)
+        self._batch = None
+
+    def _enforce_deadlines(self, b: _Batch, t_np, it_np) -> int:
+        """Cancel past-deadline running members at the slice boundary:
+        the member's lane freezes (te clamps to its current t), the
+        request fails with partial-progress forensics, and the rest of
+        the batch marches on unperturbed. Runs AFTER the finished scan,
+        so a member that both finished and expired prefers done."""
+        if self.best_effort:
+            return 0
+        now = time.time()
+        cancelled = 0
+        for i, rec in enumerate(b.reqs):
+            if rec is None or rec.state != "running":
+                continue
+            if not rec.expired(now):
+                continue
+            elapsed = (now - rec.admitted_wall
+                       if rec.admitted_wall else None)
+            self._sink.event(
+                "req", "deadline_cancel", job=rec.request_id,
+                deadline_s=rec.spec.deadline_s,
+                elapsed_s=(round(elapsed, 6)
+                           if elapsed is not None else None),
+            )
+            self.metrics.counter(
+                "serve_deadline_cancelled_total"
+            ).inc()
+            self._fail(rec, reason="deadline_exceeded", forensics={
+                "type": "DeadlineExceeded",
+                "deadline_s": rec.spec.deadline_s,
+                "elapsed_s": elapsed,
+                "admitted_wall": rec.admitted_wall,
+                "t": float(t_np[i]),
+                "it": int(it_np[i]),
+                "slices": b.slices,
+                "batch": b.batch_id,
+                "member": i,
+            })
+            # freeze the lane: te <= t stops the engine marching it
+            b.te[i] = float(t_np[i])
+            cancelled += 1
+        return cancelled
+
+    # ------------------------------------------------------------------ #
     # The slice loop
     # ------------------------------------------------------------------ #
     def _fail_diverged(self, b: _Batch, err) -> List[str]:
@@ -993,9 +1240,13 @@ class RequestServer:
                    estate=estate if self.donate else None)
 
     def _joiners(self, b: _Batch) -> int:
+        lead = next((r for r in b.reqs if r is not None), None)
+        cohort = (self._hang_cohort.get(lead.request_id)
+                  if lead is not None else None)
         return sum(
             1 for r in self.queue.batchable()
             if coalesce_key(r.spec) == b.key
+            and self._hang_cohort.get(r.request_id) == cohort
         )
 
     def _preempting(self, b: _Batch) -> Optional[RequestRecord]:
@@ -1020,6 +1271,8 @@ class RequestServer:
 
     def _tick_batch(self) -> bool:
         if self._batch is None:
+            if self.draining:
+                return False  # no new work during a drain
             self._batch = self._form_batch()
             if self._batch is None:
                 return False
@@ -1050,6 +1303,16 @@ class RequestServer:
         ready = time.monotonic()
         b.busy_s += max(0.0, ready - max(t0, b.last_ready))
         b.last_ready = ready
+        # hung-dispatch watchdog: the batch's first slice carries the
+        # compile and is exempt (and unmeasured) — the PR 6 outlier
+        # discipline applied to wall clocks
+        elapsed = ready - t0
+        if b.slices > 0:
+            budget = self._slice_budget()
+            if budget is not None and elapsed > budget:
+                self._handle_hung(b, elapsed, budget)
+                return True
+            self._slice_history.append(elapsed)
         prev_it = b.prev_it
         b.estate = estate
         b.slices += 1
@@ -1072,6 +1335,7 @@ class RequestServer:
                 done += 1
             elif b.slices % self.checkpoint_every == 0:
                 self._save_member_ckpt(rec, estate.member(i))
+        self._enforce_deadlines(b, t_np, it_np)
         active = len(b.active())
         slice_seconds = round(time.monotonic() - t0, 6)
         occupancy = round(active / max(1, len(b.reqs)), 4)
@@ -1187,6 +1451,16 @@ class RequestServer:
             0.0, ready - max(entry["dispatched"], b.last_ready)
         )
         b.last_ready = ready
+        # hung-dispatch watchdog (pipelined): elapsed is dispatch ->
+        # retirement of THIS slice; slice 1 carries the compile and is
+        # exempt, like the synchronous path
+        elapsed = ready - entry["dispatched"]
+        if entry["slice_no"] > 1:
+            budget = self._slice_budget()
+            if budget is not None and elapsed > budget:
+                self._handle_hung(b, elapsed, budget)
+                return True
+            self._slice_history.append(elapsed)
         b.slices += 1
         b.prev_it = it_np.copy()
         finished = []
@@ -1230,6 +1504,7 @@ class RequestServer:
                 slice=b.slices, lanes=len(finished),
                 wait_seconds=round(publish_wait, 6),
             )
+        self._enforce_deadlines(b, t_np, it_np)
         active = len(b.active())
         if (
             active > 0
@@ -1382,13 +1657,27 @@ class RequestServer:
     # ------------------------------------------------------------------ #
     def tick(self) -> dict:
         self.recover()
+        if self._drain_requested and not self.draining:
+            self.request_drain(self._drain_requested)
         self._ingest()
         progressed = self._tick_batch()
+        if self.draining and self._batch is not None:
+            # park at this slice boundary: members checkpoint and
+            # requeue, so the successor resumes them with zero
+            # crash-recovery work
+            b = self._batch
+            parked = len(b.active())
+            self._park(b, reason="drain")
+            self._sink.event("drain", "parked", batch=b.batch_id,
+                             members=parked)
+            self.metrics.counter("serve_drain_parked_total").inc()
         # host-side work that overlaps the in-flight slices: prewarm
         # the likely next executable, then the group-commit barrier
         # that releases this tick's acks
         self._maybe_prewarm()
         self._flush_acks()
+        if self.lease is not None:
+            self.lease.heartbeat(draining=self.draining)
         open_count = len(self.queue.open_requests())
         self.metrics.gauge("serve_queue_depth").set(open_count)
         self.slo.evaluate()  # time alone can clear (or breach) windows
@@ -1420,35 +1709,61 @@ class RequestServer:
             donate=self.donate,
             group_commit_s=self.journal.group_commit_s,
         )
+        # SIGTERM/SIGINT ask for a graceful drain; the handler only
+        # sets a flag (journal appends from a handler frame could
+        # interleave with one already on the stack)
+        import signal as _signal
+
+        def _on_signal(signum, frame):  # noqa: ARG001
+            self._drain_requested = f"signal {signum}"
+
+        prev_handlers = {}
+        try:
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                prev_handlers[sig] = _signal.signal(sig, _on_signal)
+        except ValueError:  # not the main thread: signals stay global
+            prev_handlers = {}
         t0 = time.monotonic()
         ticks = 0
         reason = "idle"
-        while True:
-            out = self.tick()
-            ticks += 1
-            if not out["progressed"]:
-                self._stalled_ticks += 1
-            else:
-                self._stalled_ticks = 0
-            if max_ticks is not None and ticks >= max_ticks:
-                reason = "ticks"
-                break
-            if max_seconds is not None and (
-                time.monotonic() - t0 > max_seconds
-            ):
-                reason = "timeout"
-                break
-            if until_idle:
-                if out["open"] == 0 and self._batch is None:
-                    reason = "idle"
+        try:
+            while True:
+                out = self.tick()
+                ticks += 1
+                if not out["progressed"]:
+                    self._stalled_ticks += 1
+                else:
+                    self._stalled_ticks = 0
+                if self.draining and self._batch is None:
+                    reason = "drained"
                     break
-                if self._stalled_ticks > 50 and self._batch is None:
-                    # open requests nothing can batch (e.g. everything
-                    # deferred) — refuse to spin forever
-                    reason = "stalled"
+                if max_ticks is not None and ticks >= max_ticks:
+                    reason = "ticks"
                     break
-            if not out["progressed"]:
-                time.sleep(poll_seconds)
+                if max_seconds is not None and (
+                    time.monotonic() - t0 > max_seconds
+                ):
+                    reason = "timeout"
+                    break
+                if until_idle:
+                    if out["open"] == 0 and self._batch is None:
+                        reason = "idle"
+                        break
+                    if self._stalled_ticks > 50 and self._batch is None:
+                        # open requests nothing can batch (e.g.
+                        # everything deferred) — refuse to spin forever
+                        reason = "stalled"
+                        break
+                if not out["progressed"]:
+                    time.sleep(poll_seconds)
+        finally:
+            for sig, h in prev_handlers.items():
+                try:
+                    _signal.signal(sig, h)
+                except (ValueError, TypeError):
+                    pass
+        if reason == "drained":
+            self._finish_drain()
         outcome = {"reason": reason, "states": self.state_counts()}
         self._sink.event("serve", "stop", reason=reason,
                          states=outcome["states"])
@@ -1479,6 +1794,10 @@ class RequestServer:
                 pass
             self._sock = None
         self.journal.close()
+        if self.lease is not None:
+            self._sink.event("lease", "release", pid=os.getpid())
+            self.lease.release()
+            self.lease = None
         close = getattr(self._sink, "close", None)
         if callable(close):
             close()

@@ -761,6 +761,9 @@ def request_timeline(streams: List[Stream]) -> dict:
     preempts = []
     divergences = []
     recoveries = []
+    hangs = []
+    deadline_cancels = []
+    drains = []
 
     def _req(rid) -> dict:
         return reqs.setdefault(rid, {
@@ -789,6 +792,29 @@ def request_timeline(streams: List[Stream]) -> dict:
                     r["slices"] = ev.get("slices")
                 elif name == "failed":
                     r["fail_reason"] = ev.get("reason")
+                elif name == "deadline_cancel":
+                    deadline_cancels.append({
+                        "t": gt, "request": ev.get("job"),
+                        "deadline_s": ev.get("deadline_s"),
+                        "elapsed_s": ev.get("elapsed_s"),
+                    })
+            elif kind == "dispatch" and name == "hung":
+                hangs.append({
+                    "t": round(s.gt(ev), 6), "batch": ev.get("batch"),
+                    "slice": ev.get("slice"),
+                    "elapsed_s": ev.get("elapsed_s"),
+                    "budget_s": ev.get("budget_s"),
+                    "requests": ev.get("jobs"),
+                })
+            elif kind == "drain":
+                drains.append({
+                    "t": round(s.gt(ev), 6), "event": name,
+                    "reason": ev.get("reason"),
+                    "open": ev.get("open"),
+                    "batch": ev.get("batch"),
+                    "members": ev.get("members"),
+                    "clean": ev.get("clean"),
+                })
             elif kind == "serve":
                 gt = round(s.gt(ev), 6)
                 if name == "admit":
@@ -865,6 +891,9 @@ def request_timeline(streams: List[Stream]) -> dict:
         "preemptions": preempts,
         "divergences": divergences,
         "recoveries": recoveries,
+        "hangs": hangs,
+        "deadline_cancels": deadline_cancels,
+        "drains": drains,
         "mean_occupancy": mean_occ,
     }
 
@@ -1110,6 +1139,27 @@ class TraceReport:
             for d in sv.get("divergences", ()):
                 add(f"   divergence: batch {d['batch']} failed "
                     f"{d.get('requests')} at t={d['t']:.3f}")
+            for h in sv.get("hangs", ()):
+                add(f"   hung dispatch: batch {h['batch']} slice "
+                    f"{h.get('slice')} at t={h['t']:.3f} "
+                    f"({h.get('elapsed_s')} s > budget "
+                    f"{h.get('budget_s')} s), evacuated "
+                    f"{h.get('requests')}")
+            for c in sv.get("deadline_cancels", ()):
+                add(f"   deadline cancel: {c['request']} at "
+                    f"t={c['t']:.3f} (deadline {c.get('deadline_s')} "
+                    f"s, elapsed {c.get('elapsed_s')} s)")
+            for d in sv.get("drains", ()):
+                detail = {
+                    "start": f"reason={d.get('reason')} "
+                             f"open={d.get('open')}",
+                    "parked": f"batch={d.get('batch')} "
+                              f"members={d.get('members')}",
+                    "done": f"clean={d.get('clean')} "
+                            f"open={d.get('open')}",
+                }.get(d["event"], "")
+                add(f"   drain {d['event']} at t={d['t']:.3f} "
+                    f"{detail}".rstrip())
             if sv.get("mean_occupancy") is not None:
                 add(f"   mean batch occupancy: {sv['mean_occupancy']}")
         add("=" * 68)

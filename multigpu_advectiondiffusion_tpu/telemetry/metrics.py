@@ -528,6 +528,17 @@ def registry_from_events(events: Iterable[dict],
                 reg.counter("serve_requests_failed_total").inc()
             elif name == "state" and ev.get("to") == "requeued":
                 reg.counter("serve_requests_requeued_total").inc()
+            elif name == "deadline_cancel":
+                reg.counter("serve_deadline_cancelled_total").inc()
+        elif kind == "dispatch":
+            if name == "hung":
+                reg.counter("serve_dispatch_hung_total").inc()
+        elif kind == "lease":
+            if name == "takeover":
+                reg.counter("serve_lease_takeovers_total").inc()
+        elif kind == "drain":
+            if name == "parked":
+                reg.counter("serve_drain_parked_total").inc()
         elif kind == "serve":
             if name == "admit":
                 reg.counter("serve_requests_admitted_total").inc()

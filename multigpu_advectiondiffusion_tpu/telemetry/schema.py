@@ -32,7 +32,14 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
                         "rotated_bytes"}},
     "span": {None: {"phase", "id", "depth"}},
     "counter": {None: {"inc", "total"}},
-    "dispatch": {"build": {"key", "impl"}},
+    "dispatch": {
+        "build": {"key", "impl"},
+        # hung-dispatch watchdog (ISSUE 20): a slice blew its
+        # wall-clock budget — the batch is evacuated from its last
+        # slice checkpoints and bisected until the poison member is
+        # isolated and quarantined
+        "hung": {"batch", "slice", "elapsed_s", "budget_s", "jobs"},
+    },
     # solver-plugin registry (models/registry.py, ISSUE 15): CLI
     # --model resolution through the registry — one event per resolved
     # run naming the family and the generated subcommand
@@ -174,7 +181,7 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
                   "pipeline", "pipeline_depth", "donate",
                   "group_commit_s"},
         "recover": {"records", "torn_lines", "requests", "requeued",
-                    "failed"},
+                    "failed", "clean_shutdown"},
         "admit": {"job", "key", "warm"},
         "defer": {"job", "reason"},
         "shed": {"job", "open", "bound", "retry_after_s"},
@@ -218,6 +225,34 @@ EVENT_REGISTRY: Dict[str, Dict[Optional[str], Set[str]]] = {
         "state": {"job", "from", "to"},
         "done": {"job", "seconds", "slices"},
         "failed": {"job", "reason"},
+        # deadline enforcement (ISSUE 20): a past-deadline request
+        # cancelled at a slice boundary (its lane frozen, the rest of
+        # the batch unperturbed); suppressed under --best-effort
+        "deadline_cancel": {"job", "deadline_s", "elapsed_s"},
+    },
+    # single-writer lease (service/lease.py, ISSUE 20): exactly one
+    # daemon per service root — acquisition (takeover=True when a
+    # stale lease from a dead holder was reclaimed), the takeover's
+    # forensics, and the release on clean shutdown/drain
+    "lease": {
+        "acquire": {"pid", "path", "takeover"},
+        "takeover": {"pid", "prev_pid", "age_s"},
+        "release": {"pid"},
+    },
+    # graceful drain & handover (ISSUE 20): admission stops, the
+    # in-flight batch parks at its next slice boundary, the journal
+    # gets the clean-shutdown marker, the lease releases — the
+    # successor starts with zero replay-recovery work
+    "drain": {
+        "start": {"reason", "open"},
+        "parked": {"batch", "members"},
+        "done": {"clean", "open"},
+    },
+    # journal schema migration (service/journal.migrate_journal via
+    # the ``migrate`` CLI verb, ISSUE 20)
+    "journal": {
+        "migrate": {"path", "migrated", "from_schema", "schema",
+                    "records"},
     },
     # per-job lifecycle in the scheduler's stream, namespaced by job
     # id: every journal transition is mirrored as a job:state event so
@@ -306,6 +341,13 @@ COUNTER_NAMES: Set[str] = {
     "serve_pipeline_dispatches_total",
     "serve_prewarm_total",
     "serve_prewarm_hits_total",
+    # operational hardening (ISSUE 20): stale-lease takeovers, batches
+    # parked by a graceful drain, hung-dispatch declarations, and
+    # deadline cancellations at slice boundaries
+    "serve_lease_takeovers_total",
+    "serve_drain_parked_total",
+    "serve_dispatch_hung_total",
+    "serve_deadline_cancelled_total",
     "sched_jobs_submitted_total",
     "sched_jobs_admitted_total",
     "sched_job_exits_total",

@@ -1,0 +1,193 @@
+#!/usr/bin/env bash
+# Graceful-drain handover gate (ISSUE 20): continuous submission across
+# a SIGTERM drain + successor start, provable in CI.
+#
+# 1. Drain gate: submit 4 coalescible requests, start the serving
+#    daemon (single-writer lease ON — the CLI default), keep submitting
+#    while its first batch marches, then drain it via the operator verb
+#    (`serve-requests --root DIR --drain` SIGTERMs the lease holder).
+#    Assert (a) the daemon exits 0 with `shutdown clean=true` as the
+#    journal's LAST record and the lease released, (b) a request
+#    submitted BETWEEN the two incarnations is inherited from the
+#    spool, (c) the successor starts with ZERO crash-recovery requeues
+#    (the clean-handover fast start), and (d) every request across the
+#    whole timeline — before, during and after the handover — is
+#    answered EXACTLY once with a published result, journal complete.
+# 2. `--selftest`: proves the gate's assertions have teeth —
+#    the duplicate `done` record a second un-leased server interleaves
+#    (with the lease ON it would exit 78 before writing a byte; the
+#    selftest disables it and forges the double-serve) must trip the
+#    exactly-once check, and a dropped in-flight request (admitted,
+#    marching, never answered) must trip `--verify --require-complete`.
+#
+#   ./out/drain_gate.sh             # the drain/handover gate
+#   ./out/drain_gate.sh --selftest  # double-serve + dropped-request proofs
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+CLI=(python -m multigpu_advectiondiffusion_tpu.cli)
+REQ=(request --model diffusion --n 12 12 --ic gaussian)
+
+# exactly-once over a comma-separated id list: exit 1 on any request
+# answered zero or 2+ times
+check_exactly_once() {
+    python - "$1" "$2" <<'PY'
+import json, sys
+root, ids = sys.argv[1], sys.argv[2].split(",")
+done = {}
+for line in open(f"{root}/journal.jsonl"):
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    if r.get("type") == "state" and r.get("to") == "done":
+        done[r["job"]] = done.get(r["job"], 0) + 1
+bad = {i: done.get(i, 0) for i in ids if done.get(i, 0) != 1}
+if bad:
+    print(f"drain_gate: NOT exactly once: {bad}", file=sys.stderr)
+    sys.exit(1)
+PY
+}
+
+if [[ "${1:-}" == "--selftest" ]]; then
+    echo "drain_gate: selftest 1 — an injected double-serve (lease" \
+         "disabled) must trip the exactly-once check"
+    ROOT="$TMP/double"
+    "${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id ds1 \
+        --t-end 0.15
+    "${CLI[@]}" serve-requests --root "$ROOT" --no-lease --until-idle \
+        --max-batch 2 --slice-steps 4 --poll 0.02
+    check_exactly_once "$ROOT" ds1
+    # the record stream a SECOND un-leased server would interleave:
+    # it replays the journal concurrently with the first, re-marches
+    # ds1, and appends its own done. With the lease on, that writer
+    # exits 78 before this record can exist.
+    python - "$ROOT" <<'PY'
+import sys
+from multigpu_advectiondiffusion_tpu.service.journal import Journal
+j = Journal(f"{sys.argv[1]}/journal.jsonl", fsync=False)
+j.append("state", job="ds1", **{"from": "running", "to": "done"})
+j.close()
+PY
+    if check_exactly_once "$ROOT" ds1 2> /dev/null; then
+        echo "drain_gate: SELFTEST FAILED — double-serve passed the" \
+             "exactly-once check" >&2
+        exit 1
+    fi
+    echo "drain_gate: selftest 1 OK — double-serve tripped the gate"
+
+    echo "drain_gate: selftest 2 — a dropped in-flight request must" \
+         "trip --verify --require-complete"
+    ROOT="$TMP/dropped"
+    # a horizon the 1.5s serving window cannot reach: admitted and
+    # marching (journalled, non-terminal) when the server stops —
+    # exactly what a lost in-flight request leaves behind
+    "${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id drop1 \
+        --t-end 50.0
+    "${CLI[@]}" serve-requests --root "$ROOT" --no-lease --max-batch 2 \
+        --slice-steps 1 --poll 0.02 --max-seconds 1.5
+    "${CLI[@]}" serve-requests --root "$ROOT" --verify
+    if "${CLI[@]}" serve-requests --root "$ROOT" --verify \
+        --require-complete > "$TMP/drop.out" 2>&1; then
+        echo "drain_gate: SELFTEST FAILED — dropped in-flight request" \
+             "passed --require-complete" >&2
+        exit 1
+    fi
+    echo "drain_gate: selftest 2 OK — dropped request tripped the gate"
+    echo "drain_gate: selftest PASS"
+    exit 0
+fi
+
+ROOT="$TMP/root"
+echo "drain_gate: submitting 4 coalescible requests"
+# a horizon long enough (~2400 steps, ~1200 slices) that the drain
+# verb's own interpreter startup still lands mid-march
+for i in 1 2 3 4; do
+    "${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id "g$i" \
+        --t-end 20.0 --ic-param "width=0.$((6 + 2 * i))"
+done
+
+echo "drain_gate: server 1 up (lease on); waiting for a marched slice"
+"${CLI[@]}" serve-requests --root "$ROOT" --until-idle --max-batch 4 \
+    --slice-steps 2 --poll 0.02 > "$TMP/server1.out" 2>&1 &
+SERVER=$!
+for _ in $(seq 1 2400); do
+    if grep -q '"slice"' "$ROOT/serve_events.jsonl" 2> /dev/null; then
+        break
+    fi
+    if ! kill -0 "$SERVER" 2> /dev/null; then
+        echo "drain_gate: server exited before the drain window:" >&2
+        cat "$TMP/server1.out" >&2
+        exit 1
+    fi
+    sleep 0.05
+done
+grep -q '"slice"' "$ROOT/serve_events.jsonl" || {
+    echo "drain_gate: server never marched a slice" >&2
+    exit 1
+}
+
+echo "drain_gate: submitting g5 mid-flight, then draining the holder"
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id g5 --t-end 20.0
+"${CLI[@]}" serve-requests --root "$ROOT" --drain
+if ! wait "$SERVER"; then
+    echo "drain_gate: drained server exited non-zero:" >&2
+    cat "$TMP/server1.out" >&2
+    exit 1
+fi
+
+python - "$ROOT" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+records = []
+for line in open(os.path.join(root, "journal.jsonl")):
+    try:
+        records.append(json.loads(line))
+    except ValueError:
+        pass
+last = records[-1]
+assert last.get("type") == "note" and last.get("note") == "shutdown" \
+    and last.get("clean") is True, \
+    f"journal does not end with shutdown clean=true: {last}"
+assert not os.path.exists(os.path.join(root, "lease.json")), \
+    "lease.json survived the drain"
+print("drain_gate: clean shutdown marker + lease released")
+PY
+
+echo "drain_gate: submitting g6 between incarnations"
+"${CLI[@]}" "${REQ[@]}" --root "$ROOT" --request-id g6 --t-end 20.0
+
+echo "drain_gate: successor up — must inherit spool + parked work"
+"${CLI[@]}" serve-requests --root "$ROOT" --until-idle --max-batch 4 \
+    --slice-steps 2 --poll 0.02 > "$TMP/server2.out" 2>&1
+
+echo "drain_gate: verify journal linearizes, complete"
+"${CLI[@]}" serve-requests --root "$ROOT" --verify --require-complete
+check_exactly_once "$ROOT" g1,g2,g3,g4,g5,g6
+
+python - "$ROOT" <<'PY'
+import json, os, sys
+root = sys.argv[1]
+evs = [json.loads(l) for l in open(os.path.join(
+    root, "serve_events.jsonl")) if l.strip()]
+recover = [e for e in evs
+           if e["kind"] == "serve" and e["name"] == "recover"]
+assert recover, "successor journalled no serve:recover"
+final = recover[-1]
+assert final["clean_shutdown"] is True, \
+    f"successor did not see a clean shutdown: {final}"
+assert final["requeued"] == 0, \
+    f"clean handover still paid crash-recovery requeues: {final}"
+for rid in ("g1", "g2", "g3", "g4", "g5", "g6"):
+    assert os.path.exists(os.path.join(
+        root, "requests", rid, "result.bin")), f"{rid}: no result.bin"
+    v = json.load(open(os.path.join(root, "requests", rid,
+                                    "verdict.json")))
+    assert v["status"] == "done", f"{rid}: verdict {v}"
+print("drain_gate: OK — 6 requests answered exactly once across the "
+      "handover, successor started with zero requeues")
+PY
+echo "drain_gate: PASS"

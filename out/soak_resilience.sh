@@ -16,6 +16,9 @@
 #   SERVE_GATE=1 ./out/soak_resilience.sh    # also run the request-
 #                                   # serving kill/replay gate and its
 #                                   # selftest after (out/serve_gate.sh)
+#   DRAIN_GATE=1 ./out/soak_resilience.sh    # also run the SIGTERM-
+#                                   # drain handover gate and its
+#                                   # selftest after (out/drain_gate.sh)
 #
 # Runs on the virtual CPU backend (no TPU needed), same as tier-1.
 set -euo pipefail
@@ -62,4 +65,13 @@ if [[ "${SERVE_GATE:-0}" == "1" ]]; then
   # — see out/serve_gate.sh
   JAX_PLATFORMS=cpu ./out/serve_gate.sh --selftest
   JAX_PLATFORMS=cpu ./out/serve_gate.sh
+fi
+
+if [[ "${DRAIN_GATE:-0}" == "1" ]]; then
+  # and on the graceful handover: the gate's assertion teeth (injected
+  # double-serve with the lease disabled + dropped in-flight request),
+  # then the live SIGTERM-drain + successor exactly-once proof
+  # — see out/drain_gate.sh
+  JAX_PLATFORMS=cpu ./out/drain_gate.sh --selftest
+  JAX_PLATFORMS=cpu ./out/drain_gate.sh
 fi

@@ -1,0 +1,900 @@
+"""Operational hardening of the serving layer (ISSUE 20).
+
+Single-writer lease (flock + advisory metadata, stale takeover with a
+pid+cmdline guard, structured exit 78 for the loser); graceful drain &
+handover (SIGTERM parks the in-flight batch at a slice boundary,
+journals ``shutdown clean=true``, releases the lease; the successor
+starts with zero replay-recovery work and answers every request
+exactly once, bit-exact); the hung-dispatch watchdog
+(``faults.stall_dispatch`` → batch evacuated from slice checkpoints,
+poison member bisected to quarantine, healthy members unperturbed);
+deadline enforcement at slice boundaries with a ``--best-effort``
+opt-out; journal schema versioning (sealed seq-0 header, loud refusal
+of future versions, the ``migrate`` CLI verb upgrading v0 roots in
+place); and the HTTP adapter's fuzz surface (structured 400/405/413/
+503, bounded reads, ``/healthz``, never a traceback).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from multigpu_advectiondiffusion_tpu.cli.status import (
+    collect_status,
+    render_text,
+)
+from multigpu_advectiondiffusion_tpu.models.ensemble import EnsembleSolver
+from multigpu_advectiondiffusion_tpu.resilience import faults
+from multigpu_advectiondiffusion_tpu.service import journal as journal_mod
+from multigpu_advectiondiffusion_tpu.service.daemon import Scheduler
+from multigpu_advectiondiffusion_tpu.service.journal import (
+    JOURNAL_SCHEMA,
+    Journal,
+    JournalSchemaError,
+    journal_schema,
+    migrate_journal,
+    schema_stamps,
+    verify_records,
+)
+from multigpu_advectiondiffusion_tpu.service.lease import (
+    EXIT_LEASE_HELD,
+    LeaseHeldError,
+    ServiceLease,
+    inspect_lease,
+)
+from multigpu_advectiondiffusion_tpu.service.requests import (
+    ALLOWED_REQUEST_TRANSITIONS,
+    REQUEST_TERMINAL_STATES,
+    RequestSpec,
+    submit_request_to_spool,
+)
+from multigpu_advectiondiffusion_tpu.service.server import RequestServer
+from multigpu_advectiondiffusion_tpu.utils.io import load_binary
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N = [12, 12]
+T0 = 0.1
+T_END = 0.18  # ~12 steps on the 12x12 stability dt
+LONG_T_END = 3 * T_END  # enough steps for several 2-step slices
+
+
+def _spec(rid, **kw) -> RequestSpec:
+    base = dict(model="diffusion", n=list(N), t_end=T_END,
+                ic="gaussian")
+    base.update(kw)
+    return RequestSpec(request_id=rid, **base)
+
+
+def _events(root):
+    path = os.path.join(root, "serve_events.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def _verdict(root, rid):
+    with open(os.path.join(root, "requests", rid, "verdict.json")) as f:
+        return json.load(f)
+
+
+def _crash(root, rid):
+    with open(os.path.join(root, "requests", rid, "crash.json")) as f:
+        return json.load(f)
+
+
+def _journal_verifies(root, require_complete=True):
+    path = os.path.join(root, "journal.jsonl")
+    records, torn = Journal.replay(path)
+    return verify_records(
+        records, torn=torn,
+        allowed_transitions=ALLOWED_REQUEST_TRANSITIONS,
+        terminal_states=REQUEST_TERMINAL_STATES,
+        initial_state="received",
+        require_complete=require_complete,
+        schema_versions=schema_stamps(path),
+    )
+
+
+def _done_counts(root):
+    records, _ = Journal.replay(os.path.join(root, "journal.jsonl"))
+    counts = {}
+    for r in records:
+        if r.get("type") == "state" and r.get("to") == "done":
+            counts[r["job"]] = counts.get(r["job"], 0) + 1
+    return counts
+
+
+def _reference_field(srv, spec):
+    """The request's answer computed OUTSIDE the serving machinery."""
+    tpl = srv._template(spec)
+    ens = EnsembleSolver(
+        tpl["family"].solver_cls, tpl["cfg"],
+        [RequestServer._member_overrides(spec)],
+    )
+    out = ens.advance_to(ens.initial_state(), [float(spec.t_end)])
+    return np.asarray(out.u[0], dtype=np.float32)
+
+
+def _assert_bits_match(root, srv, spec):
+    got = load_binary(
+        os.path.join(root, "requests", spec.request_id, "result.bin"),
+        tuple(N),
+    )
+    np.testing.assert_array_equal(got, _reference_field(srv, spec))
+
+
+def _dead_pid() -> int:
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid
+
+
+def _stale_meta(root, pid) -> dict:
+    now = time.time()
+    meta = {
+        "pid": pid, "role": "serve-requests", "root": root,
+        "cmdline": "python -c pass", "acquired": now - 120.0,
+        "heartbeat": now - 90.0, "draining": False,
+    }
+    with open(os.path.join(root, "lease.json"), "w") as f:
+        json.dump(meta, f)
+    return meta
+
+
+# --------------------------------------------------------------------- #
+# Single-writer lease
+# --------------------------------------------------------------------- #
+
+def test_lease_acquire_inspect_release(tmp_path):
+    root = str(tmp_path / "root")
+    lease = ServiceLease(root, role="serve-requests").acquire()
+    try:
+        assert lease.held
+        assert lease.takeover is None
+        info = inspect_lease(root)
+        assert info["present"] and info["locked"] and info["alive"]
+        assert not info["stale"]
+        assert info["holder"]["pid"] == os.getpid()
+        assert info["holder"]["role"] == "serve-requests"
+        assert info["age_s"] >= 0.0
+        # heartbeat flips the advisory draining flag immediately
+        lease.heartbeat(draining=True, force=True)
+        assert inspect_lease(root)["draining"] is True
+    finally:
+        lease.release()
+    info = inspect_lease(root)
+    assert not info["present"] and not info["locked"]
+    assert not os.path.exists(os.path.join(root, "lease.json"))
+
+
+def test_lease_excludes_second_holder(tmp_path):
+    root = str(tmp_path / "root")
+    lease = ServiceLease(root).acquire()
+    try:
+        with pytest.raises(LeaseHeldError, match="lease held by pid"):
+            ServiceLease(root).acquire()
+    finally:
+        lease.release()
+    # released: the next acquire wins without takeover forensics
+    lease2 = ServiceLease(root).acquire()
+    assert lease2.takeover is None
+    lease2.release()
+
+
+def test_stale_lease_reclaimed_with_takeover(tmp_path):
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    dead = _dead_pid()
+    _stale_meta(root, dead)
+    info = inspect_lease(root)
+    assert info["present"] and not info["locked"]
+    assert info["stale"] and not info["alive"]
+    # the crashed holder's root is reclaimable: acquire wins and
+    # records who it took over from
+    lease = ServiceLease(root).acquire()
+    try:
+        assert lease.takeover is not None
+        assert lease.takeover["pid"] == dead
+        assert lease.takeover["age_s"] > 0.0
+        assert inspect_lease(root)["alive"]
+    finally:
+        lease.release()
+
+
+def test_request_server_lease_wiring(tmp_path):
+    root = str(tmp_path / "srv")
+    srv = RequestServer(root, fsync=False, lease=True)
+    try:
+        kinds = [(e["kind"], e["name"]) for e in _events(root)]
+        assert ("lease", "acquire") in kinds
+        with pytest.raises(LeaseHeldError, match="lease held by pid"):
+            RequestServer(root, fsync=False, lease=True)
+    finally:
+        srv.close()
+    # close released the lease; a successor acquires immediately
+    assert not inspect_lease(root)["present"]
+    srv2 = RequestServer(root, fsync=False, lease=True)
+    srv2.close()
+    kinds = [(e["kind"], e["name"]) for e in _events(root)]
+    assert kinds.count(("lease", "release")) >= 2
+
+
+def test_scheduler_reuses_lease(tmp_path):
+    root = str(tmp_path / "sched")
+    sch = Scheduler(root, fsync=False, lease=True)
+    try:
+        with pytest.raises(LeaseHeldError, match="lease held by pid"):
+            Scheduler(root, fsync=False, lease=True)
+    finally:
+        sch.close()
+    # crashed-holder takeover: stale metadata, free flock
+    dead = _dead_pid()
+    _stale_meta(root, dead)
+    sch2 = Scheduler(root, fsync=False, lease=True)
+    try:
+        assert sch2.lease.takeover["pid"] == dead
+    finally:
+        sch2.close()
+    events = []
+    with open(os.path.join(root, "sched_events.jsonl")) as f:
+        for line in f:
+            events.append(json.loads(line))
+    takeovers = [e for e in events
+                 if e["kind"] == "lease" and e["name"] == "takeover"]
+    assert takeovers and takeovers[-1]["prev_pid"] == dead
+
+
+# --------------------------------------------------------------------- #
+# Chaos (a): two servers race one root → structured loser exit 78
+# --------------------------------------------------------------------- #
+
+_SERVER_WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multigpu_advectiondiffusion_tpu.cli.__main__ import main
+main(["serve-requests", "--root", sys.argv[2], "--until-idle",
+      "--max-batch", "4", "--slice-steps", "2", "--poll", "0.01"])
+print("SERVE-WORKER-OK", flush=True)
+'''
+
+
+def _launch_server(tmp_path, tag, root):
+    script = tmp_path / f"server_{tag}.py"
+    script.write_text(_SERVER_WORKER)
+    log = tmp_path / f"server_{tag}.log"
+    handle = open(log, "w")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), REPO, root],
+        stdout=handle, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    return proc, log, handle
+
+
+def _run_to_completion(tmp_path, tag, root, timeout=240):
+    proc, log, handle = _launch_server(tmp_path, tag, root)
+    try:
+        rc = proc.wait(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        handle.close()
+    assert rc == 0, f"server {tag} rc={rc}:\n{log.read_text()[-2000:]}"
+    assert "SERVE-WORKER-OK" in log.read_text()
+
+
+@pytest.mark.chaos
+def test_second_server_exits_78_naming_holder(tmp_path):
+    """Two servers race one root: exactly one serves; the loser exits
+    with the structured lease code instead of interleaving journal
+    appends with the winner."""
+    root = str(tmp_path / "contested")
+    holder = RequestServer(root, fsync=False, lease=True)
+    try:
+        proc, log, handle = _launch_server(tmp_path, "loser", root)
+        try:
+            rc = proc.wait(timeout=240)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+            handle.close()
+        assert rc == EXIT_LEASE_HELD, log.read_text()[-2000:]
+        text = log.read_text()
+        assert "lease held by pid" in text
+        assert str(os.getpid()) in text
+        assert "SERVE-WORKER-OK" not in text
+        # the loser never wrote a byte of the holder's journal
+        assert _journal_verifies(root, require_complete=False) == []
+    finally:
+        holder.close()
+
+
+# --------------------------------------------------------------------- #
+# Chaos (b): graceful drain & handover, exactly once, bit-exact
+# --------------------------------------------------------------------- #
+
+def test_drain_parks_batch_and_successor_resumes_exactly_once(tmp_path):
+    """In-process drain mid-batch: admission stops, the batch parks at
+    a slice boundary, the journal ends with ``shutdown clean=true``,
+    the lease is released — and the successor answers everything
+    exactly once, bit-exact, with zero crash-recovery requeues."""
+    root = str(tmp_path / "drained")
+    specs = [
+        _spec("d0", t_end=LONG_T_END),
+        _spec("d1", t_end=LONG_T_END, ic_params={"width": 0.12}),
+    ]
+    for s in specs:
+        submit_request_to_spool(root, s)
+    srv1 = RequestServer(root, max_batch=4, slice_steps=2,
+                         fsync=False, lease=True)
+    try:
+        srv1.recover()
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            srv1.tick()
+            if srv1._batch is not None and srv1._batch.slices >= 1:
+                break
+        assert srv1._batch is not None and srv1._batch.slices >= 1
+        srv1.request_drain("test")
+        # a request arriving during the drain stays spooled — the
+        # durable mailbox is the successor's, not ours
+        late = _spec("late")
+        submit_request_to_spool(root, late)
+        out = srv1.serve(until_idle=True)
+        assert out["reason"] == "drained"
+    finally:
+        srv1.close()
+
+    records, _ = Journal.replay(os.path.join(root, "journal.jsonl"))
+    last = records[-1]
+    assert last["type"] == "note" and last["note"] == "shutdown"
+    assert last["clean"] is True
+    kinds = [(e["kind"], e["name"]) for e in _events(root)]
+    assert ("drain", "start") in kinds
+    assert ("drain", "parked") in kinds
+    assert ("drain", "done") in kinds
+    # lease released at drain completion, not at close
+    assert not inspect_lease(root)["present"]
+    # the late arrival was NOT admitted by the draining server
+    assert all(r.get("job") != "late" for r in records)
+
+    srv2 = RequestServer(root, max_batch=4, slice_steps=2,
+                         fsync=False, lease=True)
+    try:
+        report = srv2.recover()
+        assert report["clean_shutdown"] is True
+        assert report["requeued"] == 0 and report["failed"] == 0
+        out = srv2.serve(until_idle=True)
+        assert out["reason"] == "idle"
+        for s in specs + [late]:
+            assert _verdict(root, s.request_id)["status"] == "done"
+            _assert_bits_match(root, srv2, s)
+    finally:
+        srv2.close()
+    assert _journal_verifies(root) == []
+    assert _done_counts(root) == {"d0": 1, "d1": 1, "late": 1}
+
+
+_CHAOS_T_END = 0.5
+
+
+def _chaos_specs():
+    return [
+        _spec(f"c{i}", t_end=_CHAOS_T_END,
+              ic_params={"width": 0.08 + 0.02 * i})
+        for i in range(4)
+    ]
+
+
+def _wait_for_slice(proc, root, timeout=180.0):
+    events = os.path.join(root, "serve_events.jsonl")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        slices = 0
+        try:
+            with open(events) as f:
+                for line in f:
+                    if '"serve"' in line and '"slice"' in line:
+                        slices += 1
+        except OSError:
+            slices = 0
+        if slices:
+            return slices
+        if proc.poll() is not None:
+            raise TimeoutError(
+                f"server exited before a slice (rc={proc.poll()})"
+            )
+        time.sleep(0.02)
+    raise TimeoutError(f"no serve:slice event within {timeout}s")
+
+
+@pytest.mark.chaos
+def test_sigterm_mid_batch_drains_clean_and_hands_over(tmp_path):
+    """The acceptance chaos case: SIGTERM the serving daemon mid-batch.
+    It drains to ``shutdown clean=true`` and exits 0; a successor —
+    with one more request submitted across the handover — answers
+    every request exactly once, bit-exact vs an uninterrupted run."""
+    root = str(tmp_path / "termed")
+    ref_root = str(tmp_path / "uninterrupted")
+    mid = _spec("mid", t_end=_CHAOS_T_END, ic_params={"width": 0.2})
+    for s in _chaos_specs() + [mid]:
+        submit_request_to_spool(ref_root, s)
+    for s in _chaos_specs():
+        submit_request_to_spool(root, s)
+    _run_to_completion(tmp_path, "ref", ref_root)
+
+    proc, log, handle = _launch_server(tmp_path, "victim", root)
+    try:
+        assert _wait_for_slice(proc, root) >= 1
+        os.kill(proc.pid, signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        handle.close()
+    # a drain is an ORDERLY exit: rc 0, worker epilogue reached
+    assert rc == 0, log.read_text()[-2000:]
+    assert "SERVE-WORKER-OK" in log.read_text()
+    records, _ = Journal.replay(os.path.join(root, "journal.jsonl"))
+    last = records[-1]
+    assert last["type"] == "note" and last["note"] == "shutdown"
+    assert last["clean"] is True
+
+    # continuous submission across the handover
+    submit_request_to_spool(root, mid)
+    _run_to_completion(tmp_path, "successor", root)
+
+    recovers = [e for e in _events(root)
+                if e["kind"] == "serve" and e["name"] == "recover"]
+    assert recovers[-1]["clean_shutdown"] is True
+    assert recovers[-1]["requeued"] == 0
+    assert _journal_verifies(root) == []
+    expected = {s.request_id: 1 for s in _chaos_specs() + [mid]}
+    assert _done_counts(root) == expected
+    for s in _chaos_specs() + [mid]:
+        drained_bits = open(
+            os.path.join(root, "requests", s.request_id, "result.bin"),
+            "rb").read()
+        ref_bits = open(
+            os.path.join(ref_root, "requests", s.request_id,
+                         "result.bin"), "rb").read()
+        assert drained_bits == ref_bits, (
+            f"{s.request_id}: drain handover changed the answer"
+        )
+
+
+# --------------------------------------------------------------------- #
+# Chaos (c): hung dispatch → evacuation, bisection, quarantine
+# --------------------------------------------------------------------- #
+
+@pytest.mark.chaos
+def test_stall_dispatch_bisects_and_quarantines_poison(tmp_path):
+    """An injected dispatch stall blows the slice budget: the batch is
+    evacuated from its slice checkpoints, bisection isolates the
+    poison member, which is quarantined+failed with forensics — and
+    the healthy members finish bit-exact."""
+    root = str(tmp_path / "stalled")
+    healthy = [
+        _spec(f"h{i}", t_end=LONG_T_END,
+              operands={"diffusivity": 0.10 + 0.01 * i})
+        for i in range(3)
+    ]
+    poison = _spec("poison", t_end=LONG_T_END,
+                   operands={"diffusivity": 0.777})
+    for s in healthy + [poison]:
+        submit_request_to_spool(root, s)
+    srv = RequestServer(root, max_batch=4, slice_steps=2, fsync=False,
+                        hang_budget_s=0.5)
+    try:
+        with faults.stall_dispatch(1.5, operand="diffusivity",
+                                   value=0.777):
+            out = srv.serve(until_idle=True)
+        assert out["reason"] == "idle"
+        v = _verdict(root, "poison")
+        assert v["status"] == "failed"
+        assert v["reason"] == "dispatch_hung"
+        crash = _crash(root, "poison")
+        assert crash["type"] == "DispatchHung"
+        assert crash["quarantined"] is True
+        assert crash["elapsed_s"] > crash["budget_s"]
+        hungs = [e for e in _events(root)
+                 if e["kind"] == "dispatch" and e["name"] == "hung"]
+        # at least the 4-wide blow and the poison cohort's repeat
+        assert len(hungs) >= 2
+        for s in healthy:
+            assert _verdict(root, s.request_id)["status"] == "done"
+            _assert_bits_match(root, srv, s)
+    finally:
+        srv.close()
+    assert _journal_verifies(root) == []
+    assert _done_counts(root) == {"h0": 1, "h1": 1, "h2": 1}
+
+
+def test_transient_solo_stall_retries_not_quarantines(tmp_path):
+    """A solo batch's FIRST budget blow (a loaded host, a GC pause) is
+    a requeue-retry from its checkpoint, not a quarantine — only the
+    repeat strike fails the request."""
+    root = str(tmp_path / "transient")
+    spec = _spec("t0", t_end=LONG_T_END,
+                 operands={"diffusivity": 0.5})
+    submit_request_to_spool(root, spec)
+    srv = RequestServer(root, max_batch=2, slice_steps=2, fsync=False,
+                        hang_budget_s=0.5)
+    try:
+        # the first slice of a batch is watchdog-exempt, so stall two
+        # slices: the second trips the budget (strike 1, requeue); the
+        # retry's slices are stall-free and march to completion
+        with faults.stall_dispatch(1.5, operand="diffusivity",
+                                   value=0.5, times=2):
+            out = srv.serve(until_idle=True)
+        assert out["reason"] == "idle"
+        assert _verdict(root, "t0")["status"] == "done"
+        _assert_bits_match(root, srv, spec)
+        records, _ = Journal.replay(os.path.join(root, "journal.jsonl"))
+        requeues = [r for r in records if r.get("type") == "state"
+                    and r.get("to") == "requeued"
+                    and r.get("reason") == "dispatch_hung"]
+        assert len(requeues) == 1
+    finally:
+        srv.close()
+    assert _journal_verifies(root) == []
+
+
+def test_persistent_solo_stall_quarantined_on_repeat(tmp_path):
+    root = str(tmp_path / "wedged")
+    submit_request_to_spool(
+        root, _spec("w0", t_end=LONG_T_END,
+                    operands={"diffusivity": 0.5}))
+    srv = RequestServer(root, max_batch=2, slice_steps=2, fsync=False,
+                        hang_budget_s=0.5)
+    try:
+        with faults.stall_dispatch(1.5, operand="diffusivity",
+                                   value=0.5):
+            out = srv.serve(until_idle=True)
+        assert out["reason"] == "idle"
+        v = _verdict(root, "w0")
+        assert v["status"] == "failed"
+        assert v["reason"] == "dispatch_hung"
+        crash = _crash(root, "w0")
+        assert crash["quarantined"] is True
+        assert crash["strikes"] >= 2
+    finally:
+        srv.close()
+    assert _journal_verifies(root) == []
+
+
+# --------------------------------------------------------------------- #
+# Chaos (d): deadline enforcement at slice boundaries
+# --------------------------------------------------------------------- #
+
+@pytest.mark.chaos
+def test_deadline_cancelled_at_boundary_rest_unperturbed(tmp_path):
+    root = str(tmp_path / "deadline")
+    keep = [
+        _spec("k0", t_end=LONG_T_END),
+        _spec("k1", t_end=LONG_T_END, ic_params={"width": 0.12}),
+    ]
+    doomed = _spec("doomed", t_end=LONG_T_END, deadline_s=0.05,
+                   ic_params={"width": 0.15})
+    for s in keep + [doomed]:
+        submit_request_to_spool(root, s)
+    srv = RequestServer(root, max_batch=4, slice_steps=2, fsync=False)
+    try:
+        out = srv.serve(until_idle=True)
+        assert out["reason"] == "idle"
+        v = _verdict(root, "doomed")
+        assert v["status"] == "failed"
+        assert v["reason"] == "deadline_exceeded"
+        crash = _crash(root, "doomed")
+        assert crash["type"] == "DeadlineExceeded"
+        assert crash["elapsed_s"] > crash["deadline_s"]
+        # partial progress recorded: frozen before its horizon
+        assert crash["t"] < LONG_T_END
+        cancels = [e for e in _events(root)
+                   if e["kind"] == "req"
+                   and e["name"] == "deadline_cancel"]
+        assert cancels and cancels[0]["job"] == "doomed"
+        for s in keep:
+            assert _verdict(root, s.request_id)["status"] == "done"
+            _assert_bits_match(root, srv, s)
+    finally:
+        srv.close()
+    assert _journal_verifies(root) == []
+
+
+def test_best_effort_ignores_deadlines(tmp_path):
+    root = str(tmp_path / "besteffort")
+    submit_request_to_spool(
+        root, _spec("be", t_end=T_END, deadline_s=0.001))
+    srv = RequestServer(root, max_batch=4, slice_steps=2, fsync=False,
+                        best_effort=True)
+    try:
+        srv.serve(until_idle=True)
+        assert _verdict(root, "be")["status"] == "done"
+        assert not any(
+            e["kind"] == "req" and e["name"] == "deadline_cancel"
+            for e in _events(root)
+        )
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# Chaos (e): journal schema versioning & migration
+# --------------------------------------------------------------------- #
+
+def test_journal_stamps_schema_header(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with Journal(path, fsync=False) as j:
+        j.append("submit", job="a")
+        j.append("state", job="a", **{"from": "received",
+                                      "to": "admitted"})
+    assert journal_schema(path) == JOURNAL_SCHEMA
+    assert schema_stamps(path) == [JOURNAL_SCHEMA]
+    # readers strip the header: record counts stay pure
+    records, torn = Journal.replay(path)
+    assert torn == 0
+    assert [r["type"] for r in records] == ["submit", "state"]
+    with_header, _ = Journal.replay(path, include_schema=True)
+    assert with_header[0]["seq"] == 0
+    assert with_header[0]["note"] == "schema"
+    assert with_header[0]["schema"] == JOURNAL_SCHEMA
+
+
+def test_future_schema_refused_loudly(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    rec = {"seq": 0, "wall": 0.0, "type": "note", "note": "schema",
+           "schema": JOURNAL_SCHEMA + 41}
+    with open(path, "w") as f:
+        f.write(journal_mod._seal(rec) + "\n")
+    with pytest.raises(JournalSchemaError, match="schema"):
+        Journal.replay(path)
+    with pytest.raises(JournalSchemaError):
+        Journal(path, fsync=False)
+    with pytest.raises(JournalSchemaError):
+        migrate_journal(path)
+    # the dashboard reports the refusal as a fact, not a crash
+    root = str(tmp_path)
+    status = collect_status(root)
+    assert status["schema_error"]
+    assert any("SCHEMA ERROR" in line for line in render_text(status))
+
+
+def test_migrate_upgrades_v0_in_place(tmp_path, capsys):
+    root = str(tmp_path / "v0root")
+    os.makedirs(root)
+    path = os.path.join(root, "journal.jsonl")
+    with Journal(path, fsync=False) as j:
+        j.append("submit", job="a")
+        j.append("state", job="a", **{"from": "received",
+                                      "to": "admitted"})
+    before, _ = Journal.replay(path)
+    # strip the header (a pre-versioning root) and leave a torn tail
+    lines = open(path).read().splitlines()
+    with open(path, "w") as f:
+        f.write("\n".join(lines[1:]) + "\n")
+        f.write('{"seq": 9, "ty')
+    assert journal_schema(path) == 0
+
+    from multigpu_advectiondiffusion_tpu.cli.__main__ import (
+        main as cli_main,
+    )
+    cli_main(["migrate", "--root", root])
+    out = capsys.readouterr().out
+    assert "schema" in out
+    assert journal_schema(path) == JOURNAL_SCHEMA
+    after, torn = Journal.replay(path)
+    # identical state machine, torn tail preserved byte-for-byte
+    assert after == before
+    assert torn == 1
+    assert verify_records(
+        after, torn=torn,
+        allowed_transitions=ALLOWED_REQUEST_TRANSITIONS,
+        terminal_states=REQUEST_TERMINAL_STATES,
+        initial_state="received",
+        schema_versions=schema_stamps(path)) == []
+    # idempotent: a second migrate is a no-op
+    result = migrate_journal(path)
+    assert result["migrated"] is False
+    assert result["schema"] == JOURNAL_SCHEMA
+    cli_main(["migrate", "--root", root])
+    assert "nothing to do" in capsys.readouterr().out
+
+
+def test_migrate_missing_journal_fails_structured(tmp_path, capsys):
+    from multigpu_advectiondiffusion_tpu.cli.__main__ import (
+        main as cli_main,
+    )
+    with pytest.raises(SystemExit):
+        cli_main(["migrate", "--root", str(tmp_path / "nothere")])
+
+
+# --------------------------------------------------------------------- #
+# HTTP adapter hardening + /healthz
+# --------------------------------------------------------------------- #
+
+def _http(port):
+    return http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+
+
+def test_http_fuzz_surface_and_healthz(tmp_path):
+    root = str(tmp_path / "http")
+    srv = RequestServer(root, fsync=False, http_port=0)
+    try:
+        port = srv.http_port
+
+        def roundtrip(method, path, body=None, headers=None):
+            conn = _http(port)
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+
+        # malformed JSON → structured 400, never a traceback
+        status, body = roundtrip("POST", "/requests", b"{not json")
+        assert status == 400
+        assert b"Traceback" not in body
+        assert "error" in json.loads(body)
+
+        # non-UTF-8 body → 400
+        status, body = roundtrip("POST", "/requests", b"\xff\xfe{}")
+        assert status == 400 and b"Traceback" not in body
+
+        # structurally-valid JSON that is not a spec → 400, not 500
+        status, body = roundtrip(
+            "POST", "/requests",
+            json.dumps({"model": "diffusion"}).encode())
+        assert status == 400 and b"Traceback" not in body
+        status, body = roundtrip("POST", "/requests", b"[1, 2, 3]")
+        assert status == 400 and b"Traceback" not in body
+
+        # oversize claim → 413 before a byte is read
+        conn = _http(port)
+        try:
+            conn.putrequest("POST", "/requests")
+            conn.putheader("Content-Length", str((1 << 20) + 1))
+            conn.putheader("Content-Type", "application/json")
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 413
+            payload = json.loads(resp.read())
+            assert payload["max_body_bytes"] == 1 << 20
+        finally:
+            conn.close()
+
+        # garbage Content-Length → 400
+        conn = _http(port)
+        try:
+            conn.putrequest("POST", "/requests")
+            conn.putheader("Content-Length", "banana")
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 400
+        finally:
+            conn.close()
+
+        # wrong methods → 405
+        for method in ("PUT", "DELETE"):
+            status, body = roundtrip(method, "/requests")
+            assert status == 405
+            assert b"Traceback" not in body
+
+        # healthz: live lease/drain state for load-balancer probes
+        status, body = roundtrip("GET", "/healthz")
+        assert status == 200
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert health["draining"] is False
+        assert health["lease"] is None  # started without a lease
+        assert health["open_requests"] == 0
+
+        # a well-formed submission still lands in the spool
+        spec = _spec("h1")
+        status, body = roundtrip(
+            "POST", "/requests",
+            json.dumps({"request_id": "h1", "model": "diffusion",
+                        "n": N, "t_end": T_END,
+                        "ic": "gaussian"}).encode())
+        assert status == 202
+        assert json.loads(body)["request_id"] == "h1"
+        del spec
+
+        # draining: admission refused with a structured 503
+        srv.draining = True
+        status, body = roundtrip(
+            "POST", "/requests",
+            json.dumps({"request_id": "h2", "model": "diffusion",
+                        "n": N, "t_end": T_END}).encode())
+        assert status == 503
+        refusal = json.loads(body)
+        assert refusal["status"] == "draining"
+        assert refusal["retry_after_s"] > 0
+        status, body = roundtrip("GET", "/healthz")
+        health = json.loads(body)
+        assert health["status"] == "draining"
+        assert health["draining"] is True
+    finally:
+        srv.close()
+
+
+def test_healthz_reports_lease_holder(tmp_path):
+    root = str(tmp_path / "leased")
+    srv = RequestServer(root, fsync=False, http_port=0, lease=True)
+    try:
+        conn = _http(srv.http_port)
+        try:
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+        assert health["lease"] == {"pid": os.getpid(), "held": True}
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------- #
+# tpucfd-status: lease / drain / clean-shutdown surface
+# --------------------------------------------------------------------- #
+
+def test_status_shows_lease_holder_and_stale(tmp_path):
+    root = str(tmp_path / "statroot")
+    lease = ServiceLease(root, role="serve-requests").acquire()
+    try:
+        status = collect_status(root)
+        assert status["lease"]["alive"]
+        text = "\n".join(render_text(status))
+        assert f"pid={os.getpid()}" in text
+        assert "role=serve-requests" in text
+        assert "STALE" not in text
+        # a draining holder is rendered as such
+        lease.heartbeat(draining=True, force=True)
+        status = collect_status(root)
+        assert status["draining"] is True
+        assert "draining" in "\n".join(render_text(status))
+    finally:
+        lease.release()
+    dead = _dead_pid()
+    _stale_meta(root, dead)
+    status = collect_status(root)
+    assert status["lease"]["stale"]
+    text = "\n".join(render_text(status))
+    assert "STALE" in text and "takes over" in text
+
+
+def test_status_shows_clean_shutdown_marker(tmp_path):
+    root = str(tmp_path / "cleanroot")
+    os.makedirs(os.path.join(root, "requests"))
+    with Journal(os.path.join(root, "journal.jsonl"), fsync=False) as j:
+        j.append("note", note="drain", reason="test")
+        j.append("note", note="shutdown", clean=True, pid=os.getpid())
+    status = collect_status(root)
+    assert status["clean_shutdown"] is True
+    assert status["draining"] is False
+    assert "clean shutdown" in "\n".join(render_text(status))

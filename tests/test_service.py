@@ -89,10 +89,12 @@ def test_journal_replay_skips_torn_and_corrupt_lines(tmp_path):
         j.append("submit", job="a", spec={})
         j.append("submit", job="b", spec={})
     text = open(path).read().splitlines()
-    # a bit-flipped CRC mid-file plus a torn (half-written) tail
-    flipped = text[0].replace('"crc": "', '"crc": "0')[:len(text[0])]
+    # text[0] is the schema header (ISSUE 20); corrupt the first
+    # PAYLOAD record: a bit-flipped CRC mid-file plus a torn tail
+    flipped = text[1].replace('"crc": "', '"crc": "0')[:len(text[1])]
     with open(path, "w") as f:
-        f.write(flipped + "\n" + text[1] + "\n" + '{"seq": 3, "ty')
+        f.write(text[0] + "\n" + flipped + "\n" + text[2] + "\n"
+                + '{"seq": 3, "ty')
     records, torn = Journal.replay(path)
     assert torn == 2
     assert [r["job"] for r in records] == ["b"]
